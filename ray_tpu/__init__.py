@@ -1,0 +1,34 @@
+"""ray_tpu — a TPU-native distributed computing framework.
+
+A ground-up redesign of the capabilities of the Ray reference
+(``/root/reference``, Ray 3.0.0.dev0) for TPU hardware: dynamic tasks and
+actors with distributed futures, placement groups and pluggable scheduling,
+an object store holding immutable host buffers and device-resident
+``jax.Array`` descriptors, XLA-compiled collectives over ICI meshes instead
+of NCCL calls, and Train/Tune/Data/Serve/RL library layers built on
+``jax``/``pjit``/``shard_map``/Pallas.
+"""
+
+from ray_tpu._private.config import _config  # noqa: F401
+from ray_tpu._private.worker import (available_resources, cancel,
+                                     cluster_resources, get, get_actor, init,
+                                     is_initialized, kill, nodes, put,
+                                     shutdown, wait)
+from ray_tpu.actor import ActorClass, ActorHandle, ActorMethod  # noqa: F401
+from ray_tpu.exceptions import (ActorDiedError, GetTimeoutError,  # noqa: F401
+                                ObjectLostError, RayTpuError,
+                                TaskCancelledError, TaskError)
+from ray_tpu.object_ref import ObjectRef  # noqa: F401
+from ray_tpu.remote_function import RemoteFunction, remote  # noqa: F401
+from ray_tpu.runtime_context import get_runtime_context  # noqa: F401
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "kill", "cancel", "get_actor", "available_resources", "cluster_resources",
+    "nodes", "ObjectRef", "ActorClass", "ActorHandle", "ActorMethod",
+    "RemoteFunction", "get_runtime_context",
+    "RayTpuError", "TaskError", "ActorDiedError", "ObjectLostError",
+    "GetTimeoutError", "TaskCancelledError",
+]

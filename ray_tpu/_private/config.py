@@ -1,0 +1,115 @@
+"""Runtime configuration registry.
+
+Parity with the reference's ``RAY_CONFIG(type, name, default)`` flag table
+(``src/ray/common/ray_config_def.h:22ff``): every flag is declared once with a
+type and default, is overridable via a ``RAY_TPU_<NAME>`` environment variable,
+and may be overridden programmatically via ``ray_tpu.init(_system_config=...)``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Callable, Dict
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+_PARSERS: Dict[type, Callable[[str], Any]] = {
+    bool: _parse_bool,
+    int: int,
+    float: float,
+    str: str,
+}
+
+
+class Config:
+    """Typed, env-overridable flag registry (singleton at ``ray_tpu._config``)."""
+
+    def __init__(self):
+        self._defs: Dict[str, tuple] = {}
+        self._values: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def define(self, name: str, typ: type, default: Any, doc: str = ""):
+        self._defs[name] = (typ, default, doc)
+        env = os.environ.get(f"RAY_TPU_{name.upper()}")
+        if env is not None:
+            self._values[name] = _PARSERS[typ](env)
+
+    def get(self, name: str) -> Any:
+        if name in self._values:
+            return self._values[name]
+        return self._defs[name][1]
+
+    def set(self, name: str, value: Any):
+        with self._lock:
+            typ = self._defs[name][0]
+            if not isinstance(value, typ):
+                value = _PARSERS[typ](str(value))
+            self._values[name] = value
+
+    def apply_system_config(self, system_config: Dict[str, Any] | str | None):
+        if system_config is None:
+            return
+        if isinstance(system_config, str):
+            system_config = json.loads(system_config)
+        for k, v in system_config.items():
+            self.set(k, v)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {name: self.get(name) for name in self._defs}
+
+    def __getattr__(self, name):
+        defs = object.__getattribute__(self, "_defs")
+        if name in defs:
+            return self.get(name)
+        raise AttributeError(name)
+
+
+_config = Config()
+
+# -- Core scheduling / execution ------------------------------------------------
+_config.define("num_workers_soft_limit", int, 0,
+               "0 = num_cpus; max concurrently executing CPU-bound tasks per node")
+_config.define("task_retry_delay_ms", int, 10, "delay before resubmitting a retryable task")
+_config.define("actor_restart_delay_ms", int, 10, "delay before restarting a failed actor")
+_config.define("worker_lease_timeout_s", float, 30.0, "max wait for resources before spillback")
+_config.define("scheduler_spread_threshold", float, 0.5,
+               "utilization threshold for hybrid pack->spread switch (reference: "
+               "ray_config_def.h scheduler_spread_threshold)")
+_config.define("scheduler_top_k_fraction", float, 0.2,
+               "fraction of nodes in the hybrid policy random top-k pick")
+_config.define("max_pending_lease_requests_per_scheduling_category", int, 10, "")
+
+# -- Object store ---------------------------------------------------------------
+_config.define("object_store_memory_bytes", int, 2 << 30,
+               "per-node budget for host objects before spilling")
+_config.define("object_spilling_enabled", bool, True, "spill to disk when over budget")
+_config.define("object_spilling_dir", str, "/tmp/ray_tpu_spill", "")
+_config.define("object_spilling_threshold", float, 0.8, "fraction of budget that triggers spill")
+_config.define("min_spilling_size_bytes", int, 1 << 20, "batch small objects up to this size")
+_config.define("inline_object_max_bytes", int, 100 * 1024,
+               "small objects returned inline instead of via the store")
+
+# -- Failure detection ----------------------------------------------------------
+_config.define("heartbeat_interval_ms", int, 100, "node heartbeat period")
+_config.define("num_heartbeats_timeout", int, 30, "missed heartbeats before a node is dead")
+_config.define("health_check_period_ms", int, 1000, "actor health check period")
+
+# -- Collectives / device plane -------------------------------------------------
+_config.define("collective_default_backend", str, "xla", "xla | cpu")
+_config.define("ici_axes_preference", str, "data,fsdp,tensor",
+               "mesh axis order preference: fastest-varying axes ride ICI")
+
+# -- Logging / events -----------------------------------------------------------
+_config.define("event_log_dir", str, "/tmp/ray_tpu/events", "")
+_config.define("log_dir", str, "/tmp/ray_tpu/logs", "")
+_config.define("metrics_report_interval_ms", int, 2000, "")
+
+# -- Tracing --------------------------------------------------------------------
+_config.define("tracing_enabled", bool, False, "emit per-task spans")
+_config.define("profiling_enabled", bool, True, "record timeline events")

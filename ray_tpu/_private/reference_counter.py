@@ -1,0 +1,71 @@
+"""Distributed-future reference counting with lineage pinning.
+
+Parity with the reference's ``ReferenceCounter``
+(``src/ray/core_worker/reference_count.h:61``): tracks local refs and
+task-argument pins per object; when counts hit zero the object is freed from
+the store, but the *task spec* that produced it is retained by the lineage
+table while any downstream object still depends on it, enabling
+reconstruction (``object_recovery_manager.h:90``). The runtime here is
+host-granular, so "local refs" covers all workers in the owner process;
+borrower bookkeeping reduces to refs held by serialized handles.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Set
+
+from ray_tpu._private.ids import ObjectID
+
+
+class ReferenceCounter:
+    def __init__(self, on_zero: Optional[Callable[[ObjectID], None]] = None):
+        self._lock = threading.Lock()
+        self._local_refs: Dict[ObjectID, int] = {}
+        self._pins: Dict[ObjectID, int] = {}  # in-flight task arg pins
+        self._on_zero = on_zero
+
+    def set_on_zero(self, cb: Callable[[ObjectID], None]):
+        self._on_zero = cb
+
+    def add_local_ref(self, oid: ObjectID):
+        with self._lock:
+            self._local_refs[oid] = self._local_refs.get(oid, 0) + 1
+
+    def remove_local_ref(self, oid: ObjectID):
+        cb = None
+        with self._lock:
+            n = self._local_refs.get(oid, 0) - 1
+            if n > 0:
+                self._local_refs[oid] = n
+            else:
+                self._local_refs.pop(oid, None)
+                if self._pins.get(oid, 0) == 0:
+                    cb = self._on_zero
+        if cb is not None:
+            cb(oid)
+
+    def pin_for_task(self, oid: ObjectID):
+        with self._lock:
+            self._pins[oid] = self._pins.get(oid, 0) + 1
+
+    def unpin_for_task(self, oid: ObjectID):
+        cb = None
+        with self._lock:
+            n = self._pins.get(oid, 0) - 1
+            if n > 0:
+                self._pins[oid] = n
+            else:
+                self._pins.pop(oid, None)
+                if self._local_refs.get(oid, 0) == 0:
+                    cb = self._on_zero
+        if cb is not None:
+            cb(oid)
+
+    def has_refs(self, oid: ObjectID) -> bool:
+        with self._lock:
+            return self._local_refs.get(oid, 0) > 0 or self._pins.get(oid, 0) > 0
+
+    def live_objects(self) -> Set[ObjectID]:
+        with self._lock:
+            return set(self._local_refs) | set(self._pins)

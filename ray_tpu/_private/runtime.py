@@ -1,0 +1,1079 @@
+"""The host-granular distributed runtime.
+
+This is the TPU-native redesign of the reference's L2 "kernel"
+(GCS ``src/ray/gcs/gcs_server/gcs_server.h:70`` + raylet
+``src/ray/raylet/node_manager.h`` + core_worker
+``src/ray/core_worker/core_worker.h:63``), collapsed around one hard
+hardware constraint: **a TPU host's devices are owned by exactly one
+process** (libtpu is single-owner). So instead of process-per-worker with a
+shared-memory arena between processes, the unit of distribution is the *host
+runtime*: TPU tasks and actors execute as concurrency-scheduled threads
+inside the device-owner process (the GIL is released for the duration of XLA
+executions, so threads scale), device values stay resident as immutable
+``jax.Array`` descriptors in the object store, and collectives are compiled
+into the computation rather than invoked by the runtime.
+
+What maps where:
+
+- ``Runtime``   = GCS: node/actor/job/PG tables, internal KV, named actors,
+                  object directory, heartbeat-style failure propagation.
+- ``Node``      = raylet + plasma: resource accounting, admission (leases),
+                  a worker pool (thread executor), a local object store.
+- ``TaskManager`` = core_worker's TaskManager + ObjectRecoveryManager:
+                  retries (``task_manager.h:152``) and lineage-based object
+                  reconstruction (``object_recovery_manager.h:90``).
+- ``ActorState``  = GcsActorManager entry + the actor's scheduling queue
+                  (ordered mailbox; ``transport/actor_scheduling_queue.cc``),
+                  with restart-up-to-``max_restarts``
+                  (``gcs_actor_manager.h:66,433``).
+
+Multi-host: each host runs one ``Runtime`` peer; the tensor plane between
+hosts is JAX's multi-controller SPMD (``jax.distributed``), the control plane
+is this module's state service reachable over gRPC (see
+``ray_tpu/_private/state_service*``). In-process, ``cluster_utils.Cluster``
+instantiates many ``Node``s under one ``Runtime`` for multi-node tests, like
+the reference's ``python/ray/cluster_utils.py:99``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private.config import _config
+from ray_tpu._private.ids import (ActorID, JobID, NodeID, ObjectID,
+                                  PlacementGroupID, TaskID)
+from ray_tpu._private.object_store import ObjectStore
+from ray_tpu._private.reference_counter import ReferenceCounter
+from ray_tpu._private.resources import (CPU, TPU, NodeResources, ResourceSet)
+from ray_tpu._private.scheduler import (HybridPolicy, Infeasible, NodeState,
+                                        SpreadPolicy, schedule_bundles)
+from ray_tpu._private.task_spec import TaskSpec
+
+logger = logging.getLogger("ray_tpu")
+
+_MAX_NODE_THREADS = 256
+
+
+class _TaskContext(threading.local):
+    def __init__(self):
+        self.node_id: Optional[NodeID] = None
+        self.task_id: Optional[TaskID] = None
+        self.actor_id: Optional[ActorID] = None
+        self.job_id: Optional[JobID] = None
+        self.devices: Optional[list] = None
+        self.placement_group: Any = None
+        self.put_counter: int = 0
+        self.cancel_flag: Optional[threading.Event] = None
+
+
+task_context = _TaskContext()
+
+
+class Node:
+    """One (possibly virtual) host: resources + object store + worker pool."""
+
+    def __init__(self, runtime: "Runtime", resources: ResourceSet,
+                 node_id: Optional[NodeID] = None, labels: Optional[dict] = None):
+        self.runtime = runtime
+        self.node_id = node_id or NodeID.from_random()
+        self.resources = NodeResources(resources)
+        self.store = ObjectStore(self.node_id)
+        self.labels = labels or {}
+        self.alive = True
+        self._pool = ThreadPoolExecutor(
+            max_workers=_MAX_NODE_THREADS,
+            thread_name_prefix=f"node-{self.node_id.hex()[:6]}")
+        # Bundle carve-outs: (pg_id, bundle_index) -> NodeResources
+        self.bundles: Dict[Tuple[PlacementGroupID, int], NodeResources] = {}
+
+    def submit(self, fn: Callable, *args) -> None:
+        self._pool.submit(fn, *args)
+
+    def state(self) -> NodeState:
+        return NodeState(self.node_id, self.resources, self.alive)
+
+    def kill(self):
+        """Simulate host failure: objects lost, resources gone (chaos tests)."""
+        self.alive = False
+
+    def shutdown(self):
+        self.alive = False
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+class ActorState:
+    RESTARTING = "RESTARTING"
+    ALIVE = "ALIVE"
+    DEAD = "DEAD"
+    PENDING = "PENDING"
+
+    def __init__(self, actor_id: ActorID, cls, args, kwargs, options,
+                 name: Optional[str], namespace: str):
+        self.actor_id = actor_id
+        self.cls = cls
+        self.args = args
+        self.kwargs = kwargs
+        self.options = options
+        self.name = name
+        self.namespace = namespace
+        self.node_id: Optional[NodeID] = None
+        self.instance: Any = None
+        self.status = self.PENDING
+        self.restart_count = 0
+        self.mailbox: "queue.Queue" = queue.Queue()
+        self.seq = 0
+        self.lock = threading.RLock()
+        self.ready = threading.Event()
+        self.death_cause: Optional[BaseException] = None
+        self.threads: List[threading.Thread] = []
+        self.is_async = False
+        self.loop = None  # asyncio loop for async actors
+        self.devices: Optional[list] = None
+
+
+class PlacementGroupState:
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[ResourceSet],
+                 strategy: str, name: str = ""):
+        self.pg_id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.name = name
+        self.bundle_nodes: Optional[List[NodeID]] = None
+        self.ready = threading.Event()
+        self.state = "PENDING"
+
+
+class KVStore:
+    """Internal KV with namespaces (GcsKvManager parity,
+    ``python/ray/_private/gcs_utils.py:264-341``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data: Dict[bytes, Dict[bytes, bytes]] = {}
+
+    @staticmethod
+    def _ns(namespace: Optional[bytes]) -> bytes:
+        return namespace or b""
+
+    def put(self, key: bytes, value: bytes, overwrite: bool = True,
+            namespace: Optional[bytes] = None) -> bool:
+        with self._lock:
+            ns = self._data.setdefault(self._ns(namespace), {})
+            if not overwrite and key in ns:
+                return False
+            ns[key] = value
+            return True
+
+    def get(self, key: bytes, namespace: Optional[bytes] = None) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(self._ns(namespace), {}).get(key)
+
+    def delete(self, key: bytes, namespace: Optional[bytes] = None) -> bool:
+        with self._lock:
+            return self._data.get(self._ns(namespace), {}).pop(key, None) is not None
+
+    def keys(self, prefix: bytes = b"", namespace: Optional[bytes] = None) -> List[bytes]:
+        with self._lock:
+            return [k for k in self._data.get(self._ns(namespace), {})
+                    if k.startswith(prefix)]
+
+
+class Runtime:
+    """Cluster state service + task manager for this driver process."""
+
+    def __init__(self, job_id: Optional[JobID] = None):
+        self.job_id = job_id or JobID.from_random()
+        self.nodes: Dict[NodeID, Node] = {}
+        self._node_order: List[NodeID] = []
+        self.kv = KVStore()
+        from ray_tpu._private.ids import _Counter
+        self._put_counter = _Counter()
+        self.reference_counter = ReferenceCounter(self._on_ref_zero)
+        self.lock = threading.RLock()
+        self.head_node: Optional[Node] = None
+
+        # object directory: ObjectID -> NodeID (owner store)
+        self.object_locations: Dict[ObjectID, NodeID] = {}
+        # lineage: ObjectID -> TaskSpec that produces it
+        self.lineage: Dict[ObjectID, TaskSpec] = {}
+        self.task_states: Dict[TaskID, str] = {}
+        self.cancel_flags: Dict[TaskID, threading.Event] = {}
+
+        self.actors: Dict[ActorID, ActorState] = {}
+        self.named_actors: Dict[Tuple[str, str], ActorID] = {}
+        self.placement_groups: Dict[PlacementGroupID, PlacementGroupState] = {}
+
+        self.hybrid_policy = HybridPolicy()
+        self.spread_policy = SpreadPolicy()
+
+        # Pending queue of tasks waiting for resources / dependencies.
+        self._pending: List[dict] = []
+        self._pending_cv = threading.Condition()
+        self._util_pool = ThreadPoolExecutor(max_workers=32,
+                                             thread_name_prefix="rt-util")
+        self._shutdown = False
+        self._dispatcher = threading.Thread(target=self._dispatch_loop,
+                                            name="rt-dispatcher", daemon=True)
+        self._dispatcher.start()
+        self._events: List[dict] = []  # structured event log
+
+    # ------------------------------------------------------------------ nodes
+
+    def add_node(self, resources: ResourceSet, labels: Optional[dict] = None) -> Node:
+        node = Node(self, resources, labels=labels)
+        with self.lock:
+            self.nodes[node.node_id] = node
+            self._node_order.append(node.node_id)
+            if self.head_node is None:
+                self.head_node = node
+        self._kick()
+        return node
+
+    def remove_node(self, node_id: NodeID):
+        """Node death: lose its objects, fail its actors, trigger recovery."""
+        with self.lock:
+            node = self.nodes.get(node_id)
+            if node is None:
+                return
+            node.kill()
+            dead_actors = [a for a in self.actors.values()
+                           if a.node_id == node_id and a.status != ActorState.DEAD]
+            lost_objects = [oid for oid, nid in self.object_locations.items()
+                            if nid == node_id]
+        for a in dead_actors:
+            self._handle_actor_failure(a, exc.NodeDiedError(
+                f"node {node_id.hex()[:8]} died"))
+        for oid in lost_objects:
+            with self.lock:
+                self.object_locations.pop(oid, None)
+        self.emit_event("NODE_DEAD", node_id=node_id.hex())
+        self._kick()
+
+    def node_states(self) -> List[NodeState]:
+        with self.lock:
+            return [self.nodes[nid].state() for nid in self._node_order]
+
+    # ---------------------------------------------------------------- objects
+
+    def put_object(self, value: Any, owner_node: Optional[Node] = None) -> ObjectID:
+        node = owner_node or self._current_or_head_node()
+        from ray_tpu._private.worker import current_task_id
+        tid = current_task_id()
+        # Runtime-global counter: driver threads share the driver TaskID, so a
+        # per-task counter would collide across threads.
+        oid = ObjectID.for_put(tid, self._put_counter.next())
+        node.store.put(oid, value)
+        with self.lock:
+            self.object_locations[oid] = node.node_id
+        return oid
+
+    def seal_return(self, oid: ObjectID, value: Any, node: Node):
+        node.store.put(oid, value)
+        with self.lock:
+            self.object_locations[oid] = node.node_id
+
+    def seal_error(self, oid: ObjectID, error: BaseException, node: Node):
+        node.store.put_error(oid, error)
+        with self.lock:
+            self.object_locations[oid] = node.node_id
+
+    def get_object(self, oid: ObjectID, timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            node = self._locate(oid)
+            if node is not None:
+                try:
+                    remaining = None if deadline is None else max(
+                        0.0, deadline - time.monotonic())
+                    return node.store.get(oid, timeout=remaining)
+                except exc.RayTpuError:
+                    raise
+                except TimeoutError:
+                    raise exc.GetTimeoutError(f"get({oid}) timed out")
+                except Exception as e:
+                    from ray_tpu._private.object_store import ObjectLostError
+                    if not isinstance(e, ObjectLostError):
+                        raise
+            # No live copy. Producing task may still be in flight (just wait),
+            # or it finished and the copy was lost (reconstruct from lineage).
+            with self.lock:
+                spec = self.lineage.get(oid)
+                state = (self.task_states.get(spec.task_id)
+                         if spec is not None else None)
+            if spec is None:
+                raise exc.ObjectLostError(
+                    f"object {oid} is lost and has no lineage to reconstruct")
+            if state in ("FINISHED", "FAILED", None):
+                # The value (or error) existed and was lost with its node.
+                if not self._try_reconstruct(oid):
+                    raise exc.ObjectLostError(
+                        f"object {oid} is lost and could not be reconstructed")
+            if deadline is not None and time.monotonic() > deadline:
+                raise exc.GetTimeoutError(f"get({oid}) timed out")
+            time.sleep(0.005)
+
+    def object_ready(self, oid: ObjectID) -> bool:
+        node = self._locate(oid)
+        return node is not None and node.store.contains(oid)
+
+    def _locate(self, oid: ObjectID) -> Optional[Node]:
+        with self.lock:
+            nid = self.object_locations.get(oid)
+            if nid is None:
+                return None
+            node = self.nodes.get(nid)
+            if node is None or not node.alive:
+                return None
+            return node
+
+    def _try_reconstruct(self, oid: ObjectID) -> bool:
+        """Lineage reconstruction (ObjectRecoveryManager::RecoverObject)."""
+        with self.lock:
+            spec = self.lineage.get(oid)
+            if spec is None:
+                return False
+            state = self.task_states.get(spec.task_id)
+            if state == "RESUBMITTED":
+                return True
+            if spec.retries_left() <= 0 and state != "PENDING":
+                return False
+            self.task_states[spec.task_id] = "RESUBMITTED"
+            spec.attempt += 1
+        self.emit_event("OBJECT_RECONSTRUCT", object_id=oid.hex(),
+                        task=spec.function_name)
+        # Elastic recovery: a hard node-affinity to a dead node would make the
+        # lineage permanently unrecoverable; degrade to soft affinity.
+        from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+        strat = spec.options.scheduling_strategy
+        if isinstance(strat, NodeAffinitySchedulingStrategy) and not strat.soft:
+            with self.lock:
+                target_alive = any(
+                    n.node_id.hex() == strat.node_id and n.alive
+                    for n in (self.nodes[nid] for nid in self._node_order))
+            if not target_alive:
+                spec.options.scheduling_strategy = NodeAffinitySchedulingStrategy(
+                    node_id=strat.node_id, soft=True)
+        if spec.is_actor_task():
+            self.submit_actor_task(spec.actor_id, spec)
+        else:
+            self.submit_task(spec)
+        return True
+
+    def _on_ref_zero(self, oid: ObjectID):
+        node = self._locate(oid)
+        if node is not None:
+            node.store.free(oid)
+        with self.lock:
+            self.object_locations.pop(oid, None)
+            self.lineage.pop(oid, None)
+
+    # ------------------------------------------------------------------ tasks
+
+    def submit_task(self, spec: TaskSpec) -> List[ObjectID]:
+        if not spec.return_ids:
+            spec.return_ids = tuple(
+                ObjectID.for_return(spec.task_id, i)
+                for i in range(spec.options.num_returns))
+        with self.lock:
+            for rid in spec.return_ids:
+                self.lineage[rid] = spec
+            self.task_states[spec.task_id] = "PENDING"
+            cancel = self.cancel_flags.setdefault(spec.task_id, threading.Event())
+        # Pin argument objects for the duration of the task.
+        for oid in _ref_ids_in(spec.args, spec.kwargs):
+            self.reference_counter.pin_for_task(oid)
+        with self._pending_cv:
+            self._pending.append({"spec": spec, "cancel": cancel})
+            self._pending_cv.notify_all()
+        return list(spec.return_ids)
+
+    def cancel_task(self, task_id: TaskID, force: bool = False):
+        with self.lock:
+            flag = self.cancel_flags.get(task_id)
+            state = self.task_states.get(task_id)
+        if flag is not None:
+            flag.set()
+        self._kick()
+
+    # The dispatcher: dependency resolution + scheduling + admission.
+    def _dispatch_loop(self):
+        while not self._shutdown:
+            with self._pending_cv:
+                if not self._pending:
+                    self._pending_cv.wait(timeout=0.05)
+                pending, self._pending = self._pending, []
+            still_waiting = []
+            for item in pending:
+                try:
+                    action = self._try_dispatch(item)
+                except Infeasible as e:
+                    spec = item["spec"]
+                    err_cls = (exc.PlacementGroupSchedulingError
+                               if spec.options.placement_group is not None
+                               else exc.RayTpuError)
+                    for rid in spec.return_ids:
+                        self.seal_error(rid, err_cls(str(e)), self.head_node)
+                    self._unpin_args(spec)
+                    with self.lock:
+                        self.task_states[spec.task_id] = "FAILED"
+                    continue
+                except Exception as e:  # defensive: never kill the dispatcher
+                    spec = item["spec"]
+                    logger.exception("dispatch error for %s", spec.function_name)
+                    for rid in spec.return_ids:
+                        self.seal_error(rid, exc.RayTpuError(
+                            f"scheduling failed: {e}"), self.head_node)
+                    self._unpin_args(spec)
+                    with self.lock:
+                        self.task_states[spec.task_id] = "FAILED"
+                    continue
+                if action == "wait":
+                    still_waiting.append(item)
+            if still_waiting:
+                with self._pending_cv:
+                    self._pending.extend(still_waiting)
+                time.sleep(0.002)
+
+    def _kick(self):
+        with self._pending_cv:
+            self._pending_cv.notify_all()
+
+    def _deps_ready(self, spec: TaskSpec) -> bool:
+        for oid in _ref_ids_in(spec.args, spec.kwargs):
+            if not self.object_ready(oid):
+                # Trigger reconstruction of lost deps.
+                node = self._locate(oid)
+                if node is None:
+                    with self.lock:
+                        known = oid in self.object_locations
+                    if not known:
+                        self._try_reconstruct(oid)
+                return False
+        return True
+
+    def _try_dispatch(self, item: dict) -> str:
+        spec: TaskSpec = item["spec"]
+        cancel: threading.Event = item["cancel"]
+        if cancel.is_set():
+            for rid in spec.return_ids:
+                self.seal_error(rid, exc.TaskCancelledError(spec.task_id),
+                                self.head_node)
+            self._unpin_args(spec)
+            return "done"
+        if not self._deps_ready(spec):
+            return "wait"
+        # Check a dep didn't resolve to an error (error propagation).
+        err = self._first_dep_error(spec)
+        if err is not None:
+            for rid in spec.return_ids:
+                self.seal_error(rid, err, self.head_node)
+            self._unpin_args(spec)
+            return "done"
+        node_id = self._select_node(spec)
+        if node_id is None:
+            return "wait"
+        node = self.nodes[node_id]
+        request = self._effective_request(spec)
+        alloc_target = self._allocation_target(spec, node)
+        if not alloc_target.can_fit(request):
+            return "wait"
+        alloc_target.allocate(request)
+        with self.lock:
+            self.task_states[spec.task_id] = "RUNNING"
+        node.submit(self._execute_task, spec, node, request, alloc_target, cancel)
+        return "done"
+
+    def _first_dep_error(self, spec: TaskSpec) -> Optional[BaseException]:
+        for oid in _ref_ids_in(spec.args, spec.kwargs):
+            node = self._locate(oid)
+            if node is None:
+                continue
+            err = node.store.peek_error(oid)
+            if isinstance(err, (exc.TaskError, exc.TaskCancelledError,
+                                exc.ActorDiedError)):
+                return err
+        return None
+
+    def _effective_request(self, spec: TaskSpec) -> ResourceSet:
+        return spec.options.resources
+
+    def _allocation_target(self, spec: TaskSpec, node: Node):
+        pg = spec.options.placement_group
+        if pg is not None:
+            idx = spec.options.placement_group_bundle_index
+            pg_state = self.placement_groups[pg.id]
+            if idx < 0:
+                # Any bundle on this node with room.
+                for (pgid, i), br in node.bundles.items():
+                    if pgid == pg.id and br.can_fit(spec.options.resources):
+                        return br
+                # fall through: first bundle on node
+                for (pgid, i), br in node.bundles.items():
+                    if pgid == pg.id:
+                        return br
+                raise Infeasible("no bundle of placement group on chosen node")
+            return node.bundles[(pg.id, idx)]
+        return node.resources
+
+    def _select_node(self, spec: TaskSpec) -> Optional[NodeID]:
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy, PlacementGroupSchedulingStrategy)
+        strategy = spec.options.scheduling_strategy
+        request = spec.options.resources
+        states = self.node_states()
+        pg = spec.options.placement_group
+        if isinstance(strategy, PlacementGroupSchedulingStrategy):
+            pg = strategy.placement_group
+            spec.options.placement_group = pg
+            spec.options.placement_group_bundle_index = (
+                strategy.placement_group_bundle_index)
+        if pg is not None:
+            pg_state = self.placement_groups[pg.id]
+            if not pg_state.ready.is_set():
+                return None
+            idx = spec.options.placement_group_bundle_index
+            candidates = (pg_state.bundle_nodes if idx < 0
+                          else [pg_state.bundle_nodes[idx]])
+            for nid in candidates:
+                node = self.nodes[nid]
+                if not node.alive:
+                    continue
+                for (pgid, i), br in node.bundles.items():
+                    if pgid == pg.id and br.can_fit(request):
+                        return nid
+            return None
+        if isinstance(strategy, NodeAffinitySchedulingStrategy):
+            from ray_tpu._private.scheduler import NodeAffinityPolicy
+            return NodeAffinityPolicy().select(states, request,
+                                               strategy.node_id, strategy.soft)
+        if strategy == "SPREAD":
+            chosen = self.spread_policy.select(states, request)
+        else:
+            preferred = task_context.node_id
+            chosen = self.hybrid_policy.select(states, request, preferred)
+        if chosen is None and not any(
+                n.alive and n.resources.could_ever_fit(request)
+                for n in states):
+            raise Infeasible(
+                f"request {request} cannot be satisfied by any node "
+                f"(cluster totals: "
+                f"{[n.resources.total.to_dict() for n in states]})")
+        return chosen
+
+    def _assign_devices(self, request: ResourceSet, node: Node) -> Optional[list]:
+        """Map a TPU resource grant to concrete jax devices (the TPU-native
+        analogue of CUDA_VISIBLE_DEVICES assignment, ``_raylet.pyx:563``)."""
+        n = int(request.get(TPU))
+        if n <= 0:
+            return None
+        try:
+            import jax
+            devs = jax.devices()
+        except Exception:
+            return None
+        return devs[:n] if len(devs) >= n else devs
+
+    def _execute_task(self, spec: TaskSpec, node: Node, request: ResourceSet,
+                      alloc_target, cancel: threading.Event):
+        ctx = task_context
+        prev = (ctx.node_id, ctx.task_id, ctx.job_id, ctx.put_counter,
+                ctx.devices, ctx.cancel_flag, ctx.placement_group)
+        ctx.node_id = node.node_id
+        ctx.task_id = spec.task_id
+        ctx.job_id = spec.job_id
+        ctx.put_counter = 0
+        ctx.devices = self._assign_devices(request, node)
+        ctx.cancel_flag = cancel
+        ctx.placement_group = spec.options.placement_group
+        t0 = time.monotonic()
+        try:
+            if cancel.is_set():
+                raise exc.TaskCancelledError(spec.task_id)
+            args = _resolve_refs(spec.args, self)
+            kwargs = _resolve_refs(spec.kwargs, self)
+            result = spec.function(*args, **kwargs)
+            if cancel.is_set():
+                raise exc.TaskCancelledError(spec.task_id)
+            self._seal_results(spec, node, result)
+            with self.lock:
+                self.task_states[spec.task_id] = "FINISHED"
+        except BaseException as e:  # noqa: BLE001
+            self._handle_task_failure(spec, node, e)
+        finally:
+            alloc_target.release(request)
+            self._unpin_args(spec)
+            self.emit_event("TASK_DONE", task=spec.function_name,
+                            ms=round((time.monotonic() - t0) * 1e3, 3))
+            (ctx.node_id, ctx.task_id, ctx.job_id, ctx.put_counter,
+             ctx.devices, ctx.cancel_flag, ctx.placement_group) = prev
+            self._kick()
+
+    def _seal_results(self, spec: TaskSpec, node: Node, result: Any):
+        n = spec.options.num_returns
+        if n == 1:
+            self.seal_return(spec.return_ids[0], result, node)
+        elif n == 0:
+            pass
+        else:
+            values = tuple(result)
+            if len(values) != n:
+                raise ValueError(
+                    f"task {spec.function_name} declared num_returns={n} "
+                    f"but returned {len(values)} values")
+            for rid, v in zip(spec.return_ids, values):
+                self.seal_return(rid, v, node)
+
+    def _handle_task_failure(self, spec: TaskSpec, node: Node, e: BaseException):
+        if isinstance(e, exc.TaskCancelledError):
+            for rid in spec.return_ids:
+                self.seal_error(rid, e, node)
+            with self.lock:
+                self.task_states[spec.task_id] = "CANCELLED"
+            return
+        if spec.should_retry(e):
+            spec.attempt += 1
+            delay = _config.get("task_retry_delay_ms") / 1e3
+            self.emit_event("TASK_RETRY", task=spec.function_name,
+                            attempt=spec.attempt)
+            timer = threading.Timer(delay, lambda: self.submit_task(spec))
+            timer.daemon = True
+            timer.start()
+            return
+        wrapped = e if isinstance(e, exc.RayTpuError) else exc.TaskError(
+            spec.function_name, e)
+        for rid in spec.return_ids:
+            self.seal_error(rid, wrapped, node)
+        with self.lock:
+            self.task_states[spec.task_id] = "FAILED"
+
+    def _unpin_args(self, spec: TaskSpec):
+        for oid in _ref_ids_in(spec.args, spec.kwargs):
+            self.reference_counter.unpin_for_task(oid)
+
+    def _current_or_head_node(self) -> Node:
+        nid = task_context.node_id
+        with self.lock:
+            if nid is not None and nid in self.nodes and self.nodes[nid].alive:
+                return self.nodes[nid]
+            assert self.head_node is not None, "runtime has no nodes"
+            return self.head_node
+
+    # ----------------------------------------------------------------- actors
+
+    def create_actor(self, state: ActorState) -> None:
+        with self.lock:
+            self.actors[state.actor_id] = state
+            if state.name:
+                key = (state.namespace, state.name)
+                if key in self.named_actors:
+                    raise ValueError(
+                        f"actor name {state.name!r} already taken in "
+                        f"namespace {state.namespace!r}")
+                self.named_actors[key] = state.actor_id
+        self._util_pool.submit(self._place_and_start_actor, state)
+
+    def _place_and_start_actor(self, state: ActorState, restart: bool = False):
+        deadline = time.monotonic() + _config.get("worker_lease_timeout_s")
+        request = state.options.resources
+        spec_like = TaskSpec(
+            task_id=TaskID.for_actor_task(self.job_id, state.actor_id),
+            job_id=self.job_id, function=lambda: None,
+            function_name=f"{state.cls.__name__}.__init__", args=state.args,
+            kwargs=state.kwargs, options=state.options)
+        while True:
+            try:
+                node_id = self._select_node(spec_like)
+            except Infeasible as e:
+                self._mark_actor_dead(state, exc.ActorDiedError(str(e)))
+                return
+            if node_id is not None:
+                node = self.nodes[node_id]
+                target = self._allocation_target(spec_like, node)
+                if target.can_fit(request):
+                    target.allocate(request)
+                    break
+            if time.monotonic() > deadline:
+                self._mark_actor_dead(state, exc.ActorDiedError(
+                    f"could not place actor {state.cls.__name__} "
+                    f"(resources {request})"))
+                return
+            time.sleep(0.005)
+        state.node_id = node_id
+        state.devices = self._assign_devices(request, node)
+        self._start_actor_on_node(state, node, request)
+
+    def _start_actor_on_node(self, state: ActorState, node: Node,
+                             request: ResourceSet):
+        import inspect
+        methods = [m for _, m in inspect.getmembers(
+            state.cls, predicate=inspect.isfunction)]
+        state.is_async = any(inspect.iscoroutinefunction(m) for m in methods)
+        max_c = getattr(state.options, "max_concurrency", None) or 1
+        if state.is_async and max_c == 1:
+            max_c = 1000  # reference default for async actors
+
+        def _init_and_loop():
+            ctx = task_context
+            ctx.node_id = node.node_id
+            ctx.actor_id = state.actor_id
+            ctx.job_id = self.job_id
+            ctx.devices = state.devices
+            ctx.placement_group = state.options.placement_group
+            try:
+                args = _resolve_refs(state.args, self)
+                kwargs = _resolve_refs(state.kwargs, self)
+                state.instance = state.cls(*args, **kwargs)
+                state.status = ActorState.ALIVE
+                state.ready.set()
+                self.emit_event("ACTOR_ALIVE", actor=state.cls.__name__)
+            except BaseException as e:  # noqa: BLE001
+                self._mark_actor_dead(state, exc.ActorDiedError(
+                    f"actor {state.cls.__name__} __init__ failed: "
+                    f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
+                return
+            if state.is_async:
+                self._run_async_actor_loop(state, max_c)
+            else:
+                self._run_actor_loop(state, node)
+
+        if state.is_async or max_c == 1:
+            t = threading.Thread(target=_init_and_loop, daemon=True,
+                                 name=f"actor-{state.cls.__name__}")
+            state.threads = [t]
+            t.start()
+        else:
+            # Threaded actor (max_concurrency>1): one mailbox, N consumers —
+            # execution order is relaxed like the reference's
+            # out_of_order_actor_scheduling_queue.cc.
+            def _consumer_entry(first: bool):
+                if first:
+                    _init_and_loop()
+                else:
+                    state.ready.wait()
+                    if state.status == ActorState.ALIVE:
+                        ctx = task_context
+                        ctx.node_id = node.node_id
+                        ctx.actor_id = state.actor_id
+                        ctx.job_id = self.job_id
+                        ctx.devices = state.devices
+                        self._run_actor_loop(state, node)
+            state.threads = []
+            for i in range(max_c):
+                t = threading.Thread(target=_consumer_entry, args=(i == 0,),
+                                     daemon=True,
+                                     name=f"actor-{state.cls.__name__}-{i}")
+                state.threads.append(t)
+                t.start()
+
+    def _run_actor_loop(self, state: ActorState, node: Node):
+        while True:
+            item = state.mailbox.get()
+            if item is None or state.status == ActorState.DEAD:
+                return
+            spec, cancel = item
+            ctx = task_context
+            ctx.task_id = spec.task_id
+            ctx.cancel_flag = cancel
+            ctx.put_counter = 0
+            try:
+                if cancel.is_set():
+                    raise exc.TaskCancelledError(spec.task_id)
+                args = _resolve_refs(spec.args, self)
+                kwargs = _resolve_refs(spec.kwargs, self)
+                method = getattr(state.instance, spec.method_name)
+                result = method(*args, **kwargs)
+                self._seal_results(spec, node, result)
+                with self.lock:
+                    self.task_states[spec.task_id] = "FINISHED"
+            except BaseException as e:  # noqa: BLE001
+                if isinstance(e, (exc.TaskCancelledError,)):
+                    wrapped: BaseException = e
+                else:
+                    wrapped = exc.TaskError(
+                        f"{state.cls.__name__}.{spec.method_name}", e)
+                for rid in spec.return_ids:
+                    self.seal_error(rid, wrapped, node)
+                with self.lock:
+                    self.task_states[spec.task_id] = "FAILED"
+            finally:
+                self._unpin_args(spec)
+                self._kick()
+
+    def _run_async_actor_loop(self, state: ActorState, max_concurrency: int):
+        import asyncio
+        loop = asyncio.new_event_loop()
+        state.loop = loop
+        node = self.nodes[state.node_id]
+        sem = asyncio.Semaphore(max_concurrency)
+
+        async def _run_one(spec: TaskSpec, cancel):
+            async with sem:
+                try:
+                    if cancel.is_set():
+                        raise exc.TaskCancelledError(spec.task_id)
+                    args = _resolve_refs(spec.args, self)
+                    kwargs = _resolve_refs(spec.kwargs, self)
+                    method = getattr(state.instance, spec.method_name)
+                    result = method(*args, **kwargs)
+                    if asyncio.iscoroutine(result):
+                        result = await result
+                    self._seal_results(spec, node, result)
+                    with self.lock:
+                        self.task_states[spec.task_id] = "FINISHED"
+                except BaseException as e:  # noqa: BLE001
+                    wrapped = e if isinstance(e, exc.RayTpuError) else exc.TaskError(
+                        f"{state.cls.__name__}.{spec.method_name}", e)
+                    for rid in spec.return_ids:
+                        self.seal_error(rid, wrapped, node)
+                    with self.lock:
+                        self.task_states[spec.task_id] = "FAILED"
+                finally:
+                    self._unpin_args(spec)
+                    self._kick()
+
+        async def _pump():
+            while state.status != ActorState.DEAD:
+                item = await loop.run_in_executor(None, state.mailbox.get)
+                if item is None:
+                    break
+                spec, cancel = item
+                loop.create_task(_run_one(spec, cancel))
+
+        try:
+            loop.run_until_complete(_pump())
+        finally:
+            loop.close()
+
+    def submit_actor_task(self, actor_id: ActorID, spec: TaskSpec) -> List[ObjectID]:
+        with self.lock:
+            state = self.actors.get(actor_id)
+        if not spec.return_ids:
+            spec.return_ids = tuple(ObjectID.for_return(spec.task_id, i)
+                                    for i in range(spec.options.num_returns))
+        cancel = threading.Event()
+        with self.lock:
+            self.cancel_flags[spec.task_id] = cancel
+            for rid in spec.return_ids:
+                self.lineage[rid] = spec
+            self.task_states[spec.task_id] = "PENDING"
+        if state is None or state.status == ActorState.DEAD:
+            cause = state.death_cause if state else None
+            err = exc.ActorDiedError(f"actor {actor_id} is dead: {cause}")
+            for rid in spec.return_ids:
+                self.seal_error(rid, err, self._current_or_head_node())
+            return list(spec.return_ids)
+        for oid in _ref_ids_in(spec.args, spec.kwargs):
+            self.reference_counter.pin_for_task(oid)
+        state.mailbox.put((spec, cancel))
+        return list(spec.return_ids)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        with self.lock:
+            state = self.actors.get(actor_id)
+        if state is None:
+            return
+        max_restarts = getattr(state.options, "max_restarts", 0)
+        out_of_restarts = (max_restarts != -1
+                           and state.restart_count >= max_restarts)
+        if no_restart or out_of_restarts:
+            self._mark_actor_dead(state, exc.ActorDiedError(
+                "actor was killed via ray_tpu.kill"))
+        else:
+            self._handle_actor_failure(state, exc.ActorDiedError("killed"))
+
+    def _mark_actor_dead(self, state: ActorState, cause: BaseException):
+        with state.lock:
+            if state.status == ActorState.DEAD:
+                return
+            state.status = ActorState.DEAD
+            state.death_cause = cause
+            state.ready.set()
+        # Fail everything still queued.
+        drained = []
+        try:
+            while True:
+                item = state.mailbox.get_nowait()
+                if item is not None:
+                    drained.append(item)
+        except queue.Empty:
+            pass
+        node = self._current_or_head_node()
+        for spec, _cancel in drained:
+            for rid in spec.return_ids:
+                self.seal_error(rid, exc.ActorDiedError(str(cause)), node)
+            self._unpin_args(spec)
+        state.mailbox.put(None)  # wake consumers so threads exit
+        self._release_actor_allocation(state)
+        with self.lock:
+            if state.name and self.named_actors.get(
+                    (state.namespace, state.name)) == state.actor_id:
+                del self.named_actors[(state.namespace, state.name)]
+        self.emit_event("ACTOR_DEAD", actor=state.cls.__name__, cause=str(cause))
+
+    def _release_actor_allocation(self, state: ActorState):
+        """Release the dead/restarting incarnation's resource grant (once)."""
+        with state.lock:
+            node_id, state.node_id = state.node_id, None
+        if node_id is None:
+            return
+        node = self.nodes.get(node_id)
+        if node is None or not node.alive:
+            return
+        try:
+            target = self._allocation_target(
+                TaskSpec(task_id=TaskID.for_task(self.job_id),
+                         job_id=self.job_id, function=lambda: None,
+                         function_name="", args=(), kwargs={},
+                         options=state.options), node)
+            target.release(state.options.resources)
+        except Exception:
+            pass
+
+    def _handle_actor_failure(self, state: ActorState, cause: BaseException):
+        """Restart up to max_restarts (GcsActorManager::ReconstructActor)."""
+        max_restarts = getattr(state.options, "max_restarts", 0)
+        if max_restarts != -1 and state.restart_count >= max_restarts:
+            self._mark_actor_dead(state, cause)
+            return
+        self._release_actor_allocation(state)
+        with state.lock:
+            state.restart_count += 1
+            state.status = ActorState.RESTARTING
+            state.ready.clear()
+            state.instance = None
+            # Hand queued work to the restarted incarnation and poison the old
+            # mailbox so consumers on the failed node stop (the reference
+            # replays in-flight actor tasks under max_task_retries).
+            old_mailbox = state.mailbox
+            state.mailbox = queue.Queue()
+            try:
+                while True:
+                    item = old_mailbox.get_nowait()
+                    if item is not None:
+                        state.mailbox.put(item)
+            except queue.Empty:
+                pass
+            old_mailbox.put(None)
+        self.emit_event("ACTOR_RESTART", actor=state.cls.__name__,
+                        attempt=state.restart_count)
+        delay = _config.get("actor_restart_delay_ms") / 1e3
+        timer = threading.Timer(
+            delay, lambda: self._util_pool.submit(
+                self._place_and_start_actor, state, True))
+        timer.daemon = True
+        timer.start()
+
+    def get_named_actor(self, name: str, namespace: str = "default"):
+        with self.lock:
+            actor_id = self.named_actors.get((namespace, name))
+            if actor_id is None:
+                raise ValueError(f"no actor named {name!r} in namespace "
+                                 f"{namespace!r}")
+            return self.actors[actor_id]
+
+    # ------------------------------------------------------------ placement
+
+    def create_placement_group(self, bundles: List[ResourceSet], strategy: str,
+                               name: str = "") -> PlacementGroupState:
+        pg = PlacementGroupState(PlacementGroupID.from_random(), bundles,
+                                 strategy, name)
+        with self.lock:
+            self.placement_groups[pg.pg_id] = pg
+        self._util_pool.submit(self._place_pg, pg)
+        return pg
+
+    def _place_pg(self, pg: PlacementGroupState):
+        deadline = time.monotonic() + _config.get("worker_lease_timeout_s")
+        while time.monotonic() < deadline:
+            with self.lock:
+                states = [self.nodes[nid].state() for nid in self._node_order]
+                assignment = schedule_bundles(states, pg.bundles, pg.strategy)
+                if assignment is not None:
+                    for i, nid in enumerate(assignment):
+                        node = self.nodes[nid]
+                        node.resources.allocate(pg.bundles[i])
+                        node.bundles[(pg.pg_id, i)] = NodeResources(pg.bundles[i])
+                    pg.bundle_nodes = assignment
+                    pg.state = "CREATED"
+                    pg.ready.set()
+                    self._kick()
+                    return
+            time.sleep(0.01)
+        pg.state = "INFEASIBLE"
+        pg.ready.set()  # wake waiters; they must check pg.state
+
+    def remove_placement_group(self, pg_id: PlacementGroupID):
+        with self.lock:
+            pg = self.placement_groups.pop(pg_id, None)
+            if pg is None or pg.bundle_nodes is None:
+                return
+            for i, nid in enumerate(pg.bundle_nodes):
+                node = self.nodes.get(nid)
+                if node is None:
+                    continue
+                node.bundles.pop((pg_id, i), None)
+                if node.alive:
+                    node.resources.release(pg.bundles[i])
+        self._kick()
+
+    # ------------------------------------------------------------------ misc
+
+    def offload(self, fn: Callable):
+        self._util_pool.submit(fn)
+
+    def emit_event(self, kind: str, **fields):
+        ev = {"ts": time.time(), "kind": kind, **fields}
+        self._events.append(ev)
+        if len(self._events) > 100000:
+            del self._events[:50000]
+
+    def events(self) -> List[dict]:
+        return list(self._events)
+
+    def shutdown(self):
+        self._shutdown = True
+        self._kick()
+        for state in list(self.actors.values()):
+            if state.status != ActorState.DEAD:
+                self._mark_actor_dead(state, exc.ActorDiedError("shutdown"))
+        for node in self.nodes.values():
+            node.shutdown()
+        self._util_pool.shutdown(wait=False, cancel_futures=True)
+
+
+# -- helpers -----------------------------------------------------------------
+
+
+def _ref_ids_in(args, kwargs) -> List[ObjectID]:
+    from ray_tpu.object_ref import ObjectRef
+    out = []
+    for a in list(args) + list(kwargs.values()):
+        if isinstance(a, ObjectRef):
+            out.append(a.id())
+    return out
+
+
+def _resolve_refs(obj, runtime: Runtime):
+    """Replace top-level ObjectRefs in args with their values (reference
+    semantics: refs in args are resolved, nested refs are passed through)."""
+    from ray_tpu.object_ref import ObjectRef
+    if isinstance(obj, ObjectRef):
+        return runtime.get_object(obj.id())
+    if isinstance(obj, tuple):
+        return tuple(_resolve_refs(a, runtime) if isinstance(a, ObjectRef)
+                     else a for a in obj)
+    if isinstance(obj, list):
+        return [_resolve_refs(a, runtime) if isinstance(a, ObjectRef)
+                else a for a in obj]
+    if isinstance(obj, dict):
+        return {k: (_resolve_refs(v, runtime) if isinstance(v, ObjectRef)
+                    else v) for k, v in obj.items()}
+    return obj

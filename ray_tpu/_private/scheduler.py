@@ -1,0 +1,166 @@
+"""Cluster scheduling policies.
+
+Parity with the reference's pluggable policy library
+(``src/ray/raylet/scheduling/policy/``):
+
+- ``HybridPolicy`` — the default: pack onto the first (local-preferred) nodes
+  until a utilization threshold, then spread; randomized top-k pick
+  (``hybrid_scheduling_policy.h:48``).
+- ``SpreadPolicy`` — round-robin over feasible nodes
+  (``spread_scheduling_policy.h:27``).
+- ``NodeAffinityPolicy`` — hard/soft pinning to one node
+  (``node_affinity_scheduling_policy.h:29``).
+- Bundle policies for placement groups: PACK / SPREAD / STRICT_PACK /
+  STRICT_SPREAD (``bundle_scheduling_policy.h:73-97``).
+
+All policies are pure functions over a snapshot of node states so they are
+shared by the cluster scheduler and the placement-group manager, like the
+reference shares them between raylet and GCS.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu._private.config import _config
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.resources import NodeResources, ResourceSet
+
+
+class NodeState:
+    """Scheduler-visible view of one node."""
+
+    def __init__(self, node_id: NodeID, resources: NodeResources, alive: bool = True):
+        self.node_id = node_id
+        self.resources = resources
+        self.alive = alive
+
+
+class Infeasible(Exception):
+    """No node in the cluster could ever satisfy the request."""
+
+
+class HybridPolicy:
+    """Pack-then-spread with top-k randomization."""
+
+    def __init__(self, spread_threshold: Optional[float] = None,
+                 top_k_fraction: Optional[float] = None, seed: Optional[int] = None):
+        self.spread_threshold = spread_threshold
+        self.top_k_fraction = top_k_fraction
+        self._rng = random.Random(seed)
+
+    def select(self, nodes: Sequence[NodeState], request: ResourceSet,
+               preferred: Optional[NodeID] = None) -> Optional[NodeID]:
+        threshold = (self.spread_threshold if self.spread_threshold is not None
+                     else _config.get("scheduler_spread_threshold"))
+        top_k_frac = (self.top_k_fraction if self.top_k_fraction is not None
+                      else _config.get("scheduler_top_k_fraction"))
+        scored: List[Tuple[float, int, NodeID]] = []
+        for i, n in enumerate(nodes):
+            if not n.alive or not n.resources.can_fit(request):
+                continue
+            util = n.resources.utilization()
+            # Below threshold: score 0 (pack anywhere cheap); above: score by
+            # utilization so lighter nodes win (spread).
+            score = 0.0 if util < threshold else util
+            is_preferred = 0 if (preferred is not None and n.node_id == preferred) else 1
+            scored.append((score, is_preferred, i, n.node_id))
+        if not scored:
+            return None
+        scored.sort(key=lambda t: (t[0], t[1], t[2]))
+        k = max(1, int(len(scored) * top_k_frac))
+        return self._rng.choice(scored[:k])[3]
+
+
+class SpreadPolicy:
+    def __init__(self):
+        self._next = 0
+        self._lock = threading.Lock()
+
+    def select(self, nodes: Sequence[NodeState], request: ResourceSet,
+               preferred: Optional[NodeID] = None) -> Optional[NodeID]:
+        feasible = [n for n in nodes if n.alive and n.resources.can_fit(request)]
+        if not feasible:
+            return None
+        with self._lock:
+            choice = feasible[self._next % len(feasible)]
+            self._next += 1
+        return choice.node_id
+
+
+class NodeAffinityPolicy:
+    def select(self, nodes: Sequence[NodeState], request: ResourceSet,
+               node_id_hex: str = "", soft: bool = False) -> Optional[NodeID]:
+        target = None
+        for n in nodes:
+            if n.node_id.hex() == node_id_hex:
+                target = n
+                break
+        if target is not None and target.alive:
+            if target.resources.can_fit(request):
+                return target.node_id
+            if target.resources.could_ever_fit(request):
+                return None  # node is busy: wait, don't fail (reference
+                # semantics fail hard affinity only when the node is gone)
+        if not soft:
+            raise Infeasible(f"node {node_id_hex} unavailable for hard affinity")
+        return HybridPolicy().select(nodes, request)
+
+
+# -- bundle (placement group) policies ---------------------------------------
+
+
+def _bin_pack(nodes: List[NodeState], bundles: Sequence[ResourceSet],
+              distinct: bool, minimize_nodes: bool) -> Optional[List[NodeID]]:
+    """Greedy bundle placement over a copy of node availability."""
+    avail: Dict[NodeID, ResourceSet] = {
+        n.node_id: n.resources.available for n in nodes if n.alive}
+    used_nodes: List[NodeID] = []
+    placement: List[NodeID] = []
+    order = sorted(range(len(bundles)),
+                   key=lambda i: -sum(bundles[i].to_dict().values()))
+    slots: List[Optional[NodeID]] = [None] * len(bundles)
+    for i in order:
+        b = bundles[i]
+        candidates = []
+        for nid, a in avail.items():
+            if distinct and nid in used_nodes:
+                continue
+            if b.is_subset_of(a):
+                candidates.append(nid)
+        if not candidates:
+            return None
+        if minimize_nodes:
+            # Prefer nodes already holding a bundle (PACK), then most-loaded.
+            candidates.sort(key=lambda nid: (nid not in used_nodes,))
+        else:
+            # SPREAD: prefer nodes not yet holding a bundle.
+            candidates.sort(key=lambda nid: (nid in used_nodes,))
+        chosen = candidates[0]
+        avail[chosen] = avail[chosen].subtract(b)
+        if chosen not in used_nodes:
+            used_nodes.append(chosen)
+        slots[i] = chosen
+    return slots  # type: ignore[return-value]
+
+
+def schedule_bundles(nodes: List[NodeState], bundles: Sequence[ResourceSet],
+                     strategy: str) -> Optional[List[NodeID]]:
+    """Return one NodeID per bundle, or None if unplaceable now."""
+    if strategy == "STRICT_PACK":
+        total = ResourceSet()
+        for b in bundles:
+            total = total.add(b)
+        for n in nodes:
+            if n.alive and n.resources.can_fit(total):
+                return [n.node_id] * len(bundles)
+        return None
+    if strategy == "STRICT_SPREAD":
+        return _bin_pack(nodes, bundles, distinct=True, minimize_nodes=False)
+    if strategy == "PACK":
+        return _bin_pack(nodes, bundles, distinct=False, minimize_nodes=True)
+    if strategy == "SPREAD":
+        return _bin_pack(nodes, bundles, distinct=False, minimize_nodes=False)
+    raise ValueError(f"unknown placement strategy {strategy}")

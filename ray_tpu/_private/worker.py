@@ -1,0 +1,204 @@
+"""Global worker: the public ``init/get/put/wait/kill/cancel`` surface.
+
+Parity with ``python/ray/_private/worker.py`` (``ray.init`` :1003, ``ray.get``
+:2162, ``ray.put`` :2276, ``ray.wait`` :2331, ``ray.shutdown`` :1529).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private.config import _config
+from ray_tpu._private.ids import JobID, TaskID
+from ray_tpu._private.resources import (CPU, TPU, ResourceSet)
+from ray_tpu._private.runtime import Runtime, task_context
+from ray_tpu.object_ref import ObjectRef
+
+_global_lock = threading.Lock()
+_global = None  # type: Optional["Worker"]
+
+
+class Worker:
+    def __init__(self, runtime: Runtime, namespace: str):
+        self.runtime = runtime
+        self.namespace = namespace
+        self.driver_task_id = TaskID.for_task(runtime.job_id)
+
+
+def _detect_num_tpus() -> int:
+    """TPU autodetection from the live jax backend — replaces the reference's
+    nvidia-smi/GPUtil probing (``resource_spec.py:273-310``)."""
+    try:
+        import jax
+        return len([d for d in jax.devices() if d.platform == "tpu"])
+    except Exception:
+        return 0
+
+
+def init(num_cpus: Optional[float] = None,
+         num_tpus: Optional[float] = None,
+         resources: Optional[Dict[str, float]] = None,
+         namespace: Optional[str] = None,
+         ignore_reinit_error: bool = False,
+         _system_config: Optional[dict] = None,
+         _create_default_node: bool = True,
+         **kwargs) -> "Worker":
+    """Start the runtime (one device-owner process per host)."""
+    global _global
+    with _global_lock:
+        if _global is not None:
+            if ignore_reinit_error:
+                return _global
+            raise RuntimeError("ray_tpu.init() called twice; pass "
+                               "ignore_reinit_error=True to ignore")
+        _config.apply_system_config(_system_config)
+        runtime = Runtime()
+        if _create_default_node:
+            amounts: Dict[str, float] = {
+                CPU: num_cpus if num_cpus is not None else float(os.cpu_count() or 1),
+            }
+            detected_tpus = _detect_num_tpus()
+            n_tpus = num_tpus if num_tpus is not None else detected_tpus
+            if n_tpus:
+                amounts[TPU] = n_tpus
+            if resources:
+                amounts.update(resources)
+            runtime.add_node(ResourceSet(amounts))
+        _global = Worker(runtime, namespace or "default")
+        return _global
+
+
+def shutdown():
+    global _global
+    with _global_lock:
+        if _global is not None:
+            _global.runtime.shutdown()
+            _global = None
+
+
+def is_initialized() -> bool:
+    return _global is not None
+
+
+def global_worker() -> Worker:
+    if _global is None:
+        init()
+    return _global  # type: ignore[return-value]
+
+
+def try_global_runtime() -> Optional[Runtime]:
+    return _global.runtime if _global is not None else None
+
+
+def current_task_id() -> TaskID:
+    tid = task_context.task_id
+    if tid is not None:
+        return tid
+    return global_worker().driver_task_id
+
+
+def put(value: Any) -> ObjectRef:
+    if isinstance(value, ObjectRef):
+        raise TypeError("put() of an ObjectRef is not allowed")
+    w = global_worker()
+    oid = w.runtime.put_object(value)
+    return ObjectRef(oid, owner=w.runtime)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None) -> Any:
+    w = global_worker()
+    if isinstance(refs, ObjectRef):
+        return w.runtime.get_object(refs.id(), timeout=timeout)
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError(f"get() expects ObjectRef or list, got {type(refs)}")
+    deadline = None if timeout is None else time.monotonic() + timeout
+    out = []
+    for r in refs:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"get() list items must be ObjectRef, got {type(r)}")
+        remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+        out.append(w.runtime.get_object(r.id(), timeout=remaining))
+    return out
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None,
+         fetch_local: bool = True) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    """Parity with ``ray.wait`` (worker.py:2331): returns (ready, not_ready)
+    preserving input order, blocking until ``num_returns`` ready or timeout."""
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    refs = list(refs)
+    if len(set(refs)) != len(refs):
+        raise ValueError("wait() got duplicate ObjectRefs")
+    if num_returns > len(refs):
+        raise ValueError("num_returns exceeds number of refs")
+    w = global_worker()
+    deadline = None if timeout is None else time.monotonic() + timeout
+    while True:
+        ready = [r for r in refs if w.runtime.object_ready(r.id())]
+        if len(ready) >= num_returns or (
+                deadline is not None and time.monotonic() >= deadline):
+            # Return at most num_returns ready refs (ray.wait contract).
+            ready_set = set(ready[:num_returns])
+            ready_list = [r for r in refs if r in ready_set]
+            not_ready = [r for r in refs if r not in ready_set]
+            return ready_list, not_ready
+        time.sleep(0.001)
+
+
+def kill(actor, *, no_restart: bool = True):
+    from ray_tpu.actor import ActorHandle
+    if not isinstance(actor, ActorHandle):
+        raise TypeError("kill() expects an ActorHandle; use cancel() for tasks")
+    global_worker().runtime.kill_actor(actor._actor_id, no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    if not isinstance(ref, ObjectRef):
+        raise TypeError("cancel() expects an ObjectRef")
+    global_worker().runtime.cancel_task(ref.task_id(), force=force)
+
+
+def get_actor(name: str, namespace: Optional[str] = None):
+    from ray_tpu.actor import ActorHandle
+    w = global_worker()
+    state = w.runtime.get_named_actor(name, namespace or w.namespace)
+    return ActorHandle._from_state(state)
+
+
+def available_resources() -> Dict[str, float]:
+    w = global_worker()
+    total: Dict[str, float] = {}
+    for ns in w.runtime.node_states():
+        if not ns.alive:
+            continue
+        for k, v in ns.resources.available.to_dict().items():
+            total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def cluster_resources() -> Dict[str, float]:
+    w = global_worker()
+    total: Dict[str, float] = {}
+    for ns in w.runtime.node_states():
+        if not ns.alive:
+            continue
+        for k, v in ns.resources.total.to_dict().items():
+            total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def nodes() -> List[dict]:
+    w = global_worker()
+    return [{
+        "NodeID": ns.node_id.hex(),
+        "Alive": ns.alive,
+        "Resources": ns.resources.total.to_dict(),
+        "Available": ns.resources.available.to_dict(),
+    } for ns in w.runtime.node_states()]

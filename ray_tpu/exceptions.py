@@ -1,0 +1,78 @@
+"""User-facing error types.
+
+Parity with ``python/ray/exceptions.py`` in the reference: task errors wrap
+the remote traceback and re-raise at ``get``; actor/object/node failures have
+dedicated types so retry logic can discriminate.
+"""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A remote task raised an exception; re-raised at ``get``.
+
+    Mirrors ``RayTaskError`` (reference ``python/ray/exceptions.py``): carries
+    the remote traceback string and the original cause.
+    """
+
+    def __init__(self, function_name: str, cause: BaseException,
+                 remote_traceback: str = ""):
+        self.function_name = function_name
+        self.cause = cause
+        self.remote_traceback = remote_traceback or "".join(
+            traceback.format_exception(type(cause), cause, cause.__traceback__))
+        super().__init__(
+            f"task {function_name} failed: {type(cause).__name__}: {cause}\n"
+            f"{self.remote_traceback}")
+
+
+class ActorError(RayTpuError):
+    pass
+
+
+class ActorDiedError(ActorError):
+    """The actor is dead (killed, crashed past max_restarts, or owner exited)."""
+
+
+class ActorUnavailableError(ActorError):
+    """The actor is temporarily unavailable (restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    """Object was evicted/lost and could not be reconstructed from lineage."""
+
+
+class ObjectReconstructionFailedError(ObjectLostError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class TaskCancelledError(RayTpuError):
+    def __init__(self, task_id=None):
+        self.task_id = task_id
+        super().__init__(f"task {task_id} was cancelled")
+
+
+class WorkerCrashedError(RayTpuError):
+    pass
+
+
+class NodeDiedError(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class PlacementGroupSchedulingError(RayTpuError):
+    pass

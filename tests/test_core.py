@@ -1,0 +1,209 @@
+"""Core API: tasks, get/put/wait, errors, retries, cancellation.
+
+Models the reference's ``python/ray/tests/test_basic*.py`` coverage.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put({"a": 1, "b": [1, 2, 3]})
+    assert ray_tpu.get(ref) == {"a": 1, "b": [1, 2, 3]}
+
+
+def test_put_get_numpy_zero_copy(ray_start_regular):
+    x = np.arange(100, dtype=np.float32)
+    ref = ray_tpu.put(x)
+    y = ray_tpu.get(ref)
+    np.testing.assert_array_equal(x, y)
+    assert not y.flags.writeable  # immutability, plasma-style
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_ref_args(ray_start_regular):
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    r1 = double.remote(10)
+    r2 = double.remote(r1)
+    r3 = double.remote(r2)
+    assert ray_tpu.get(r3) == 80
+
+
+def test_task_kwargs_and_options(ray_start_regular):
+    @ray_tpu.remote
+    def f(a, b=10):
+        return a + b
+
+    assert ray_tpu.get(f.options(num_cpus=0.5).remote(1, b=2)) == 3
+
+
+def test_num_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("bad")
+
+    with pytest.raises(ray_tpu.TaskError) as e:
+        ray_tpu.get(boom.remote())
+    assert "bad" in str(e.value)
+
+
+def test_error_propagates_through_dependencies(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("root cause")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(ray_tpu.TaskError) as e:
+        ray_tpu.get(consume.remote(boom.remote()))
+    assert "root cause" in str(e.value)
+
+
+def test_retry_on_exception(ray_start_regular):
+    counter = {"n": 0}
+    lock = threading.Lock()
+
+    @ray_tpu.remote(max_retries=3, retry_exceptions=True)
+    def flaky():
+        with lock:
+            counter["n"] += 1
+            n = counter["n"]
+        if n < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert ray_tpu.get(flaky.remote()) == "ok"
+    assert counter["n"] == 3
+
+
+def test_no_retry_by_default_on_app_error(ray_start_regular):
+    counter = {"n": 0}
+
+    @ray_tpu.remote
+    def fail_once():
+        counter["n"] += 1
+        raise RuntimeError("app error")
+
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(fail_once.remote())
+    assert counter["n"] == 1
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def slow(t):
+        time.sleep(t)
+        return t
+
+    fast = slow.remote(0.01)
+    slower = slow.remote(5.0)
+    ready, not_ready = ray_tpu.wait([fast, slower], num_returns=1, timeout=3)
+    assert ready == [fast]
+    assert not_ready == [slower]
+
+
+def test_wait_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def forever():
+        time.sleep(60)
+
+    r = forever.remote()
+    t0 = time.monotonic()
+    ready, not_ready = ray_tpu.wait([r], num_returns=1, timeout=0.2)
+    assert time.monotonic() - t0 < 2
+    assert ready == [] and not_ready == [r]
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def forever():
+        time.sleep(60)
+
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        ray_tpu.get(forever.remote(), timeout=0.2)
+
+
+def test_cancel_pending_task(ray_start_regular):
+    @ray_tpu.remote(num_cpus=8)
+    def hog():
+        time.sleep(10)
+
+    @ray_tpu.remote(num_cpus=8)
+    def queued():
+        return 1
+
+    h = hog.remote()
+    q = queued.remote()  # cannot start: resources taken
+    time.sleep(0.1)
+    ray_tpu.cancel(q)
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(q, timeout=5)
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(10)) == 21
+
+
+def test_many_tasks_throughput(ray_start_regular):
+    @ray_tpu.remote(num_cpus=0.01)
+    def f(i):
+        return i
+
+    refs = [f.remote(i) for i in range(500)]
+    assert ray_tpu.get(refs) == list(range(500))
+
+
+def test_cluster_resources(ray_start_regular):
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] == 8
+
+
+def test_fractional_resources(ray_start_regular):
+    @ray_tpu.remote(num_cpus=0.5)
+    def half():
+        return 1
+
+    assert sum(ray_tpu.get([half.remote() for _ in range(16)])) == 16
+
+
+def test_object_ref_serializable_in_task(ray_start_regular):
+    @ray_tpu.remote
+    def make():
+        return ray_tpu.put(42)
+
+    inner_ref = ray_tpu.get(make.remote())
+    assert ray_tpu.get(inner_ref) == 42

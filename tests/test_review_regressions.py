@@ -1,0 +1,89 @@
+"""Regression tests for review findings on the core runtime."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.placement_group import placement_group
+
+
+def test_wait_returns_at_most_num_returns(ray_start_regular):
+    @ray_tpu.remote
+    def f(i):
+        return i
+
+    refs = [f.remote(i) for i in range(3)]
+    ray_tpu.get(refs)  # all done
+    ready, not_ready = ray_tpu.wait(refs, num_returns=1)
+    assert len(ready) == 1 and len(not_ready) == 2
+
+
+def test_infeasible_placement_group_wait_returns_false(ray_start_regular):
+    pg = placement_group([{"CPU": 10000}], strategy="PACK")
+    assert pg.wait(2) is False
+
+
+def test_actor_restart_releases_resources(ray_start_regular):
+    """A restarting actor must not leak its old allocation (the node only
+    has capacity for one incarnation)."""
+    @ray_tpu.remote(num_cpus=8, max_restarts=2)
+    class Big:
+        def ping(self):
+            return "pong"
+
+    a = Big.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=10) == "pong"
+    ray_tpu.kill(a, no_restart=False)
+    time.sleep(0.3)
+    assert ray_tpu.get(a.ping.remote(), timeout=10) == "pong"
+
+
+def test_kill_with_restart_on_infinite_restarts(ray_start_regular):
+    @ray_tpu.remote(max_restarts=-1)
+    class Eternal:
+        def ping(self):
+            return 1
+
+    a = Eternal.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=10) == 1
+    ray_tpu.kill(a, no_restart=False)
+    time.sleep(0.3)
+    assert ray_tpu.get(a.ping.remote(), timeout=10) == 1
+
+
+def test_hard_affinity_waits_for_busy_node(ray_start_cluster):
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+    cluster = ray_start_cluster
+    node = cluster.add_node(num_cpus=1)
+
+    @ray_tpu.remote(num_cpus=1)
+    def busy():
+        time.sleep(0.3)
+        return "first"
+
+    @ray_tpu.remote(num_cpus=1)
+    def queued():
+        return "second"
+
+    strat = NodeAffinitySchedulingStrategy(node_id=node.node_id.hex(), soft=False)
+    r1 = busy.options(scheduling_strategy=strat).remote()
+    r2 = queued.options(scheduling_strategy=strat).remote()
+    assert ray_tpu.get([r1, r2], timeout=10) == ["first", "second"]
+
+
+def test_concurrent_driver_puts_unique(ray_start_regular):
+    results = {}
+
+    def do_puts(tag):
+        refs = [ray_tpu.put((tag, i)) for i in range(50)]
+        results[tag] = ray_tpu.get(refs)
+
+    threads = [threading.Thread(target=do_puts, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for tag in range(4):
+        assert results[tag] == [(tag, i) for i in range(50)]

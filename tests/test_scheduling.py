@@ -1,0 +1,202 @@
+"""Multi-node scheduling, placement groups, node failure, lineage recovery.
+
+Models ``python/ray/tests/test_placement_group*.py``, ``test_multi_node*.py``,
+``test_chaos.py`` coverage on the in-process Cluster.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.placement_group import (placement_group,
+                                          placement_group_table,
+                                          remove_placement_group)
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy, PlacementGroupSchedulingStrategy)
+
+
+def test_spread_scheduling(ray_start_cluster):
+    cluster = ray_start_cluster
+    for _ in range(4):
+        cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote(scheduling_strategy="SPREAD")
+    def where():
+        return ray_tpu.get_runtime_context().node_id.hex()
+
+    nodes = set(ray_tpu.get([where.remote() for _ in range(16)]))
+    assert len(nodes) >= 3, f"SPREAD should use most nodes, got {nodes}"
+
+
+def test_node_affinity(ray_start_cluster):
+    cluster = ray_start_cluster
+    n1 = cluster.add_node(num_cpus=2)
+    n2 = cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote
+    def where():
+        return ray_tpu.get_runtime_context().node_id.hex()
+
+    target = n2.node_id.hex()
+    strategy = NodeAffinitySchedulingStrategy(node_id=target, soft=False)
+    got = ray_tpu.get([where.options(scheduling_strategy=strategy).remote()
+                       for _ in range(5)])
+    assert all(g == target for g in got)
+
+
+def test_custom_resources(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2, resources={"special": 2})
+
+    @ray_tpu.remote(resources={"special": 1})
+    def needs_special():
+        return ray_tpu.get_runtime_context().node_id.hex()
+
+    special_node = cluster._nodes[1].node_id.hex()
+    assert ray_tpu.get(needs_special.remote()) == special_node
+
+
+def test_infeasible_task_errors(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1)
+
+    @ray_tpu.remote(num_cpus=64)
+    def impossible():
+        return 1
+
+    with pytest.raises(ray_tpu.RayTpuError):
+        ray_tpu.get(impossible.remote(), timeout=10)
+
+
+def test_placement_group_strict_spread(ray_start_cluster):
+    cluster = ray_start_cluster
+    for _ in range(3):
+        cluster.add_node(num_cpus=2)
+    pg = placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert pg.wait(10)
+    table = placement_group_table()[pg.id.hex()]
+    assert table["state"] == "CREATED"
+    assert len(set(table["bundle_nodes"])) == 3
+
+
+def test_placement_group_strict_pack(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=4)
+    cluster.add_node(num_cpus=4)
+    pg = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="STRICT_PACK")
+    assert pg.wait(10)
+    table = placement_group_table()[pg.id.hex()]
+    assert len(set(table["bundle_nodes"])) == 1
+
+
+def test_task_in_placement_group(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.wait(10)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        return ray_tpu.get_runtime_context().node_id.hex()
+
+    strategy = PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=0)
+    n0 = ray_tpu.get(where.options(scheduling_strategy=strategy).remote())
+    strategy1 = PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=1)
+    n1 = ray_tpu.get(where.options(scheduling_strategy=strategy1).remote())
+    table = placement_group_table()[pg.id.hex()]
+    assert [n0, n1] == table["bundle_nodes"]
+
+
+def test_remove_placement_group_releases_resources(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.wait(10)
+    assert ray_tpu.available_resources().get("CPU", 0) == 0
+    remove_placement_group(pg)
+    time.sleep(0.1)
+    assert ray_tpu.available_resources().get("CPU", 0) == 2
+
+
+def test_actor_in_placement_group(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.wait(10)
+
+    @ray_tpu.remote(num_cpus=1)
+    class Pinned:
+        def where(self):
+            return ray_tpu.get_runtime_context().node_id.hex()
+
+    strategy = PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=1)
+    a = Pinned.options(scheduling_strategy=strategy).remote()
+    loc = ray_tpu.get(a.where.remote())
+    assert loc == placement_group_table()[pg.id.hex()]["bundle_nodes"][1]
+
+
+def test_node_failure_kills_actors(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    victim = cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote(num_cpus=2)
+    class Pinned:
+        def ping(self):
+            return "pong"
+
+    strategy = NodeAffinitySchedulingStrategy(
+        node_id=victim.node_id.hex(), soft=False)
+    a = Pinned.options(scheduling_strategy=strategy).remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+    cluster.remove_node(victim)
+    time.sleep(0.2)
+    with pytest.raises(ray_tpu.ActorDiedError):
+        ray_tpu.get(a.ping.remote(), timeout=5)
+
+
+def test_lineage_reconstruction_on_node_loss(ray_start_cluster):
+    """Objects lost with their node are recomputed from lineage
+    (reference: ObjectRecoveryManager, test_chaos.py)."""
+    cluster = ray_start_cluster
+    stable = cluster.add_node(num_cpus=2)
+    victim = cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote(max_retries=2)
+    def produce():
+        return list(range(1000))
+
+    strategy = NodeAffinitySchedulingStrategy(
+        node_id=victim.node_id.hex(), soft=False)
+    ref = produce.options(scheduling_strategy=strategy).remote()
+    assert len(ray_tpu.get(ref)) == 1000
+    cluster.remove_node(victim)
+    # Object is gone with the node; get() must reconstruct via lineage.
+    assert len(ray_tpu.get(ref, timeout=15)) == 1000
+
+
+def test_actor_restart_after_node_death(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    victim = cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote(max_restarts=1, num_cpus=1)
+    class Survivor:
+        def ping(self):
+            return ray_tpu.get_runtime_context().node_id.hex()
+
+    strategy = NodeAffinitySchedulingStrategy(
+        node_id=victim.node_id.hex(), soft=True)
+    a = Survivor.options(scheduling_strategy=strategy).remote()
+    first = ray_tpu.get(a.ping.remote())
+    cluster.remove_node(victim)
+    time.sleep(0.5)
+    second = ray_tpu.get(a.ping.remote(), timeout=10)
+    assert second != first or first != victim.node_id.hex()
