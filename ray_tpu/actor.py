@@ -89,7 +89,7 @@ class ActorHandle:
         return cls(state.actor_id, state.cls.__name__)
 
     def __getattr__(self, name: str) -> ActorMethod:
-        if name.startswith("_"):
+        if name.startswith("_") and not name.startswith("__ray"):
             raise AttributeError(name)
         return ActorMethod(self, name)
 
@@ -182,6 +182,14 @@ class ActorClass:
 def _inject_builtin_methods(cls: type) -> type:
     if not hasattr(cls, "__ray_ready__"):
         cls.__ray_ready__ = lambda self: True
+    if not hasattr(cls, "__ray_collective_init__"):
+        def _collective_init(self, world_size, rank, backend, group_name,
+                             devices=None):
+            from ray_tpu.collective import init_collective_group
+            init_collective_group(world_size, rank, backend, group_name,
+                                  devices)
+            return rank
+        cls.__ray_collective_init__ = _collective_init
     if not hasattr(cls, "__ray_terminate__"):
         def _terminate(self):
             from ray_tpu._private import worker as _worker

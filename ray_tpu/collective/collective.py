@@ -1,0 +1,184 @@
+"""Collective communication API.
+
+Parity with ``python/ray/util/collective/collective.py``: the same group API
+(``init_collective_group`` :120, ``create_collective_group`` :151-212,
+``allreduce`` :258, ``barrier`` :298, ``reduce`` :311, ``broadcast`` :373,
+``allgather`` :423, ``reducescatter`` :472, ``send/recv`` :531,594,
+``destroy_collective_group`` :216) with backends ``xla`` (ICI-compiled
+collectives) and ``cpu`` (numpy). Group state lives in a process-global
+registry — the host-granular analogue of the reference's per-process
+``GroupManager`` + named-``Info``-actor rendezvous (``collective.py:40-112``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.collective.collective_group.cpu_group import (CPUGroup,
+                                                           CPUGroupShared)
+from ray_tpu.collective.collective_group.xla_group import (XLAGroup,
+                                                           XLAGroupShared)
+from ray_tpu.collective.types import Backend, ReduceOp
+
+_registry_lock = threading.Lock()
+_shared_groups: Dict[str, Any] = {}        # group_name -> Shared state
+_local_groups = threading.local()          # per-caller rank-bound groups
+
+
+class GroupManager:
+    """Per-caller map of group_name -> rank-bound group object."""
+
+    @staticmethod
+    def _groups() -> Dict[str, Any]:
+        if not hasattr(_local_groups, "groups"):
+            _local_groups.groups = {}
+        return _local_groups.groups
+
+    @classmethod
+    def create_group(cls, backend: str, world_size: int, rank: int,
+                     group_name: str, devices: Optional[List] = None):
+        backend = Backend(backend)
+        with _registry_lock:
+            shared = _shared_groups.get(group_name)
+            if shared is None:
+                if backend == Backend.XLA:
+                    shared = XLAGroupShared(world_size, devices)
+                else:
+                    shared = CPUGroupShared(world_size, devices)
+                shared.join_count = 0
+                _shared_groups[group_name] = shared
+            else:
+                if shared.world_size != world_size:
+                    raise ValueError(
+                        f"group {group_name!r} exists with world_size="
+                        f"{shared.world_size}, requested {world_size}")
+                existing_backend = (Backend.XLA
+                                    if isinstance(shared, XLAGroupShared)
+                                    else Backend.CPU)
+                if existing_backend != backend:
+                    raise ValueError(
+                        f"group {group_name!r} exists with backend "
+                        f"{existing_backend!r}, requested {backend!r}")
+            shared.join_count += 1
+        group_cls = XLAGroup if isinstance(shared, XLAGroupShared) else CPUGroup
+        g = group_cls(world_size, rank, group_name, shared)
+        cls._groups()[group_name] = g
+        return g
+
+    @classmethod
+    def get_group(cls, group_name: str):
+        return cls._groups().get(group_name)
+
+    @classmethod
+    def destroy_group(cls, group_name: str):
+        """Detach this caller; shared state is freed when the last rank
+        leaves (a single rank's destroy must not split the group)."""
+        g = cls._groups().pop(group_name, None)
+        if g is None:
+            return
+        g.destroy()
+        with _registry_lock:
+            shared = _shared_groups.get(group_name)
+            if shared is g._shared:
+                shared.join_count -= 1
+                if shared.join_count <= 0:
+                    _shared_groups.pop(group_name, None)
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return GroupManager.get_group(group_name) is not None
+
+
+def init_collective_group(world_size: int, rank: int, backend: str = "xla",
+                          group_name: str = "default",
+                          devices: Optional[List] = None):
+    """Join a collective group from inside an actor/task (collective.py:120)."""
+    if world_size <= 0 or not (0 <= rank < world_size):
+        raise ValueError(f"invalid world_size={world_size} rank={rank}")
+    if is_group_initialized(group_name):
+        raise RuntimeError(f"group {group_name!r} already initialized here")
+    return GroupManager.create_group(backend, world_size, rank, group_name,
+                                     devices)
+
+
+def create_collective_group(actors: List, world_size: int,
+                            ranks: List[int], backend: str = "xla",
+                            group_name: str = "default",
+                            devices: Optional[List] = None):
+    """Driver-side declarative setup (collective.py:151-212): instructs each
+    actor to join the group with its assigned rank."""
+    from ray_tpu._private import worker as _worker
+    if len(actors) != world_size or sorted(ranks) != list(range(world_size)):
+        raise ValueError("actors/ranks must cover 0..world_size-1")
+    refs = [actor.__ray_collective_init__.remote(world_size, rank, backend,
+                                                 group_name, devices)
+            for actor, rank in zip(actors, ranks)]
+    return _worker.get(refs)
+
+
+def destroy_collective_group(group_name: str = "default"):
+    GroupManager.destroy_group(group_name)
+
+
+def get_rank(group_name: str = "default") -> int:
+    g = GroupManager.get_group(group_name)
+    return g.rank if g is not None else -1
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    g = GroupManager.get_group(group_name)
+    return g.world_size if g is not None else -1
+
+
+def _group(group_name: str):
+    g = GroupManager.get_group(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} is not initialized in this "
+            "actor/task; call init_collective_group first")
+    return g
+
+
+def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    return _group(group_name).allreduce(tensor, op)
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
+           op: ReduceOp = ReduceOp.SUM):
+    return _group(group_name).reduce(tensor, dst_rank, op)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return _group(group_name).broadcast(tensor, src_rank)
+
+
+def allgather(tensor, group_name: str = "default"):
+    return _group(group_name).allgather(tensor)
+
+
+def reducescatter(tensor, group_name: str = "default",
+                  op: ReduceOp = ReduceOp.SUM):
+    return _group(group_name).reducescatter(tensor, op)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default"):
+    return _group(group_name).send(tensor, dst_rank)
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    return _group(group_name).recv(src_rank)
+
+
+def barrier(group_name: str = "default"):
+    return _group(group_name).barrier()
+
+
+def synchronize(group_name: str = "default"):
+    """Block until pending device work completes (the reference syncs CUDA
+    streams, ``collective.py:655``; XLA's analogue is draining dispatch)."""
+    try:
+        import jax
+        jax.effects_barrier()
+    except Exception:
+        pass

@@ -1,0 +1,112 @@
+"""CPU (numpy) collective group — the GLOO-role backend.
+
+Parity with ``python/ray/util/collective/collective_group/gloo_collective_group.py:184``:
+host-tensor collectives for CPU-only actors and tests, sharing the same
+rendezvous machinery as the XLA group but computing with numpy.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.collective.collective_group.xla_group import _Rendezvous
+from ray_tpu.collective.types import ReduceOp
+
+_NP_REDUCE = {
+    ReduceOp.SUM: lambda xs: np.sum(xs, axis=0),
+    ReduceOp.PRODUCT: lambda xs: np.prod(xs, axis=0),
+    ReduceOp.MIN: lambda xs: np.min(xs, axis=0),
+    ReduceOp.MAX: lambda xs: np.max(xs, axis=0),
+}
+
+
+class CPUGroupShared:
+    def __init__(self, world_size: int, devices: Optional[List] = None):
+        self.world_size = world_size
+        self._rdv = _Rendezvous(world_size)
+        self._p2p: Dict[tuple, _Rendezvous] = {}
+        import threading
+        self._p2p_lock = threading.Lock()
+
+    def collective(self, rank: int, tensor, op_desc: tuple) -> Dict[int, Any]:
+        arr = np.asarray(tensor)
+
+        def compute(slots):
+            kind = op_desc[0]
+            xs = np.stack([np.asarray(slots[r]) for r in range(self.world_size)])
+            if kind == "barrier":
+                return {r: None for r in range(self.world_size)}
+            if kind == "broadcast":
+                return {r: xs[op_desc[1]] for r in range(self.world_size)}
+            if kind == "allreduce":
+                red = _NP_REDUCE[op_desc[1]](xs)
+                return {r: red for r in range(self.world_size)}
+            if kind == "reduce":
+                red = _NP_REDUCE[op_desc[1]](xs)
+                return {r: (red if r == op_desc[2] else xs[r])
+                        for r in range(self.world_size)}
+            if kind == "allgather":
+                return {r: xs for r in range(self.world_size)}
+            if kind == "reducescatter":
+                red = _NP_REDUCE[op_desc[1]](xs)
+                chunks = np.split(red, self.world_size, axis=0)
+                return {r: chunks[r] for r in range(self.world_size)}
+            raise ValueError(kind)
+
+        return self._rdv.run(rank, arr, compute)
+
+    def _pair_rdv(self, src: int, dst: int) -> _Rendezvous:
+        with self._p2p_lock:
+            key = (src, dst)
+            if key not in self._p2p:
+                self._p2p[key] = _Rendezvous(2)
+            return self._p2p[key]
+
+    def p2p_send(self, rank: int, dst_rank: int, tensor):
+        rdv = self._pair_rdv(rank, dst_rank)
+        rdv.run(rank, np.asarray(tensor), lambda slots: slots[rank])
+
+    def p2p_recv(self, rank: int, src_rank: int):
+        rdv = self._pair_rdv(src_rank, rank)
+        return rdv.run(rank, None, lambda slots: slots[src_rank])
+
+
+class CPUGroup:
+    def __init__(self, world_size: int, rank: int, group_name: str,
+                 shared: CPUGroupShared):
+        self.world_size = world_size
+        self.rank = rank
+        self.group_name = group_name
+        self._shared = shared
+
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        return self._shared.collective(self.rank, tensor, ("allreduce", op))[self.rank]
+
+    def reduce(self, tensor, root_rank: int = 0, op: ReduceOp = ReduceOp.SUM):
+        return self._shared.collective(self.rank, tensor,
+                                       ("reduce", op, root_rank))[self.rank]
+
+    def broadcast(self, tensor, root_rank: int = 0):
+        return self._shared.collective(self.rank, tensor,
+                                       ("broadcast", root_rank))[self.rank]
+
+    def allgather(self, tensor):
+        return self._shared.collective(self.rank, tensor, ("allgather",))[self.rank]
+
+    def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        return self._shared.collective(self.rank, tensor,
+                                       ("reducescatter", op))[self.rank]
+
+    def barrier(self):
+        self._shared.collective(self.rank, np.zeros(()), ("barrier",))
+
+    def send(self, tensor, dst_rank: int):
+        self._shared.p2p_send(self.rank, dst_rank, tensor)
+
+    def recv(self, src_rank: int):
+        return self._shared.p2p_recv(self.rank, src_rank)
+
+    def destroy(self):
+        pass

@@ -1,0 +1,89 @@
+"""Collective types and options.
+
+Parity with ``python/ray/util/collective/types.py``: ``Backend`` and
+``ReduceOp`` enums plus per-op options dataclasses.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import List
+
+
+class Backend:
+    """Collective backend names. The reference supports NCCL/GLOO and rejects
+    MPI (``collective.py:59-60``); here the tensor plane is XLA — collectives
+    compile onto ICI — with a CPU (numpy) backend for host tensors and tests.
+    NCCL/GLOO names are accepted as aliases so reference code ports run."""
+
+    XLA = "xla"
+    CPU = "cpu"
+
+    _ALIASES = {"nccl": XLA, "gloo": CPU, "xla": XLA, "cpu": CPU}
+
+    def __new__(cls, name: str = "xla"):
+        backend = cls._ALIASES.get(str(name).lower())
+        if backend is None:
+            if str(name).lower() == "mpi":
+                raise ValueError("MPI backend is not supported")
+            raise ValueError(f"unknown collective backend {name!r}; "
+                             f"use 'xla' or 'cpu'")
+        return backend
+
+
+class ReduceOp(Enum):
+    SUM = 0
+    PRODUCT = 1
+    MIN = 2
+    MAX = 3
+
+
+unset_timeout_ms = 30000
+
+
+@dataclass
+class AllReduceOptions:
+    reduceOp: ReduceOp = ReduceOp.SUM
+    timeout_ms: int = unset_timeout_ms
+
+
+@dataclass
+class BarrierOptions:
+    timeout_ms: int = unset_timeout_ms
+
+
+@dataclass
+class ReduceOptions:
+    reduceOp: ReduceOp = ReduceOp.SUM
+    root_rank: int = 0
+    timeout_ms: int = unset_timeout_ms
+
+
+@dataclass
+class BroadcastOptions:
+    root_rank: int = 0
+    timeout_ms: int = unset_timeout_ms
+
+
+@dataclass
+class AllGatherOptions:
+    timeout_ms: int = unset_timeout_ms
+
+
+@dataclass
+class ReduceScatterOptions:
+    reduceOp: ReduceOp = ReduceOp.SUM
+    timeout_ms: int = unset_timeout_ms
+
+
+@dataclass
+class SendOptions:
+    dst_rank: int = 0
+    timeout_ms: int = unset_timeout_ms
+
+
+@dataclass
+class RecvOptions:
+    src_rank: int = 0
+    timeout_ms: int = unset_timeout_ms

@@ -1,0 +1,15 @@
+from ray_tpu.parallel.expert import moe_apply
+from ray_tpu.parallel.mesh import (AXIS_ORDER, MeshConfig, build_mesh,
+                                   single_axis_mesh)
+from ray_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from ray_tpu.parallel.sequence import ring_attention
+from ray_tpu.parallel.sharding import (DEFAULT_RULES, ShardingRules,
+                                       batch_sharding, replicated,
+                                       shard_pytree)
+
+__all__ = [
+    "MeshConfig", "build_mesh", "single_axis_mesh", "AXIS_ORDER",
+    "ShardingRules", "DEFAULT_RULES", "shard_pytree", "batch_sharding",
+    "replicated", "pipeline_apply", "stack_stage_params", "ring_attention",
+    "moe_apply",
+]

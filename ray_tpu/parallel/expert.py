@@ -1,0 +1,75 @@
+"""Expert parallelism: switch-style MoE with ``all_to_all`` dispatch.
+
+Experts shard over the ``expert`` mesh axis; tokens route to their expert's
+device via a single ``jax.lax.all_to_all`` (the EP pattern the reference has
+no analogue for — its parallelism stops at process-level DP, SURVEY §2.5).
+Top-1 (switch) routing with a capacity limit; dropped tokens pass through the
+residual path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def moe_apply(x: jax.Array, router_weights: jax.Array, expert_params: Any,
+              expert_fn: Callable, mesh: Mesh, axis: str = "expert",
+              capacity_factor: float = 1.25) -> jax.Array:
+    """x: [tokens, d_model] (replicated over ``axis``); router_weights:
+    [d_model, n_experts]; expert_params leaves have leading dim n_experts
+    (sharded over ``axis``). Returns [tokens, d_model]."""
+    n_exp_total = router_weights.shape[-1]
+    n_shards = mesh.shape[axis]
+    if n_exp_total % n_shards != 0:
+        raise ValueError(f"{n_exp_total} experts not divisible over "
+                         f"{n_shards} expert shards")
+    exp_per_shard = n_exp_total // n_shards
+
+    def per_device(x_loc, rw, params):
+        tokens, d = x_loc.shape
+        capacity = max(1, int(capacity_factor * tokens / n_exp_total))
+        gates = jax.nn.softmax(x_loc @ rw, axis=-1)            # [T, E]
+        expert_idx = jnp.argmax(gates, axis=-1)                # [T]
+        gate_val = jnp.take_along_axis(
+            gates, expert_idx[:, None], axis=-1)[:, 0]         # [T]
+        # Position of each token within its expert's capacity buffer.
+        onehot = jax.nn.one_hot(expert_idx, n_exp_total, dtype=jnp.int32)
+        pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1) * onehot  # [T, E]
+        pos = jnp.sum(pos_in_expert, axis=-1)                  # [T]
+        keep = pos < capacity
+        # Scatter tokens into [E, capacity, d] dispatch buffer.
+        disp = jnp.zeros((n_exp_total, capacity, d), x_loc.dtype)
+        tok_ids = jnp.arange(tokens)
+        disp = disp.at[expert_idx, jnp.clip(pos, 0, capacity - 1)].add(
+            jnp.where(keep[:, None], x_loc, 0.0))
+        # Exchange: [E, cap, d] -> experts grouped by owning shard.
+        disp = disp.reshape(n_shards, exp_per_shard, capacity, d)
+        recv = jax.lax.all_to_all(disp, axis, split_axis=0, concat_axis=0,
+                                  tiled=False)
+        # recv: [n_shards, exp_per_shard, capacity, d] — all shards' tokens
+        # destined for MY experts. Flatten senders into the capacity dim.
+        recv = recv.transpose(1, 0, 2, 3).reshape(
+            exp_per_shard, n_shards * capacity, d)
+        # in_specs P(axis) already hands this device its expert slice
+        # (leading dim == exp_per_shard).
+        out = jax.vmap(expert_fn)(params, recv)
+        # Undo: [exp_per_shard, n_shards, capacity, d] -> all_to_all back.
+        out = out.reshape(exp_per_shard, n_shards, capacity, d).transpose(
+            1, 0, 2, 3)
+        back = jax.lax.all_to_all(out, axis, split_axis=0, concat_axis=0,
+                                  tiled=False)
+        back = back.reshape(n_exp_total, capacity, d)
+        # Gather each token's expert output; dropped tokens get zeros.
+        y = back[expert_idx, jnp.clip(pos, 0, capacity - 1)]
+        y = jnp.where(keep[:, None], y, 0.0)
+        return x_loc + gate_val[:, None] * y  # residual + gated expert out
+
+    fn = shard_map(per_device, mesh=mesh,
+                   in_specs=(P(), P(), P(axis)),
+                   out_specs=P(), check_vma=False)
+    return fn(x, router_weights, expert_params)

@@ -1,0 +1,97 @@
+"""Pipeline parallelism: GPipe schedule compiled into one XLA program.
+
+Stages live on the ``pipe`` mesh axis (outermost — its point-to-point
+traffic tolerates DCN across slices, cf. PAPERS.md "Scaling Deep Learning
+Training with MPMD Pipeline Parallelism"). Unlike a runtime scheduler pushing
+microbatches between processes, the whole S-stage × M-microbatch schedule is
+a ``lax.scan`` inside ``shard_map``: each step every stage applies its layer
+block, then activations rotate one hop along the pipe axis via ``ppermute``.
+Bubbles are the standard (S-1)/(M+S-1) fraction; scan keeps it one compiled
+program with static shapes. Composes with data parallelism by sharding the
+batch over ``data_axis``.
+
+Capability net-new vs the reference (SURVEY §2.5: no PP anywhere).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jax.Array,
+                   mesh: Mesh, num_microbatches: int,
+                   axis: str = "pipe",
+                   data_axis: Optional[str] = "data") -> jax.Array:
+    """Run ``stage_fn`` as a pipeline over mesh axis ``axis``.
+
+    stage_fn(params_for_one_stage, activation[mb, ...]) -> activation
+    stage_params: pytree whose leaves have leading dim = n_stages (sharded
+        over ``axis``).
+    x: [batch, ...] input (batch optionally sharded over ``data_axis``).
+    Returns [batch, ...] output with the same sharding as the input batch.
+    """
+    n_stages = mesh.shape[axis]
+    if n_stages == 1:
+        return stage_fn(jax.tree.map(lambda p: p[0], stage_params), x)
+
+    use_dp = (data_axis is not None and data_axis in mesh.axis_names
+              and mesh.shape[data_axis] > 1)
+
+    def per_device(params, x_local):
+        params = jax.tree.map(lambda p: p[0], params)  # this stage's slice
+        stage = jax.lax.axis_index(axis)
+        local_batch = x_local.shape[0]
+        if local_batch % num_microbatches != 0:
+            raise ValueError(
+                f"per-device batch {local_batch} not divisible by "
+                f"num_microbatches {num_microbatches}")
+        mb_size = local_batch // num_microbatches
+        mbs = x_local.reshape((num_microbatches, mb_size) + x_local.shape[1:])
+        total_steps = num_microbatches + n_stages - 1
+        out_buf = jnp.zeros_like(mbs)
+        carry = jnp.zeros_like(mbs[0])
+
+        def step(state, t):
+            carry, out_buf = state
+            # Stage 0 injects microbatch t; other stages consume the
+            # activation that just arrived from the previous stage.
+            inject = jax.lax.dynamic_index_in_dim(
+                mbs, jnp.minimum(t, num_microbatches - 1), keepdims=False)
+            inp = jnp.where(stage == 0, inject, carry)
+            y = stage_fn(params, inp)
+            # Last stage records its result for microbatch (t - S + 1).
+            mb_idx = t - (n_stages - 1)
+            valid = jnp.logical_and(stage == n_stages - 1, mb_idx >= 0)
+            out_buf = jax.lax.cond(
+                valid,
+                lambda buf: jax.lax.dynamic_update_index_in_dim(
+                    buf, y, jnp.maximum(mb_idx, 0), 0),
+                lambda buf: buf,
+                out_buf)
+            # Rotate activations one hop forward along the pipe ring.
+            perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+            carry = jax.lax.ppermute(y, axis, perm)
+            return (carry, out_buf), None
+
+        (carry, out_buf), _ = jax.lax.scan(
+            step, (carry, out_buf), jnp.arange(total_steps))
+        # Replicate final outputs from the last stage onto every stage.
+        out = jax.lax.psum(
+            jnp.where(stage == n_stages - 1, out_buf, jnp.zeros_like(out_buf)),
+            axis)
+        return out.reshape((local_batch,) + x_local.shape[1:])
+
+    x_spec = P(data_axis) if use_dp else P()
+    fn = shard_map(per_device, mesh=mesh, in_specs=(P(axis), x_spec),
+                   out_specs=x_spec, check_vma=False)
+    return fn(stage_params, x)
+
+
+def stack_stage_params(params_per_stage: list) -> Any:
+    """Stack per-stage pytrees into leading-stage-dim arrays for sharding."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *params_per_stage)

@@ -1,0 +1,114 @@
+"""Logical-axis sharding rules: annotate once, let XLA insert collectives.
+
+Parameters and activations are described by *logical* axis names
+("embed", "heads", "batch", ...); a ``ShardingRules`` table maps each to a
+mesh axis (or replication). This is the pjit/scaling-book methodology —
+shardings are data, not code, so switching DP↔FSDP↔TP↔SP is a config edit,
+not a rewrite. (Capability net-new vs the reference; SURVEY §2.5.)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, Optional, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MeshAxes = Union[None, str, Tuple[str, ...]]
+
+
+DEFAULT_RULES: Dict[str, MeshAxes] = {
+    # activations
+    "batch": ("data", "fsdp"),      # per-example axis: all data-parallel axes
+    "seq": "seq",                   # sequence/context parallelism
+    "act_embed": None,              # activation feature dim stays replicated
+    "act_heads": "tensor",
+    # parameters
+    "embed": "fsdp",                # ZeRO-3: shard params along embed over fsdp
+    "vocab": "tensor",
+    "heads": "tensor",              # attention heads over tensor axis
+    "kv": None,
+    "mlp": "tensor",                # ffn hidden over tensor axis
+    # mixture of experts
+    "expert": "expert",
+    # pipeline
+    "stage": "pipe",
+}
+
+
+@dataclass(frozen=True)
+class ShardingRules:
+    rules: Dict[str, MeshAxes] = field(default_factory=lambda: dict(DEFAULT_RULES))
+
+    def with_overrides(self, **overrides: MeshAxes) -> "ShardingRules":
+        merged = dict(self.rules)
+        merged.update(overrides)
+        return ShardingRules(merged)
+
+    def spec(self, logical_axes: Tuple[Optional[str], ...]) -> P:
+        """PartitionSpec for a tensor described by logical axis names."""
+        parts = []
+        used = set()
+        for ax in logical_axes:
+            if ax is None:
+                parts.append(None)
+                continue
+            mesh_axes = self.rules.get(ax)
+            if mesh_axes is None:
+                parts.append(None)
+                continue
+            if isinstance(mesh_axes, str):
+                mesh_axes = (mesh_axes,)
+            fresh = tuple(a for a in mesh_axes if a not in used)
+            used.update(fresh)
+            if not fresh:
+                parts.append(None)
+            elif len(fresh) == 1:
+                parts.append(fresh[0])
+            else:
+                parts.append(fresh)
+        return P(*parts)
+
+    def sharding(self, mesh: Mesh,
+                 logical_axes: Tuple[Optional[str], ...]) -> NamedSharding:
+        spec = self.spec(logical_axes)
+        # Drop axes not present in (or sized 1 on) this mesh.
+        cleaned = []
+        for part in spec:
+            if part is None:
+                cleaned.append(None)
+            elif isinstance(part, tuple):
+                keep = tuple(a for a in part if a in mesh.axis_names
+                             and mesh.shape[a] > 1)
+                cleaned.append(keep if keep else None)
+            else:
+                cleaned.append(part if part in mesh.axis_names
+                               and mesh.shape[part] > 1 else None)
+        return NamedSharding(mesh, P(*cleaned))
+
+
+def shard_pytree(tree: Any, axes_tree: Any, mesh: Mesh,
+                 rules: Optional[ShardingRules] = None) -> Any:
+    """Device-put every leaf with the sharding derived from its logical axes.
+
+    ``axes_tree`` mirrors ``tree`` with tuples of logical axis names.
+    """
+    rules = rules or ShardingRules()
+
+    def _place(leaf, axes):
+        return jax.device_put(leaf, rules.sharding(mesh, axes))
+
+    return jax.tree.map(_place, tree, axes_tree,
+                        is_leaf=lambda x: x is None)
+
+
+def batch_sharding(mesh: Mesh, rules: Optional[ShardingRules] = None,
+                   ndim: int = 2) -> NamedSharding:
+    """Sharding for a [batch, ...] input array."""
+    rules = rules or ShardingRules()
+    return rules.sharding(mesh, ("batch",) + (None,) * (ndim - 1))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

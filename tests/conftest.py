@@ -14,6 +14,17 @@ os.environ["XLA_FLAGS"] = (
 
 import pytest  # noqa: E402
 
+import jax  # noqa: E402
+
+# sitecustomize pre-imports jax, so JAX_PLATFORMS env is read before this
+# file runs — the config update below is what actually forces CPU (default
+# jax.devices() must be the 8 virtual CPUs, not the axon TPU, or the
+# multi-device collective paths silently degrade to single-device
+# fallbacks). float32 matmuls so sharded-vs-dense comparisons are not
+# dominated by bf16 default-precision noise.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
 
 @pytest.fixture
 def ray_start_regular():
