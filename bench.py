@@ -1,0 +1,75 @@
+"""Headline benchmark: ResNet-18 training throughput per chip.
+
+Mirrors the reference's GPU image-training benchmark
+(``doc/source/ray-air/benchmarks.rst:163-174``: torchvision ResNet-18,
+746.29 images/sec across 16 T4 workers = 46.64 images/sec/chip) on one TPU
+chip. Synthetic 224x224 data (the reference benchmark is also
+data-loader-free compute measurement at this granularity), bfloat16, full
+fwd+bwd+SGD step, steps chained inside one jit scan so dispatch overhead is
+amortized (required under the axon relay).
+
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.models import resnet
+
+BASELINE_IMAGES_PER_SEC_PER_CHIP = 746.29 / 16  # T4, benchmarks.rst:171-174
+
+BATCH = 256
+IMAGE = 224
+MEASURE_STEPS = 20
+
+
+def main():
+    cfg = resnet.resnet18(num_classes=1000)
+    params = resnet.init_params(jax.random.PRNGKey(0), cfg)
+    opt = optax.sgd(0.1, momentum=0.9)
+    opt_state = opt.init(params)
+
+    key = jax.random.PRNGKey(1)
+    images = jax.random.normal(key, (BATCH, IMAGE, IMAGE, 3),
+                               dtype=jnp.bfloat16)
+    labels = jax.random.randint(jax.random.PRNGKey(2), (BATCH,), 0, 1000)
+
+    def one_step(state, _):
+        params, opt_state = state
+        loss, grads = jax.value_and_grad(resnet.loss_fn)(
+            params, images, labels, cfg)
+        updates, opt_state = opt.update(grads, opt_state)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state), loss
+
+    @jax.jit
+    def run_steps(state, n_steps_arr):
+        return jax.lax.scan(one_step, state, n_steps_arr)
+
+    state = (params, opt_state)
+    # Warmup with the SAME step count so the measured call hits the compile
+    # cache (a different scan length is a different program).
+    state, losses = run_steps(state, jnp.arange(MEASURE_STEPS))
+    jax.block_until_ready(losses)
+
+    t0 = time.perf_counter()
+    state, losses = run_steps(state, jnp.arange(MEASURE_STEPS))
+    jax.block_until_ready(losses)
+    elapsed = time.perf_counter() - t0
+
+    images_per_sec = BATCH * MEASURE_STEPS / elapsed
+    print(json.dumps({
+        "metric": "resnet18_train_images_per_sec_per_chip",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(images_per_sec / BASELINE_IMAGES_PER_SEC_PER_CHIP,
+                             2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,6 @@
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import (CheckpointConfig, FailureConfig, Result,
+                                RunConfig, ScalingConfig)
+
+__all__ = ["Checkpoint", "ScalingConfig", "RunConfig", "FailureConfig",
+           "CheckpointConfig", "Result"]

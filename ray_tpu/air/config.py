@@ -1,0 +1,57 @@
+"""AIR-style configs.
+
+Parity with ``python/ray/air/config.py`` (``ScalingConfig``, ``RunConfig``,
+``FailureConfig``) adapted to TPU: ``use_tpu`` + ``topology`` replace
+``use_gpu``; workers map 1:1 to TPU hosts (the device-owner process model).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    topology: Optional[str] = None  # e.g. "v5e-8" custom resource label
+
+    def worker_resources(self) -> Dict[str, float]:
+        r = dict(self.resources_per_worker or {})
+        r.setdefault("CPU", 1)
+        if self.use_tpu:
+            r.setdefault("TPU", 1)
+        if self.topology:
+            r.setdefault(self.topology, 1)
+        return r
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0  # -1 = unlimited restarts
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_frequency: int = 0
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    verbose: int = 1
+
+
+@dataclass
+class Result:
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Any]
+    error: Optional[BaseException] = None
+    metrics_history: Optional[list] = None

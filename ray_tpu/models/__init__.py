@@ -1,0 +1,4 @@
+from ray_tpu.models import resnet, transformer
+from ray_tpu.models.transformer import TransformerConfig
+
+__all__ = ["transformer", "resnet", "TransformerConfig"]

@@ -1,0 +1,148 @@
+"""ResNet (18/50) — the image-training benchmark model.
+
+Functional flax-free implementation matching the reference's benchmark
+workload (``release/air_tests/air_benchmarks/workloads/torch_benchmark.py``
+trains torchvision resnet18; ``benchmarks.rst:163-174``). NHWC layout
+(TPU-native; conv lowers onto the MXU), bfloat16 compute with float32
+batch-norm statistics.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Tuple[int, ...] = (2, 2, 2, 2)   # resnet18
+    num_classes: int = 1000
+    width: int = 64
+    bottleneck: bool = False
+    dtype: Any = jnp.bfloat16
+
+
+def resnet18(num_classes: int = 1000) -> ResNetConfig:
+    return ResNetConfig((2, 2, 2, 2), num_classes, bottleneck=False)
+
+
+def resnet50(num_classes: int = 1000) -> ResNetConfig:
+    return ResNetConfig((3, 4, 6, 3), num_classes, bottleneck=True)
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    fan_in = kh * kw * cin
+    return jax.random.normal(key, (kh, kw, cin, cout), jnp.float32) \
+        * math.sqrt(2.0 / fan_in)
+
+
+def _bn_init(c):
+    return {"scale": jnp.ones((c,), jnp.float32),
+            "bias": jnp.zeros((c,), jnp.float32)}
+
+
+def init_params(key: jax.Array, cfg: ResNetConfig) -> Dict[str, Any]:
+    keys = iter(jax.random.split(key, 256))
+    params: Dict[str, Any] = {
+        "stem": {"conv": _conv_init(next(keys), 7, 7, 3, cfg.width),
+                 "bn": _bn_init(cfg.width)},
+        "stages": [],
+    }
+    cin = cfg.width
+    expansion = 4 if cfg.bottleneck else 1
+    for si, n_blocks in enumerate(cfg.stage_sizes):
+        cmid = cfg.width * (2 ** si)
+        cout = cmid * expansion
+        stage: List[Dict[str, Any]] = []
+        for bi in range(n_blocks):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            blk: Dict[str, Any] = {}
+            if cfg.bottleneck:
+                blk["conv1"] = _conv_init(next(keys), 1, 1, cin, cmid)
+                blk["bn1"] = _bn_init(cmid)
+                blk["conv2"] = _conv_init(next(keys), 3, 3, cmid, cmid)
+                blk["bn2"] = _bn_init(cmid)
+                blk["conv3"] = _conv_init(next(keys), 1, 1, cmid, cout)
+                blk["bn3"] = _bn_init(cout)
+            else:
+                blk["conv1"] = _conv_init(next(keys), 3, 3, cin, cmid)
+                blk["bn1"] = _bn_init(cmid)
+                blk["conv2"] = _conv_init(next(keys), 3, 3, cmid, cout)
+                blk["bn2"] = _bn_init(cout)
+            if stride != 1 or cin != cout:
+                blk["proj"] = _conv_init(next(keys), 1, 1, cin, cout)
+                blk["proj_bn"] = _bn_init(cout)
+            stage.append(blk)
+            cin = cout
+        params["stages"].append(stage)
+    params["head"] = {
+        "w": jax.random.normal(next(keys), (cin, cfg.num_classes),
+                               jnp.float32) * 0.01,
+        "b": jnp.zeros((cfg.num_classes,), jnp.float32),
+    }
+    return params
+
+
+def _conv(x, w, stride=1, dtype=jnp.bfloat16):
+    return jax.lax.conv_general_dilated(
+        x.astype(dtype), w.astype(dtype),
+        window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _bn(x, p):
+    """Per-batch normalization statistics (training mode)."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=(0, 1, 2), keepdims=True)
+    var = jnp.var(xf, axis=(0, 1, 2), keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + 1e-5)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _basic_block(x, blk, stride, dtype):
+    y = jax.nn.relu(_bn(_conv(x, blk["conv1"], stride, dtype),
+                        blk["bn1"]))
+    y = _bn(_conv(y, blk["conv2"], 1, dtype), blk["bn2"])
+    sc = x
+    if "proj" in blk:
+        sc = _bn(_conv(x, blk["proj"], stride, dtype), blk["proj_bn"])
+    return jax.nn.relu(y + sc)
+
+
+def _bottleneck_block(x, blk, stride, dtype):
+    y = jax.nn.relu(_bn(_conv(x, blk["conv1"], 1, dtype), blk["bn1"]))
+    y = jax.nn.relu(_bn(_conv(y, blk["conv2"], stride, dtype),
+                        blk["bn2"]))
+    y = _bn(_conv(y, blk["conv3"], 1, dtype), blk["bn3"])
+    sc = x
+    if "proj" in blk:
+        sc = _bn(_conv(x, blk["proj"], stride, dtype), blk["proj_bn"])
+    return jax.nn.relu(y + sc)
+
+
+def apply(params: Dict[str, Any], images: jax.Array,
+          cfg: ResNetConfig) -> jax.Array:
+    """images: [B, H, W, 3] -> logits [B, num_classes] (float32)."""
+    dtype = cfg.dtype
+    x = _conv(images, params["stem"]["conv"], 2, dtype)
+    x = jax.nn.relu(_bn(x, params["stem"]["bn"]))
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "SAME")
+    block = _bottleneck_block if cfg.bottleneck else _basic_block
+    for si, stage in enumerate(params["stages"]):
+        for bi, blk in enumerate(stage):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            x = block(x, blk, stride, dtype)
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+    return x @ params["head"]["w"] + params["head"]["b"]
+
+
+def loss_fn(params, images, labels, cfg: ResNetConfig) -> jax.Array:
+    logits = apply(params, images, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))

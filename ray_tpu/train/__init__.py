@@ -1,0 +1,7 @@
+from ray_tpu.train import session
+from ray_tpu.train.backend_executor import BackendExecutor, RayTrainWorker
+from ray_tpu.train.step import make_lm_train_step
+from ray_tpu.train.trainer import JaxTrainer
+
+__all__ = ["JaxTrainer", "BackendExecutor", "RayTrainWorker", "session",
+           "make_lm_train_step"]

@@ -1,0 +1,98 @@
+"""Per-worker training session.
+
+Parity with ``python/ray/air/session.py`` + ``train/_internal/session.py:261``:
+``report(metrics, checkpoint=...)`` streams results to the driver;
+``get_checkpoint`` hands back the restore point; rank/world accessors mirror
+the reference's. The TPU additions: ``get_mesh()`` exposes the worker's
+device mesh, and reported checkpoints may hold device arrays (they stay
+resident; the store keeps descriptors).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, Optional
+
+
+class _TrainSession:
+    def __init__(self, world_rank: int, world_size: int,
+                 local_rank: int = 0,
+                 checkpoint=None, mesh=None, config=None,
+                 collective_group_name: Optional[str] = None):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.collective_group_name = collective_group_name
+        self.results: "queue.Queue" = queue.Queue()
+        self.checkpoint = checkpoint
+        self.mesh = mesh
+        self.config = config or {}
+        self.finished = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.latest_checkpoint = checkpoint
+
+
+_session = threading.local()
+
+
+def _init_session(**kwargs) -> _TrainSession:
+    _session.s = _TrainSession(**kwargs)
+    return _session.s
+
+
+def _get_session() -> Optional[_TrainSession]:
+    return getattr(_session, "s", None)
+
+
+def _shutdown_session():
+    _session.s = None
+
+
+def report(metrics: Dict[str, Any], checkpoint=None) -> None:
+    """Stream a result row (and optionally a checkpoint) to the driver."""
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("session.report() called outside a train worker")
+    if checkpoint is not None:
+        s.latest_checkpoint = checkpoint
+    s.results.put({"metrics": dict(metrics), "checkpoint": checkpoint,
+                   "rank": s.world_rank})
+
+
+def get_checkpoint():
+    s = _get_session()
+    return s.checkpoint if s else None
+
+
+def get_world_rank() -> int:
+    s = _get_session()
+    return s.world_rank if s else 0
+
+
+def get_world_size() -> int:
+    s = _get_session()
+    return s.world_size if s else 1
+
+
+def get_local_rank() -> int:
+    s = _get_session()
+    return s.local_rank if s else 0
+
+
+def get_mesh():
+    """The jax device mesh assigned to this worker group (TPU-native)."""
+    s = _get_session()
+    return s.mesh if s else None
+
+
+def get_config() -> Dict[str, Any]:
+    s = _get_session()
+    return dict(s.config) if s else {}
+
+
+def get_collective_group_name() -> Optional[str]:
+    """Name of the collective group the executor created for this worker
+    group (None when the trainer was built with collective_backend=None)."""
+    s = _get_session()
+    return s.collective_group_name if s else None

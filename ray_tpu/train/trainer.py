@@ -1,0 +1,112 @@
+"""JaxTrainer — the DataParallelTrainer equivalent.
+
+Parity with ``python/ray/train/data_parallel_trainer.py:50`` +
+``base_trainer.py:327``: ``fit()`` spins up a worker group in a placement
+group, runs ``train_loop_per_worker`` on every worker, streams
+``session.report`` rounds, and on worker failure restarts the group from the
+latest checkpoint up to ``FailureConfig.max_failures``
+(``backend_executor.py:461-531``). TPU-native: workers pin to TPU hosts;
+inside the loop the user gets a mesh (``session.get_mesh``) and an optional
+``xla`` collective group instead of a torch process group.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import (FailureConfig, Result, RunConfig,
+                                ScalingConfig)
+from ray_tpu.train.backend_executor import BackendExecutor
+
+
+class JaxTrainer:
+    def __init__(self, train_loop_per_worker: Callable[[Dict[str, Any]], None],
+                 *, train_loop_config: Optional[Dict[str, Any]] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 collective_backend: Optional[str] = "xla",
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        self._train_loop = train_loop_per_worker
+        self._config = train_loop_config or {}
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self._collective_backend = collective_backend
+        self._resume_from = resume_from_checkpoint
+
+    def fit(self) -> Result:
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        failures = 0
+        max_failures = self.run_config.failure_config.max_failures
+        checkpoint = self._resume_from
+        history = []
+        last_metrics: Dict[str, Any] = {}
+        ckpt_index = 0
+        while True:
+            executor = BackendExecutor(
+                self.scaling_config.num_workers,
+                self.scaling_config.worker_resources(),
+                self.scaling_config.placement_strategy,
+                self._collective_backend)
+            try:
+                executor.start()
+                executor.start_training(self._train_loop, self._config,
+                                        checkpoint)
+                while True:
+                    round_results = executor.get_next_results()
+                    if round_results is None:
+                        break
+                    for r in round_results:
+                        history.append(r["metrics"])
+                        if r["checkpoint"] is not None and r["rank"] == 0:
+                            checkpoint = r["checkpoint"]
+                            ckpt_index = self._persist_checkpoint(
+                                checkpoint, ckpt_index)
+                    if round_results:
+                        last_metrics = round_results[0]["metrics"]
+                finals = executor.get_final_checkpoints()
+                if finals and finals[0] is not None:
+                    checkpoint = finals[0]
+                return Result(metrics=last_metrics, checkpoint=checkpoint,
+                              metrics_history=history)
+            except (exc.ActorDiedError, exc.NodeDiedError,
+                    exc.TaskError) as e:
+                failures += 1
+                if max_failures != -1 and failures > max_failures:
+                    return Result(metrics=last_metrics, checkpoint=checkpoint,
+                                  error=e, metrics_history=history)
+                # Elastic restart from the latest checkpoint
+                # (reference: backend_executor.py:510-531).
+                time.sleep(0.1)
+                continue
+            finally:
+                # Never leak the worker group / placement group, whatever
+                # path exits the attempt.
+                executor.shutdown()
+
+    def _persist_checkpoint(self, checkpoint: Checkpoint, index: int) -> int:
+        """Write checkpoints under RunConfig.storage_path, pruning to
+        CheckpointConfig.num_to_keep (reference: checkpoint managers in
+        ``air/_internal/checkpoint_manager.py``)."""
+        import os
+        import shutil
+        storage = self.run_config.storage_path
+        if not storage:
+            return index
+        name = self.run_config.name or "experiment"
+        exp_dir = os.path.join(storage, name)
+        os.makedirs(exp_dir, exist_ok=True)
+        checkpoint.to_directory(os.path.join(exp_dir,
+                                             f"checkpoint_{index:06d}"))
+        keep = self.run_config.checkpoint_config.num_to_keep
+        if keep:
+            existing = sorted(d for d in os.listdir(exp_dir)
+                              if d.startswith("checkpoint_"))
+            for stale in existing[:-keep]:
+                shutil.rmtree(os.path.join(exp_dir, stale),
+                              ignore_errors=True)
+        return index + 1
