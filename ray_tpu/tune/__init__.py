@@ -1,0 +1,33 @@
+"""ray_tpu.tune — hyperparameter optimization engine.
+
+TPU-native re-design of the capabilities of ``python/ray/tune/``: trials are
+``ray_tpu`` actors (one Trainable each) driven by an event-loop TrialRunner
+with pluggable schedulers (ASHA/HyperBand/PBT/median-stopping) and searchers
+(grid/random + wrappers). Trials that train on TPU share the host's device
+mesh; checkpoints interoperate with ``ray_tpu.air.Checkpoint``.
+"""
+
+from ray_tpu.tune.analysis import ExperimentAnalysis, ResultGrid
+from ray_tpu.tune.sample import (choice, grid_search, lograndint, loguniform,
+                                 qloguniform, quniform, randint, randn,
+                                 sample_from, uniform)
+from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
+                                     HyperBandScheduler, MedianStoppingRule,
+                                     PopulationBasedTraining, TrialScheduler)
+from ray_tpu.tune.search import (BasicVariantGenerator, ConcurrencyLimiter,
+                                 Repeater, Searcher)
+from ray_tpu.tune.session import get_checkpoint, get_trial_id, report
+from ray_tpu.tune.trainable import FunctionTrainable, Trainable, wrap_function
+from ray_tpu.tune.trial import Trial
+from ray_tpu.tune.tuner import TuneConfig, Tuner, run
+
+__all__ = [
+    "run", "Tuner", "TuneConfig", "Trainable", "FunctionTrainable",
+    "wrap_function", "Trial", "report", "get_checkpoint", "get_trial_id",
+    "uniform", "quniform", "loguniform", "qloguniform", "randn", "randint",
+    "lograndint", "choice", "sample_from", "grid_search",
+    "FIFOScheduler", "AsyncHyperBandScheduler", "HyperBandScheduler",
+    "MedianStoppingRule", "PopulationBasedTraining", "TrialScheduler",
+    "BasicVariantGenerator", "ConcurrencyLimiter", "Repeater", "Searcher",
+    "ExperimentAnalysis", "ResultGrid",
+]

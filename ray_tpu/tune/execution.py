@@ -1,0 +1,324 @@
+"""Trial execution: actor-per-trial event loop.
+
+Parity with ``python/ray/tune/execution/trial_runner.py`` (``TrialRunner.step``
+:234,853) and ``ray_trial_executor.py``: each trial runs as a ``ray_tpu``
+actor; the driver loop starts pending trials up to the resource-derived
+concurrency cap, waits on in-flight ``train()`` futures, routes results
+through the scheduler (CONTINUE/PAUSE/STOP), checkpoints trials, restarts
+failed trials from their last checkpoint up to ``max_failures``, and
+persists experiment state for resume (``trial_runner.py:671,1240``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.tune import schedulers as sched_mod
+from ray_tpu.tune.schedulers import CONTINUE, PAUSE, STOP, FIFOScheduler
+from ray_tpu.tune.trainable import Trainable, wrap_function
+from ray_tpu.tune.trial import (ERROR, PAUSED, PENDING, RUNNING, TERMINATED,
+                                Trial)
+
+
+@ray_tpu.remote
+class _TrainableActor:
+    """Hosts one Trainable instance (the executor's trial actor)."""
+
+    def __init__(self, trainable_cls_bytes: bytes, config: Dict[str, Any],
+                 logdir: str, trial_id: str):
+        import cloudpickle
+        cls = cloudpickle.loads(trainable_cls_bytes)
+        self._t = cls(config, logdir)
+        self._t._trial_id = trial_id
+
+    def train(self) -> Dict[str, Any]:
+        return self._t.train()
+
+    def save(self) -> Dict[str, Any]:
+        return self._t.save()
+
+    def restore(self, payload: Dict[str, Any]):
+        self._t.restore(payload)
+
+    def reset(self, new_config: Dict[str, Any]) -> bool:
+        return self._t.reset(new_config)
+
+    def stop(self):
+        self._t.stop()
+
+
+def _as_trainable_cls(trainable) -> type:
+    if isinstance(trainable, type) and issubclass(trainable, Trainable):
+        return trainable
+    if callable(trainable):
+        return wrap_function(trainable)
+    raise TypeError(f"not a trainable: {trainable!r}")
+
+
+class TrialRunner:
+    def __init__(self, trainable, trials: List[Trial],
+                 scheduler=None,
+                 stop: Optional[Any] = None,
+                 metric: Optional[str] = None, mode: str = "max",
+                 max_concurrent_trials: Optional[int] = None,
+                 max_failures: int = 0,
+                 checkpoint_freq: int = 0,
+                 checkpoint_at_end: bool = False,
+                 resources_per_trial: Optional[Dict[str, float]] = None,
+                 callbacks: Optional[List[Any]] = None,
+                 local_dir: Optional[str] = None,
+                 experiment_name: str = "experiment",
+                 searcher=None,
+                 time_budget_s: Optional[float] = None):
+        import cloudpickle
+        self._trainable_cls = _as_trainable_cls(trainable)
+        self._trainable_bytes = cloudpickle.dumps(self._trainable_cls)
+        self.trials = list(trials)
+        self.scheduler = scheduler or FIFOScheduler()
+        self.scheduler.set_search_properties(metric, mode)
+        if isinstance(self.scheduler, sched_mod.PopulationBasedTraining):
+            self.scheduler._runner = self
+        self.searcher = searcher
+        self._stop = stop
+        self.metric, self.mode = metric, mode
+        self.max_failures = max_failures
+        self.checkpoint_freq = checkpoint_freq
+        self.checkpoint_at_end = checkpoint_at_end
+        self.resources_per_trial = resources_per_trial or {"cpu": 1}
+        self.callbacks = callbacks or []
+        self.time_budget_s = time_budget_s
+        self._start_time: Optional[float] = None
+        self.local_dir = local_dir or os.path.expanduser(
+            "~/ray_tpu_results")
+        self.experiment_dir = os.path.join(self.local_dir, experiment_name)
+        os.makedirs(self.experiment_dir, exist_ok=True)
+        if max_concurrent_trials:
+            self._max_concurrent = max_concurrent_trials
+        else:
+            self._max_concurrent = self._derive_concurrency()
+        for t in self.trials:
+            self.scheduler.on_trial_add(t)
+
+    def _derive_concurrency(self) -> int:
+        try:
+            avail = ray_tpu.cluster_resources()
+        except Exception:
+            return 4
+        cpus = avail.get("CPU", 4)
+        per = self.resources_per_trial.get(
+            "cpu", self.resources_per_trial.get("CPU", 1)) or 1
+        return max(1, int(cpus / per))
+
+    # ------------------------------------------------------------------
+    def _trial_by_id(self, trial_id: str) -> Optional[Trial]:
+        for t in self.trials:
+            if t.trial_id == trial_id:
+                return t
+        return None
+
+    def _start_trial(self, trial: Trial, restore: bool = True):
+        trial.logdir = os.path.join(self.experiment_dir, trial.trial_id)
+        os.makedirs(trial.logdir, exist_ok=True)
+        cpu = self.resources_per_trial.get(
+            "cpu", self.resources_per_trial.get("CPU", 1))
+        tpu = self.resources_per_trial.get(
+            "tpu", self.resources_per_trial.get("TPU", 0))
+        actor = _TrainableActor.options(
+            num_cpus=cpu, num_tpus=tpu or None).remote(
+                self._trainable_bytes, trial.config, trial.logdir,
+                trial.trial_id)
+        trial._actor = actor
+        if restore and trial.checkpoint is not None:
+            ray_tpu.get(actor.restore.remote(trial.checkpoint))
+        trial.status = RUNNING
+        if trial.start_time is None:
+            trial.start_time = time.time()
+        trial._future = actor.train.remote()
+        for cb in self.callbacks:
+            cb.on_trial_start(trial)
+
+    def _stop_trial(self, trial: Trial, status: str = TERMINATED,
+                    save: bool = False):
+        if trial._actor is not None:
+            try:
+                if save:
+                    trial.checkpoint = ray_tpu.get(trial._actor.save.remote())
+                ray_tpu.get(trial._actor.stop.remote())
+            except Exception:
+                pass
+            try:
+                ray_tpu.kill(trial._actor)
+            except Exception:
+                pass
+        trial._actor = None
+        trial._future = None
+        trial.status = status
+        for cb in self.callbacks:
+            cb.on_trial_complete(trial)
+
+    def _exploit_trial(self, trial: Trial, donor: Trial,
+                       new_config: Dict[str, Any]):
+        """PBT exploit: replace trial's state with donor's checkpoint and a
+        perturbed config (reference ``pbt.py _exploit``)."""
+        if trial._actor is None:
+            return
+        reset_ok = False
+        try:
+            reset_ok = ray_tpu.get(trial._actor.reset.remote(new_config))
+        except Exception:
+            reset_ok = False
+        if not reset_ok:
+            self._stop_trial(trial, status=PAUSED)
+            trial.config = new_config
+            trial.checkpoint = donor.checkpoint
+            self._start_trial(trial, restore=True)
+            return
+        trial.config = new_config
+        ray_tpu.get(trial._actor.restore.remote(donor.checkpoint))
+        trial.checkpoint = donor.checkpoint
+        trial._future = trial._actor.train.remote()
+
+    def _should_stop_trial(self, trial: Trial, result: Dict[str, Any]) -> bool:
+        if result.get("done"):
+            return True
+        s = self._stop
+        if s is None:
+            return False
+        if callable(s):
+            return bool(s(trial.trial_id, result))
+        if isinstance(s, dict):
+            for k, v in s.items():
+                if k in result and result[k] >= v:
+                    return True
+        return False
+
+    def _maybe_checkpoint(self, trial: Trial, result: Dict[str, Any]):
+        it = result.get("training_iteration", 0)
+        if self.checkpoint_freq and it % self.checkpoint_freq == 0:
+            trial.checkpoint = ray_tpu.get(trial._actor.save.remote())
+
+    # ------------------------------------------------------------------
+    def run(self):
+        self._start_time = time.time()
+        while True:
+            if self._over_budget():
+                for t in self.trials:
+                    if t.status == RUNNING:
+                        self._stop_trial(t, save=self.checkpoint_at_end)
+                break
+            self._launch_pending()
+            inflight = {t._future: t for t in self.trials
+                        if t.status == RUNNING and t._future is not None}
+            if not inflight:
+                if any(t.status in (PENDING, PAUSED) for t in self.trials):
+                    continue
+                break
+            ready, _ = ray_tpu.wait(list(inflight.keys()), num_returns=1,
+                                    timeout=10.0)
+            if not ready:
+                continue
+            trial = inflight[ready[0]]
+            self._process_result(trial, ready[0])
+        self.save_experiment_state()
+        return self.trials
+
+    def _over_budget(self) -> bool:
+        return (self.time_budget_s is not None and self._start_time and
+                time.time() - self._start_time > self.time_budget_s)
+
+    def _launch_pending(self):
+        running = sum(1 for t in self.trials if t.status == RUNNING)
+        for t in self.trials:
+            if running >= self._max_concurrent:
+                break
+            if t.status == PENDING or t.status == PAUSED:
+                self._start_trial(t)
+                running += 1
+        # pull more suggestions from a live searcher
+        while (self.searcher is not None and
+               running < self._max_concurrent):
+            tid = f"trial_{len(self.trials)}"
+            cfg = self.searcher.suggest(tid)
+            if cfg is None:
+                break
+            t = Trial(cfg, trial_id=tid)
+            self.trials.append(t)
+            self.scheduler.on_trial_add(t)
+            self._start_trial(t)
+            running += 1
+
+    def _process_result(self, trial: Trial, future):
+        try:
+            result = ray_tpu.get(future)
+        except Exception as e:  # trial actor failed
+            trial.num_failures += 1
+            trial.error = repr(e)
+            self.scheduler.on_trial_error(trial)
+            if self.searcher is not None:
+                self.searcher.on_trial_complete(trial.trial_id, error=True)
+            if trial.num_failures <= self.max_failures:
+                # restart from last checkpoint (trial_runner.py:1240)
+                self._stop_trial(trial, status=PENDING)
+            else:
+                self._stop_trial(trial, status=ERROR)
+            return
+        trial.results.append(result)
+        trial.last_result = result
+        for cb in self.callbacks:
+            cb.on_trial_result(trial, result)
+        if self.searcher is not None:
+            self.searcher.on_trial_result(trial.trial_id, result)
+        self._maybe_checkpoint(trial, result)
+        decision = self.scheduler.on_trial_result(trial, result)
+        if trial.status != RUNNING or trial._future is None:
+            # scheduler (e.g. PBT exploit) already restarted the trial
+            return
+        if self._should_stop_trial(trial, result):
+            decision = STOP
+        if decision == STOP:
+            if self.checkpoint_at_end:
+                trial.checkpoint = ray_tpu.get(trial._actor.save.remote())
+            self.scheduler.on_trial_complete(trial, result)
+            if self.searcher is not None:
+                self.searcher.on_trial_complete(trial.trial_id, result)
+            self._stop_trial(trial, status=TERMINATED)
+        elif decision == PAUSE:
+            self._stop_trial(trial, status=PAUSED, save=True)
+        else:
+            trial._future = trial._actor.train.remote()
+
+    # -- experiment persistence ----------------------------------------
+    def save_experiment_state(self):
+        state_path = os.path.join(self.experiment_dir, "experiment_state.json")
+        ckpt_path = os.path.join(self.experiment_dir, "trial_checkpoints.pkl")
+        with open(state_path, "w") as f:
+            json.dump({"trials": [t.summary() for t in self.trials],
+                       "timestamp": time.time()}, f, indent=2, default=repr)
+        with open(ckpt_path, "wb") as f:
+            pickle.dump({t.trial_id: t.checkpoint for t in self.trials}, f)
+
+    @classmethod
+    def load_experiment_state(cls, experiment_dir: str):
+        with open(os.path.join(experiment_dir, "experiment_state.json")) as f:
+            state = json.load(f)
+        ckpts = {}
+        p = os.path.join(experiment_dir, "trial_checkpoints.pkl")
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                ckpts = pickle.load(f)
+        trials = []
+        for ts in state["trials"]:
+            t = Trial(ts["config"], trial_id=ts["trial_id"])
+            t.status = (TERMINATED if ts["status"] == TERMINATED
+                        else PENDING)
+            t.last_result = ts.get("last_result") or {}
+            if t.last_result:
+                t.results = [t.last_result]
+            t.checkpoint = ckpts.get(t.trial_id)
+            trials.append(t)
+        return trials

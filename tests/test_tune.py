@@ -1,0 +1,268 @@
+"""Tests for ray_tpu.tune (mirrors the reference's tune/tests strategy:
+function + class API, grid/random search, schedulers, checkpoints, resume,
+failure handling)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.sample import Domain
+from ray_tpu.tune.search import generate_variants
+from ray_tpu.tune.trial import ERROR, TERMINATED
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _ray():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=8)
+    yield
+
+
+# ---------------------------------------------------------------- search
+def test_generate_variants_grid_cross_product():
+    space = {"a": tune.grid_search([1, 2, 3]), "b": tune.grid_search([10, 20]),
+             "c": "const"}
+    variants = list(generate_variants(space, num_samples=1))
+    assert len(variants) == 6
+    assert {(v["a"], v["b"]) for v in variants} == {
+        (a, b) for a in (1, 2, 3) for b in (10, 20)}
+    assert all(v["c"] == "const" for v in variants)
+
+
+def test_generate_variants_sampling_and_nested():
+    space = {"lr": tune.loguniform(1e-5, 1e-1),
+             "net": {"width": tune.randint(8, 64),
+                     "act": tune.choice(["relu", "gelu"])}}
+    variants = list(generate_variants(space, num_samples=20, seed=0))
+    assert len(variants) == 20
+    for v in variants:
+        assert 1e-5 <= v["lr"] <= 1e-1
+        assert 8 <= v["net"]["width"] < 64
+        assert v["net"]["act"] in ("relu", "gelu")
+
+
+def test_sample_domains():
+    import random
+    rng = random.Random(0)
+    assert 0 <= tune.uniform(0, 1).sample(rng) <= 1
+    assert tune.quniform(0, 10, 2).sample(rng) % 2 == 0
+    assert tune.randint(5, 6).sample(rng) == 5
+    assert tune.choice([3]).sample(rng) == 3
+    assert isinstance(tune.sample_from(lambda: 42).sample(rng), int)
+
+
+# ---------------------------------------------------------------- function API
+def test_function_trainable_run(tmp_path):
+    def trainable(config):
+        for i in range(5):
+            tune.report(score=config["x"] * (i + 1))
+
+    analysis = tune.run(trainable, config={"x": tune.grid_search([1, 2])},
+                        metric="score", mode="max",
+                        local_dir=str(tmp_path), verbose=0)
+    assert len(analysis.trials) == 2
+    best = analysis.get_best_trial()
+    assert best.config["x"] == 2
+    assert best.last_result["score"] == 10
+    assert all(t.status == TERMINATED for t in analysis.trials)
+
+
+def test_stop_criteria_dict(tmp_path):
+    def trainable(config):
+        for i in range(100):
+            tune.report(it=i)
+
+    analysis = tune.run(trainable, config={}, stop={"it": 5},
+                        local_dir=str(tmp_path), verbose=0)
+    t = analysis.trials[0]
+    assert t.last_result["it"] == 5
+
+
+def test_class_trainable_and_checkpoint_freq(tmp_path):
+    class MyTrainable(tune.Trainable):
+        def setup(self, config):
+            self.x = config.get("start", 0)
+
+        def step(self):
+            self.x += 1
+            return {"x": self.x, "done": self.x >= 6}
+
+        def save_checkpoint(self, d):
+            return {"x": self.x}
+
+        def load_checkpoint(self, data):
+            self.x = data["x"]
+
+    analysis = tune.run(MyTrainable, config={"start": 0}, checkpoint_freq=2,
+                        metric="x", mode="max", local_dir=str(tmp_path),
+                        verbose=0)
+    t = analysis.trials[0]
+    assert t.last_result["x"] == 6
+    assert t.checkpoint is not None and t.checkpoint["data"]["x"] in (4, 6)
+
+
+def test_trial_failure_restart_from_checkpoint(tmp_path):
+    class Flaky(tune.Trainable):
+        def setup(self, config):
+            self.x = 0
+            self.crashed = config  # marker file dir
+
+        def step(self):
+            self.x += 1
+            marker = os.path.join(self.config["dir"], "crashed")
+            if self.x == 3 and not os.path.exists(marker):
+                open(marker, "w").close()
+                raise RuntimeError("boom")
+            return {"x": self.x, "done": self.x >= 5}
+
+        def save_checkpoint(self, d):
+            return {"x": self.x}
+
+        def load_checkpoint(self, data):
+            self.x = data["x"]
+
+    analysis = tune.run(Flaky, config={"dir": str(tmp_path)},
+                        checkpoint_freq=1, max_failures=2, metric="x",
+                        mode="max", local_dir=str(tmp_path), verbose=0)
+    t = analysis.trials[0]
+    assert t.status == TERMINATED
+    assert t.num_failures == 1
+    assert t.last_result["x"] == 5
+
+
+def test_trial_error_exhausts_failures(tmp_path):
+    def bad(config):
+        raise ValueError("always fails")
+
+    analysis = tune.run(bad, config={}, max_failures=0,
+                        local_dir=str(tmp_path), verbose=0)
+    assert analysis.trials[0].status == ERROR
+    assert "always fails" in analysis.trials[0].error
+
+
+# ---------------------------------------------------------------- schedulers
+def test_asha_stops_bad_trials(tmp_path):
+    def trainable(config):
+        for i in range(20):
+            tune.report(score=config["q"] * (i + 1))
+
+    sched = tune.AsyncHyperBandScheduler(max_t=20, grace_period=2,
+                                         reduction_factor=2)
+    # sequential execution with the best config first = deterministic
+    # successive halving: later, worse trials hit populated rung cutoffs
+    analysis = tune.run(trainable,
+                        config={"q": tune.grid_search([8, 4, 2, 1])},
+                        metric="score", mode="max", scheduler=sched,
+                        max_concurrent_trials=1,
+                        local_dir=str(tmp_path), verbose=0)
+    iters = {t.config["q"]: len(t.results) for t in analysis.trials}
+    # the best trial must survive to the end, worse ones must be cut early
+    assert iters[8] == 20
+    assert iters[1] < 20 and iters[2] < 20
+
+
+def test_median_stopping(tmp_path):
+    def trainable(config):
+        for i in range(15):
+            tune.report(score=config["q"] + i * config["q"])
+
+    sched = tune.MedianStoppingRule(grace_period=3, min_samples_required=2)
+    analysis = tune.run(trainable, config={"q": tune.grid_search([1, 5, 10])},
+                        metric="score", mode="max", scheduler=sched,
+                        max_concurrent_trials=3, local_dir=str(tmp_path),
+                        verbose=0)
+    assert len(analysis.trials) == 3
+
+
+def test_pbt_exploits(tmp_path):
+    class PBTTrainable(tune.Trainable):
+        def setup(self, config):
+            self.weight = 0.0
+
+        def step(self):
+            self.weight += self.config["lr"]
+            return {"score": self.weight, "done": self.iteration >= 14}
+
+        def save_checkpoint(self, d):
+            return {"weight": self.weight}
+
+        def load_checkpoint(self, data):
+            self.weight = data["weight"]
+
+    sched = tune.PopulationBasedTraining(
+        perturbation_interval=3, hyperparam_mutations={"lr": [0.1, 1.0, 10.0]},
+        seed=0)
+    analysis = tune.run(PBTTrainable,
+                        config={"lr": tune.choice([0.1, 1.0, 10.0])},
+                        num_samples=4, metric="score", mode="max",
+                        scheduler=sched, checkpoint_freq=1,
+                        max_concurrent_trials=4, local_dir=str(tmp_path),
+                        verbose=0, seed=1)
+    assert all(t.status == TERMINATED for t in analysis.trials)
+    # at least one trial must have ended above the pure-0.1-lr trajectory,
+    # proving exploit/explore happened or a good config won
+    best = analysis.get_best_trial()
+    assert best.last_result["score"] > 0.1 * 15
+
+
+# ---------------------------------------------------------------- tuner API
+def test_tuner_result_grid(tmp_path):
+    def trainable(config):
+        tune.report(loss=(config["x"] - 3) ** 2)
+
+    from ray_tpu.air.config import RunConfig
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([0, 3, 7])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min"),
+        run_config=RunConfig(storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 3
+    best = grid.get_best_result()
+    assert best.metrics["loss"] == 0
+    df = grid.get_dataframe()
+    assert len(df) == 3 and "loss" in df.columns
+
+
+def test_experiment_state_saved_and_resume(tmp_path):
+    def trainable(config):
+        for i in range(3):
+            tune.report(v=i)
+
+    analysis = tune.run(trainable, config={"x": tune.grid_search([1, 2])},
+                        metric="v", mode="max", name="exp1",
+                        local_dir=str(tmp_path), verbose=0)
+    exp_dir = os.path.join(str(tmp_path), "exp1")
+    assert os.path.exists(os.path.join(exp_dir, "experiment_state.json"))
+    # resume: all trials are TERMINATED so nothing re-runs
+    analysis2 = tune.run(trainable, metric="v", mode="max",
+                         local_dir=str(tmp_path), resume_from=exp_dir,
+                         verbose=0)
+    assert len(analysis2.trials) == 2
+    assert all(t.status == TERMINATED for t in analysis2.trials)
+
+
+def test_loggers_write_files(tmp_path):
+    def trainable(config):
+        for i in range(3):
+            tune.report(metric=i)
+
+    analysis = tune.run(trainable, config={}, metric="metric", mode="max",
+                        local_dir=str(tmp_path), verbose=1)
+    logdir = analysis.trials[0].logdir
+    assert os.path.exists(os.path.join(logdir, "result.json"))
+    assert os.path.exists(os.path.join(logdir, "progress.csv"))
+
+
+def test_concurrency_limiter_and_searcher():
+    gen = tune.BasicVariantGenerator({"x": tune.randint(0, 10)},
+                                     num_samples=5, seed=0)
+    limited = tune.ConcurrencyLimiter(gen, max_concurrent=2)
+    a = limited.suggest("t1")
+    b = limited.suggest("t2")
+    assert a is not None and b is not None
+    assert limited.suggest("t3") is None  # capped
+    limited.on_trial_complete("t1")
+    assert limited.suggest("t3") is not None
