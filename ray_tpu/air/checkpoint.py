@@ -42,7 +42,8 @@ class Checkpoint:
 
     def to_dict(self) -> Dict[str, Any]:
         if self._data is not None:
-            return self._data
+            # shallow copy: caller mutation must not corrupt the checkpoint
+            return dict(self._data)
         return self._load_directory(self._directory)
 
     def to_directory(self, path: Optional[str] = None) -> str:

@@ -56,7 +56,7 @@ class TransformerConfig:
 
 def init_params(key: jax.Array, cfg: TransformerConfig) -> Dict[str, Any]:
     """Initialize the parameter pytree (float32 master copy)."""
-    keys = jax.random.split(key, 4 + cfg.n_layers)
+    k_embed, k_head, k_layers = jax.random.split(key, 3)
     d, h, kvh, hd, f = (cfg.d_model, cfg.n_heads, cfg.kv_heads, cfg.head_dim,
                         cfg.ff_dim)
 
@@ -81,13 +81,13 @@ def init_params(key: jax.Array, cfg: TransformerConfig) -> Dict[str, Any]:
             "ln2": jnp.ones((d,), jnp.float32),
         }
 
-    layer_keys = jax.random.split(keys[3], cfg.n_layers)
+    layer_keys = jax.random.split(k_layers, cfg.n_layers)
     return {
-        "embed": jax.random.normal(keys[0], (cfg.vocab_size, d),
+        "embed": jax.random.normal(k_embed, (cfg.vocab_size, d),
                                    jnp.float32) * 0.02,
         "blocks": jax.vmap(layer)(layer_keys),      # stacked: [L, ...]
         "ln_f": jnp.ones((d,), jnp.float32),
-        "lm_head": dense(keys[2], (d, cfg.vocab_size), d),
+        "lm_head": dense(k_head, (d, cfg.vocab_size), d),
     }
 
 
@@ -196,24 +196,28 @@ def backbone(params: Dict[str, Any], tokens: jax.Array,
     return x
 
 
-def apply(params: Dict[str, Any], tokens: jax.Array,
-          cfg: TransformerConfig, mesh: Optional[Mesh] = None) -> jax.Array:
-    """tokens: [B, L] int32 -> logits [B, L, vocab] (float32)."""
-    x = backbone(params, tokens, cfg, mesh)
+def head(params: Dict[str, Any], x: jax.Array,
+         cfg: TransformerConfig) -> jax.Array:
+    """Final norm + lm-head projection -> float32 logits. The single logits
+    path shared by inference (``apply``) and training (``head_and_loss``)."""
     x = _rmsnorm(x, params["ln_f"])
     logits = jnp.einsum("bld,dv->blv", x,
                         params["lm_head"].astype(cfg.dtype))
     return logits.astype(jnp.float32)
 
 
+def apply(params: Dict[str, Any], tokens: jax.Array,
+          cfg: TransformerConfig, mesh: Optional[Mesh] = None) -> jax.Array:
+    """tokens: [B, L] int32 -> logits [B, L, vocab] (float32)."""
+    x = backbone(params, tokens, cfg, mesh)
+    return head(params, x, cfg)
+
+
 def head_and_loss(params, x: jax.Array, targets: jax.Array,
                   cfg: TransformerConfig) -> jax.Array:
     """Final norm + lm head + next-token cross entropy, shared by the scan
     path (``loss_fn``) and the pipeline-parallel path (train.step)."""
-    x = _rmsnorm(x, params["ln_f"])
-    logits = jnp.einsum("bld,dv->blv", x,
-                        params["lm_head"].astype(cfg.dtype))
-    logits = logits.astype(jnp.float32)
+    logits = head(params, x, cfg)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return jnp.mean(nll)

@@ -94,8 +94,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     def _finalize():
         l = jnp.maximum(l_scr[:, :1], 1e-30)
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse = m_scr[:, :1] + jnp.log(l)
-        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+        # lse is stored compact [BH, Lq, 1]: same column orientation as the
+        # scratch stats, single lane (Mosaic allows full-dim lane blocks).
+        lse_ref[0] = m_scr[:, :1] + jnp.log(l)                # [bq, 1]
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
@@ -119,11 +120,11 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, Lq, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, Lq, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Lq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -157,8 +158,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, :1]
-        delta = delta_ref[0][:, :1]
+        lse = lse_ref[0]                                      # [bq, 1]
+        delta = delta_ref[0]                                  # [bq, 1]
         kv_valid = (ik * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_k, 1), 0)) < seq_k
         k = jnp.where(kv_valid, k, 0.0)
@@ -204,8 +205,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, :1]
-        delta = delta_ref[0][:, :1]
+        lse = lse_ref[0]                                      # [bq, 1]
+        delta = delta_ref[0]                                  # [bq, 1]
         # Pad *query* rows of a ragged last Q block would contaminate the
         # dk/dv sums (they reduce over q rows); zero the sources and mask p.
         q_valid = (iq * block_q + jax.lax.broadcasted_iota(
@@ -250,8 +251,8 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, g):
     nk = pl.cdiv(Lk, block_k)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)                                   # [BH, Lq]
-    lse_b = jnp.broadcast_to(lse[:, :, None], (BH, Lq, _LANES))
-    delta_b = jnp.broadcast_to(delta[:, :, None], (BH, Lq, _LANES))
+    lse_c = lse[:, :, None]                                    # [BH, Lq, 1]
+    delta_c = delta[:, :, None]
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
@@ -263,14 +264,14 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, g):
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, Lq, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse_b, delta_b)
+    )(q, k, v, do, lse_c, delta_c)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
@@ -282,8 +283,8 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, g):
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
@@ -298,7 +299,7 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, g):
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse_b, delta_b)
+    )(q, k, v, do, lse_c, delta_c)
     return dq, dk, dv
 
 

@@ -40,9 +40,14 @@ class RayTrainWorker:
             import jax
             devs = jax.devices()
             if len(devs) >= self.world_size:
+                # Each worker gets a disjoint slice of the host's devices for
+                # its intra-worker mesh; the data-parallel split ACROSS
+                # workers is the collective group's job. (All workers sharing
+                # one mesh would duplicate compute on the same devices.)
+                per = len(devs) // self.world_size
+                local = devs[self.rank * per:(self.rank + 1) * per]
                 from ray_tpu.parallel import MeshConfig, build_mesh
-                mesh = build_mesh(MeshConfig(data=self.world_size),
-                                  devs[:self.world_size])
+                mesh = build_mesh(MeshConfig(data=len(local)), local)
         except Exception:
             mesh = None
         self.session = session_mod._init_session(
@@ -76,8 +81,13 @@ class RayTrainWorker:
         self.thread.start()
         return self.rank
 
-    def next_result(self, timeout: float = 300.0):
-        """Block until the next reported result (or completion sentinel)."""
+    def next_result(self, timeout: Optional[float] = None):
+        """Block until the next reported result (or completion sentinel).
+
+        ``timeout=None`` blocks indefinitely: a slow epoch is not a failure.
+        Worker death is still detected (the actor call raises), and the loop
+        thread's completion sentinel always arrives via ``finally``.
+        """
         import queue as _q
         try:
             item = self.session.results.get(timeout=timeout)
@@ -101,11 +111,13 @@ class BackendExecutor:
     def __init__(self, num_workers: int,
                  resources_per_worker: Dict[str, float],
                  placement_strategy: str = "PACK",
-                 collective_backend: Optional[str] = None):
+                 collective_backend: Optional[str] = None,
+                 results_timeout_s: Optional[float] = None):
         self.num_workers = num_workers
         self.resources_per_worker = resources_per_worker
         self.placement_strategy = placement_strategy
         self.collective_backend = collective_backend
+        self.results_timeout_s = results_timeout_s
         self.pg = None
         self.workers: List[Any] = []
         self.group_name: Optional[str] = None
@@ -147,7 +159,7 @@ class BackendExecutor:
                                     self.group_name)
             for w in self.workers])
 
-    def get_next_results(self, timeout: float = 300.0):
+    def get_next_results(self, timeout: Optional[float] = None):
         """One result per still-running worker, or None once all finished.
 
         Workers that already hit their completion sentinel are not polled
@@ -159,8 +171,10 @@ class BackendExecutor:
                 if i not in self._finished]
         if not live:
             return None
+        timeout = timeout if timeout is not None else self.results_timeout_s
         refs = [w.next_result.remote(timeout) for _, w in live]
-        results = ray_tpu.get(refs, timeout=timeout + 30)
+        results = ray_tpu.get(
+            refs, timeout=None if timeout is None else timeout + 30)
         out = []
         for (i, _), r in zip(live, results):
             if r == _FINISHED:

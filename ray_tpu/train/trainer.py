@@ -29,13 +29,15 @@ class JaxTrainer:
                  scaling_config: Optional[ScalingConfig] = None,
                  run_config: Optional[RunConfig] = None,
                  collective_backend: Optional[str] = "xla",
-                 resume_from_checkpoint: Optional[Checkpoint] = None):
+                 resume_from_checkpoint: Optional[Checkpoint] = None,
+                 results_timeout_s: Optional[float] = None):
         self._train_loop = train_loop_per_worker
         self._config = train_loop_config or {}
         self.scaling_config = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
         self._collective_backend = collective_backend
         self._resume_from = resume_from_checkpoint
+        self._results_timeout_s = results_timeout_s
 
     def fit(self) -> Result:
         if not ray_tpu.is_initialized():
@@ -51,7 +53,8 @@ class JaxTrainer:
                 self.scaling_config.num_workers,
                 self.scaling_config.worker_resources(),
                 self.scaling_config.placement_strategy,
-                self._collective_backend)
+                self._collective_backend,
+                results_timeout_s=self._results_timeout_s)
             try:
                 executor.start()
                 executor.start_training(self._train_loop, self._config,
