@@ -42,8 +42,10 @@ class Checkpoint:
 
     def to_dict(self) -> Dict[str, Any]:
         if self._data is not None:
-            # shallow copy: caller mutation must not corrupt the checkpoint
-            return dict(self._data)
+            # Copy the dict *containers* recursively so caller mutation of
+            # any nesting level cannot corrupt the stored checkpoint. Leaves
+            # (jax arrays are immutable) are shared, not copied.
+            return _copy_containers(self._data)
         return self._load_directory(self._directory)
 
     def to_directory(self, path: Optional[str] = None) -> str:
@@ -108,6 +110,14 @@ class Checkpoint:
             with open(arrays_path + ".pkl", "rb") as f:
                 data.update(pickle.load(f))
         return data
+
+
+def _copy_containers(v: Any) -> Any:
+    if isinstance(v, dict):
+        return {k: _copy_containers(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_copy_containers(x) for x in v]
+    return v
 
 
 def _is_array_tree(v: Any) -> bool:

@@ -1,0 +1,24 @@
+"""ray_tpu.data — distributed datasets over the object store.
+
+Capability parity with ``python/ray/data/``: block-based Datasets with lazy
+fused execution, task/actor-pool compute, two-phase shuffle/sort/groupby,
+file IO, windowed pipelines. TPU-native: ``iter_jax_batches`` feeds sharded
+device arrays directly onto a mesh.
+"""
+
+from ray_tpu.data.block import BlockAccessor
+from ray_tpu.data.dataset import (ActorPoolStrategy, Dataset, GroupedData,
+                                  TaskPoolStrategy)
+from ray_tpu.data.dataset_pipeline import DatasetPipeline
+from ray_tpu.data.read_api import (from_arrow, from_items, from_numpy,
+                                   from_pandas, range, range_table,
+                                   read_binary_files, read_csv, read_json,
+                                   read_numpy, read_parquet, read_text)
+
+__all__ = [
+    "Dataset", "DatasetPipeline", "GroupedData", "BlockAccessor",
+    "ActorPoolStrategy", "TaskPoolStrategy",
+    "from_items", "from_pandas", "from_arrow", "from_numpy",
+    "range", "range_table", "read_csv", "read_parquet", "read_json",
+    "read_numpy", "read_text", "read_binary_files",
+]

@@ -38,14 +38,18 @@ class RayTrainWorker:
         mesh = None
         try:
             import jax
-            devs = jax.devices()
-            if len(devs) >= self.world_size:
-                # Each worker gets a disjoint slice of the host's devices for
-                # its intra-worker mesh; the data-parallel split ACROSS
-                # workers is the collective group's job. (All workers sharing
-                # one mesh would duplicate compute on the same devices.)
-                per = len(devs) // self.world_size
-                local = devs[self.rank * per:(self.rank + 1) * per]
+            # Each worker gets a disjoint slice of ITS HOST's devices for its
+            # intra-worker mesh; the data-parallel split ACROSS workers is the
+            # collective group's job. Use local devices + the worker's rank
+            # among co-hosted workers (global rank would misalign slices when
+            # workers span hosts).
+            devs = jax.local_devices()
+            hosts = max(1, jax.process_count())
+            workers_per_host = max(1, self.world_size // hosts)
+            local_rank = self.rank % workers_per_host
+            if len(devs) >= workers_per_host:
+                per = len(devs) // workers_per_host
+                local = devs[local_rank * per:(local_rank + 1) * per]
                 from ray_tpu.parallel import MeshConfig, build_mesh
                 mesh = build_mesh(MeshConfig(data=len(local)), local)
         except Exception:

@@ -1,0 +1,255 @@
+"""Tests for ray_tpu.data (mirrors the reference's data/tests strategy:
+transforms, shuffle/sort/groupby, IO round trips, splits, pipelines)."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _ray():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=8)
+    yield
+
+
+def test_range_and_count():
+    ds = rd.range(100, parallelism=5)
+    assert ds.count() == 100
+    assert ds.num_blocks() == 5
+    assert ds.take(3) == [0, 1, 2]
+
+
+def test_from_items_map_filter_flat_map():
+    ds = rd.from_items(list(range(20)))
+    out = (ds.map(lambda x: x * 2)
+             .filter(lambda x: x % 4 == 0)
+             .flat_map(lambda x: [x, x + 1]))
+    rows = out.take_all()
+    assert rows[:4] == [0, 1, 4, 5]
+    assert out.count() == 20
+
+
+def test_stage_fusion_single_task_per_block():
+    # consecutive one-to-one stages must fuse: the plan has 3 stages but
+    # execution yields exactly num_blocks output refs
+    ds = rd.range(10, parallelism=2).map(lambda x: x + 1).filter(
+        lambda x: True).map(lambda x: x * 2)
+    refs = ds.get_internal_block_refs()
+    assert len(refs) == 2
+    assert ds.take_all() == [(i + 1) * 2 for i in range(10)]
+
+
+def test_map_batches_pandas_and_numpy():
+    df = pd.DataFrame({"a": range(10), "b": range(10)})
+    ds = rd.from_pandas(df)
+    out = ds.map_batches(lambda d: d.assign(c=d.a + d.b),
+                         batch_format="pandas")
+    assert out.to_pandas()["c"].tolist() == [2 * i for i in range(10)]
+
+    out2 = rd.range_table(8).map_batches(
+        lambda batch: {"value": batch["value"] * 3}, batch_format="numpy",
+        batch_size=3)
+    assert out2.to_pandas()["value"].tolist() == [3 * i for i in range(8)]
+
+
+def test_column_ops():
+    ds = rd.range_table(5).add_column("sq", lambda df: df["value"] ** 2)
+    assert ds.select_columns(["sq"]).to_pandas()["sq"].tolist() == [
+        0, 1, 4, 9, 16]
+    assert ds.rename_columns({"sq": "square"}).columns() == [
+        "value", "square"]
+    assert ds.drop_columns(["value"]).columns() == ["sq"]
+
+
+def test_repartition():
+    ds = rd.range(100, parallelism=10).repartition(3)
+    assert ds.num_blocks() == 3
+    assert ds.count() == 100
+    assert sorted(ds.take_all()) == list(range(100))
+
+
+def test_random_shuffle_preserves_multiset():
+    ds = rd.range(200, parallelism=4).random_shuffle(seed=7)
+    rows = ds.take_all()
+    assert sorted(rows) == list(range(200))
+    assert rows != list(range(200))  # astronomically unlikely to be sorted
+
+
+def test_sort_simple_and_tabular():
+    import random as _r
+    items = list(range(50))
+    _r.Random(3).shuffle(items)
+    ds = rd.from_items(items, parallelism=4).sort()
+    assert ds.take_all() == list(range(50))
+
+    df = pd.DataFrame({"k": items, "v": [i * 2 for i in items]})
+    ds2 = rd.from_pandas(df).sort("k")
+    assert ds2.to_pandas()["k"].tolist() == list(range(50))
+
+    ds3 = rd.from_items(items, parallelism=4).sort(descending=True)
+    assert ds3.take_all() == list(range(49, -1, -1))
+
+
+def test_groupby_aggregates():
+    df = pd.DataFrame({"g": [i % 3 for i in range(30)],
+                       "x": list(range(30))})
+    ds = rd.from_pandas(df)
+    out = ds.groupby("g").sum("x").to_pandas().sort_values("g")
+    expected = df.groupby("g")["x"].sum()
+    assert out["sum(x)"].tolist() == expected.tolist()
+
+    cnt = ds.groupby("g").count().to_pandas().sort_values("g")
+    assert cnt["count()"].tolist() == [10, 10, 10]
+
+    mx = ds.groupby("g").max("x").to_pandas().sort_values("g")
+    assert mx["max(x)"].tolist() == [27, 28, 29]
+
+
+def test_groupby_map_groups():
+    ds = rd.from_items([{"g": i % 2, "x": i} for i in range(10)])
+    out = ds.groupby(lambda r: r["g"]).map_groups(
+        lambda block: [{"g": block.iloc[0]["g"], "n": len(block)}])
+    rows = sorted(out.take_all(), key=lambda r: r["g"])
+    assert rows == [{"g": 0, "n": 5}, {"g": 1, "n": 5}]
+
+
+def test_global_aggregates():
+    ds = rd.range(10)
+    assert ds.sum() == 45
+    assert ds.min() == 0
+    assert ds.max() == 9
+    assert ds.mean() == 4.5
+    tab = rd.range_table(10)
+    assert tab.sum("value") == 45
+
+
+def test_zip_and_union():
+    a = rd.range(5)
+    b = rd.range(5).map(lambda x: x * 10)
+    z = a.zip(b)
+    assert z.take_all() == [(i, i * 10) for i in range(5)]
+    u = a.union(b)
+    assert sorted(u.take_all()) == sorted(
+        list(range(5)) + [i * 10 for i in range(5)])
+
+
+def test_limit_take_show(capsys):
+    ds = rd.range(100, parallelism=4)
+    assert ds.limit(7).count() == 7
+    ds.show(2)
+    assert capsys.readouterr().out == "0\n1\n"
+
+
+def test_split_and_split_at_indices():
+    ds = rd.range(30, parallelism=6)
+    parts = ds.split(3)
+    assert len(parts) == 3
+    assert sum(p.count() for p in parts) == 30
+    eq = ds.split(4, equal=True)
+    counts = [p.count() for p in eq]
+    assert counts[:3] == [7, 7, 7] and sum(counts) == 30
+
+    a, b = ds.split_at_indices([10])
+    assert a.count() == 10 and b.count() == 20
+
+
+def test_train_test_split():
+    tr, te = rd.range(100).train_test_split(0.2)
+    assert tr.count() == 80 and te.count() == 20
+
+
+def test_iter_batches_formats():
+    ds = rd.range_table(25)
+    batches = list(ds.iter_batches(batch_size=10, batch_format="pandas"))
+    assert [len(b) for b in batches] == [10, 10, 5]
+    npb = list(ds.iter_batches(batch_size=25, batch_format="numpy"))
+    assert isinstance(npb[0], np.ndarray) or isinstance(npb[0], dict)
+    dropped = list(ds.iter_batches(batch_size=10, drop_last=True))
+    assert [len(b) for b in dropped] == [10, 10]
+
+
+def test_iter_torch_and_jax_batches():
+    ds = rd.range_table(8)
+    tb = next(ds.iter_torch_batches(batch_size=8))
+    import torch
+    t = tb if not isinstance(tb, dict) else tb["value"]
+    assert isinstance(t, torch.Tensor) and t.shape[0] == 8
+
+    jb = next(ds.iter_jax_batches(batch_size=8))
+    import jax
+    j = jb if not isinstance(jb, dict) else jb["value"]
+    assert isinstance(j, jax.Array) and j.shape[0] == 8
+
+
+def test_local_shuffle_buffer():
+    rows = list(rd.range(50).iter_batches(
+        batch_size=50, batch_format="numpy",
+        local_shuffle_buffer_size=20, local_shuffle_seed=1))[0]
+    assert sorted(rows.tolist()) == list(range(50))
+    assert rows.tolist() != list(range(50))
+
+
+def test_io_roundtrips(tmp_path):
+    df = pd.DataFrame({"a": range(20), "b": [f"s{i}" for i in range(20)]})
+    ds = rd.from_pandas(df).repartition(3)
+
+    pq = str(tmp_path / "pq")
+    ds.write_parquet(pq)
+    back = rd.read_parquet(pq)
+    assert back.count() == 20
+    assert sorted(back.to_pandas()["a"].tolist()) == list(range(20))
+
+    cs = str(tmp_path / "csv")
+    ds.write_csv(cs)
+    assert rd.read_csv(cs).count() == 20
+
+    js = str(tmp_path / "json")
+    ds.write_json(js)
+    assert rd.read_json(js).count() == 20
+
+    npdir = str(tmp_path / "np")
+    rd.range_table(10).write_numpy(npdir, column="value")
+    assert rd.read_numpy(npdir).count() == 10
+
+
+def test_read_text_binary(tmp_path):
+    p = tmp_path / "t.txt"
+    p.write_text("a\nb\nc\n")
+    assert rd.read_text(str(p)).take_all() == ["a", "b", "c"]
+    assert rd.read_binary_files(str(p)).take_all() == [b"a\nb\nc\n"]
+
+
+def test_actor_pool_compute():
+    ds = rd.range(40, parallelism=4).map(
+        lambda x: x + 1, compute=rd.ActorPoolStrategy(min_size=2))
+    assert sorted(ds.take_all()) == list(range(1, 41))
+
+
+def test_pipeline_window_repeat():
+    pipe = rd.range(20, parallelism=4).window(blocks_per_window=2)
+    assert pipe.count() == 20
+    rows = pipe.map(lambda x: x * 2).take(5)
+    assert rows == [0, 2, 4, 6, 8]
+
+    rep = rd.range(5).repeat(3)
+    assert rep.count() == 15
+    epochs = list(rep.iter_epochs())
+    assert len(epochs) == 3 and epochs[0].count() == 5
+
+
+def test_pipeline_split():
+    pipe = rd.range(20, parallelism=4).window(blocks_per_window=2)
+    shards = pipe.split(2)
+    assert sum(s.count() for s in shards) == 20
+
+
+def test_random_sample():
+    ds = rd.range(1000).random_sample(0.1, seed=5)
+    n = ds.count()
+    assert 50 < n < 200
