@@ -45,7 +45,7 @@ class RayTrainWorker:
             # workers span hosts).
             devs = jax.local_devices()
             hosts = max(1, jax.process_count())
-            workers_per_host = max(1, self.world_size // hosts)
+            workers_per_host = max(1, -(-self.world_size // hosts))
             local_rank = self.rank % workers_per_host
             if len(devs) >= workers_per_host:
                 per = len(devs) // workers_per_host

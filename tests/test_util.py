@@ -1,0 +1,181 @@
+"""Tests for ray_tpu.util: ActorPool, Queue, multiprocessing Pool.
+
+Models the reference's tests for ``python/ray/util/actor_pool.py``,
+``util/queue.py`` and ``util/multiprocessing``.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool
+from ray_tpu.util.multiprocessing import Pool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@ray_tpu.remote
+class _Doubler:
+    def double(self, v):
+        return 2 * v
+
+
+@pytest.fixture
+def pool4(ray_start_regular):
+    return ActorPool([_Doubler.remote() for _ in range(4)])
+
+
+def test_actor_pool_map_ordered(ray_start_regular, pool4):
+    out = list(pool4.map(lambda a, v: a.double.remote(v), range(10)))
+    assert out == [2 * i for i in range(10)]
+
+
+def test_actor_pool_map_unordered(ray_start_regular, pool4):
+    out = list(pool4.map_unordered(lambda a, v: a.double.remote(v), range(10)))
+    assert sorted(out) == [2 * i for i in range(10)]
+
+
+def test_actor_pool_submit_get_next(ray_start_regular, pool4):
+    for i in range(6):
+        pool4.submit(lambda a, v: a.double.remote(v), i)
+    assert pool4.has_next()
+    assert [pool4.get_next() for _ in range(6)] == [0, 2, 4, 6, 8, 10]
+    assert not pool4.has_next()
+    with pytest.raises(StopIteration):
+        pool4.get_next()
+
+
+def test_actor_pool_more_tasks_than_actors(ray_start_regular):
+    pool = ActorPool([_Doubler.remote()])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(5)))
+    assert out == [0, 2, 4, 6, 8]
+
+
+def test_actor_pool_push_pop(ray_start_regular, pool4):
+    a = pool4.pop_idle()
+    assert a is not None
+    pool4.push(a)
+    with pytest.raises(ValueError):
+        pool4.push(a)
+
+
+def test_queue_basic(ray_start_regular):
+    q = Queue()
+    assert q.empty()
+    q.put(1)
+    q.put(2)
+    assert q.qsize() == 2
+    assert q.get() == 1
+    assert q.get() == 2
+    assert q.empty()
+
+
+def test_queue_maxsize_and_nowait(ray_start_regular):
+    q = Queue(maxsize=2)
+    q.put_nowait(1)
+    q.put_nowait(2)
+    assert q.full()
+    with pytest.raises(Full):
+        q.put_nowait(3)
+    with pytest.raises(Full):
+        q.put(3, timeout=0.05)
+    assert q.get_nowait() == 1
+    q.put(3)
+    assert q.get_nowait_batch(2) == [2, 3]
+    with pytest.raises(Empty):
+        q.get_nowait()
+    with pytest.raises(Empty):
+        q.get(timeout=0.05)
+
+
+def test_queue_cross_task(ray_start_regular):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return n
+
+    ray_tpu.get(producer.remote(q, 5))
+    assert [q.get(timeout=5) for _ in range(5)] == list(range(5))
+
+
+def _sq(x):
+    return x * x
+
+
+def _add(x, y):
+    return x + y
+
+
+def test_mp_pool_map(ray_start_regular):
+    with Pool(processes=4) as p:
+        assert p.map(_sq, range(10)) == [i * i for i in range(10)]
+
+
+def test_mp_pool_apply_and_starmap(ray_start_regular):
+    with Pool(processes=2) as p:
+        assert p.apply(_add, (3, 4)) == 7
+        r = p.apply_async(_add, (1, 2))
+        assert r.get(timeout=30) == 3
+        assert p.starmap(_add, [(1, 2), (3, 4)]) == [3, 7]
+
+
+def test_mp_pool_imap(ray_start_regular):
+    with Pool(processes=2) as p:
+        assert list(p.imap(_sq, range(6), chunksize=2)) == [i * i for i in range(6)]
+        assert sorted(p.imap_unordered(_sq, range(6))) == sorted(
+            i * i for i in range(6))
+
+
+def _boom(x):
+    raise RuntimeError("boom")
+
+
+def test_mp_pool_error_propagates(ray_start_regular):
+    with Pool(processes=2) as p:
+        with pytest.raises(Exception):
+            p.map(_boom, [1])
+
+
+def test_mp_pool_closed_rejects(ray_start_regular):
+    p = Pool(processes=1)
+    p.close()
+    with pytest.raises(ValueError):
+        p.map(_sq, [1])
+
+
+@ray_tpu.remote
+class _Flaky:
+    def work(self, v):
+        if v == 1:
+            raise RuntimeError("bad input")
+        return v
+
+
+def test_actor_pool_survives_task_error(ray_start_regular):
+    pool = ActorPool([_Flaky.remote()])
+    pool.submit(lambda a, v: a.work.remote(v), 1)
+    pool.submit(lambda a, v: a.work.remote(v), 2)
+    with pytest.raises(Exception):
+        pool.get_next()
+    # The actor was returned to the pool before the error re-raised, so the
+    # queued submit still runs.
+    assert pool.get_next() == 2
+
+
+def test_mp_pool_async_callback_fires_without_get(ray_start_regular):
+    import time as _time
+    seen = []
+    with Pool(processes=2) as p:
+        p.map_async(_sq, [1, 2, 3], chunksize=3, callback=seen.append)
+        deadline = _time.monotonic() + 30
+        while not seen and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+    assert seen == [[1, 4, 9]]
+
+
+def test_mp_pool_imap_checks_closed_at_call_time(ray_start_regular):
+    p = Pool(processes=1)
+    p.close()
+    with pytest.raises(ValueError):
+        p.imap(_sq, [1])
