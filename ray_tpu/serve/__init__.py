@@ -1,0 +1,16 @@
+"""ray_tpu.serve — model serving on actors (reference: python/ray/serve/)."""
+
+from ray_tpu.serve.api import (Application, Deployment,  # noqa: F401
+                               delete, deployment, get_deployment_handle,
+                               run, shutdown, start, start_http_proxy,
+                               status)
+from ray_tpu.serve.batching import batch  # noqa: F401
+from ray_tpu.serve.config import (AutoscalingConfig,  # noqa: F401
+                                  DeploymentConfig)
+from ray_tpu.serve.handle import (DeploymentHandle,  # noqa: F401
+                                  DeploymentResponse)
+
+__all__ = ["deployment", "run", "start", "shutdown", "delete", "status",
+           "batch", "start_http_proxy", "get_deployment_handle",
+           "Application", "Deployment", "DeploymentHandle",
+           "DeploymentResponse", "DeploymentConfig", "AutoscalingConfig"]

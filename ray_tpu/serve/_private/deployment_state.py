@@ -1,0 +1,195 @@
+"""Deployment reconciliation: target state -> running replica actors.
+
+Parity with ``python/ray/serve/_private/deployment_state.py``: each
+deployment has a target (code version, config, replica count); a reconcile
+step starts/stops replica actors to converge, performs rolling updates when
+the code version changes, reconfigures in place when only user_config
+changes, and replaces dead replicas.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.serve._private.replica import Replica
+from ray_tpu.serve.config import DeploymentConfig
+
+logger = logging.getLogger("ray_tpu.serve")
+
+_replica_counter = itertools.count()
+
+
+class ReplicaInfo:
+    def __init__(self, tag: str, handle, version: str):
+        self.tag = tag
+        self.handle = handle
+        self.version = version
+
+
+class DeploymentState:
+    def __init__(self, name: str):
+        self.name = name
+        self.func_or_class = None
+        self.init_args: Tuple = ()
+        self.init_kwargs: Dict = {}
+        self.config = DeploymentConfig()
+        self.target_version: Optional[str] = None
+        self.target_replicas = 0
+        self.replicas: List[ReplicaInfo] = []
+        self.deleting = False
+        self._last_health_check = 0.0
+
+    # -- target mutations -------------------------------------------------
+
+    def set_target(self, func_or_class, init_args, init_kwargs,
+                   config: DeploymentConfig) -> None:
+        self.func_or_class = func_or_class
+        self.init_args = init_args or ()
+        self.init_kwargs = init_kwargs or {}
+        new_version = config.version_hash(
+            func_or_class, self.init_args, self.init_kwargs)
+        version_changed = new_version != self.target_version
+        user_config_changed = config.user_config != self.config.user_config
+        self.target_version = new_version
+        self.config = config
+        self.target_replicas = (
+            config.autoscaling_config.min_replicas
+            if config.autoscaling_config else config.num_replicas)
+        self.deleting = False
+        if not version_changed and user_config_changed:
+            # In-place reconfigure (reference: lightweight config update).
+            for info in self.replicas:
+                try:
+                    ray_tpu.get(info.handle.reconfigure.remote(
+                        config.user_config))
+                except Exception:
+                    pass
+
+    def set_num_replicas(self, n: int) -> None:
+        cfg = self.config.autoscaling_config
+        if cfg is not None:
+            n = max(cfg.min_replicas, min(cfg.max_replicas, n))
+        self.target_replicas = n
+
+    def delete(self) -> None:
+        self.deleting = True
+        self.target_replicas = 0
+
+    # -- reconciliation ---------------------------------------------------
+
+    def _start_replica(self) -> ReplicaInfo:
+        tag = f"{self.name}#{next(_replica_counter)}"
+        opts = dict(self.config.ray_actor_options)
+        opts.setdefault("max_concurrency",
+                        max(2, self.config.max_concurrent_queries))
+        handle = ray_tpu.remote(Replica).options(**opts).remote(
+            self.name, tag, self.func_or_class, self.init_args,
+            self.init_kwargs, self.config.user_config)
+        return ReplicaInfo(tag, handle, self.target_version)
+
+    def _stop_replica(self, info: ReplicaInfo) -> None:
+        try:
+            ray_tpu.get(info.handle.prepare_for_shutdown.remote(
+                self.config.graceful_shutdown_timeout_s), timeout=None)
+        except Exception:
+            pass
+        try:
+            ray_tpu.kill(info.handle)
+        except Exception:
+            pass
+
+    def _check_health(self) -> List[ReplicaInfo]:
+        """Probe all replicas concurrently; returns the live ones.
+
+        A replica is dead only when its health ref resolves to an error
+        (actor died); a slow-but-running replica whose ref isn't ready
+        within the probe window stays live.  Runs at
+        ``health_check_period_s`` cadence, not every control-loop tick.
+        """
+        import time as _time
+        probes = []
+        for info in self.replicas:
+            try:
+                probes.append((info, info.handle.check_health.remote()))
+            except Exception:
+                probes.append((info, None))
+        refs = [r for _, r in probes if r is not None]
+        if refs:
+            ray_tpu.wait(refs, num_returns=len(refs), timeout=2.0)
+        live = []
+        for info, ref in probes:
+            if ref is None:
+                logger.warning("replica %s unreachable; replacing", info.tag)
+                continue
+            ready, _ = ray_tpu.wait([ref], timeout=0)
+            if not ready:
+                live.append(info)  # slow, not dead
+                continue
+            try:
+                ray_tpu.get(ref, timeout=0.1)
+                live.append(info)
+            except Exception:
+                logger.warning("replica %s died; replacing", info.tag)
+        self._last_health_check = _time.monotonic()
+        return live
+
+    def reconcile(self) -> bool:
+        """One convergence step. Returns True if replica membership changed."""
+        import time as _time
+        changed = False
+
+        # Replace dead replicas (failure recovery) on the configured cadence.
+        if (self.replicas and _time.monotonic() - self._last_health_check
+                >= self.config.health_check_period_s):
+            live = self._check_health()
+            if len(live) != len(self.replicas):
+                changed = True
+            self.replicas = live
+
+        # Rolling update: retire at most one stale replica per step so
+        # capacity never drops by more than one (reference semantics).
+        stale = [r for r in self.replicas if r.version != self.target_version]
+        if stale and self.func_or_class is not None:
+            old = stale[0]
+            if len(self.replicas) <= self.target_replicas:
+                self.replicas.append(self._start_replica())
+            self.replicas.remove(old)
+            self._stop_replica(old)
+            changed = True
+
+        # Scale toward the target count.
+        while len(self.replicas) < self.target_replicas:
+            self.replicas.append(self._start_replica())
+            changed = True
+        while len(self.replicas) > self.target_replicas:
+            info = self.replicas.pop()
+            self._stop_replica(info)
+            changed = True
+        return changed
+
+    # -- introspection ----------------------------------------------------
+
+    def running_replica_handles(self) -> List[Any]:
+        return [r.handle for r in self.replicas]
+
+    def total_ongoing_requests(self) -> float:
+        total = 0.0
+        for info in self.replicas:
+            try:
+                m = ray_tpu.get(info.handle.get_metrics.remote(), timeout=5)
+                total += m["num_ongoing_requests"]
+            except Exception:
+                pass
+        return total
+
+    def status(self) -> dict:
+        return {
+            "name": self.name,
+            "target_replicas": self.target_replicas,
+            "running_replicas": len(self.replicas),
+            "version": self.target_version,
+            "deleting": self.deleting,
+        }

@@ -1,0 +1,129 @@
+"""HTTP ingress for Serve.
+
+Parity with ``python/ray/serve/_private/http_proxy.py``: an actor running
+an HTTP server that maps route prefixes to deployments (table pushed from
+the controller via long-poll) and forwards request bodies through a
+``DeploymentHandle``.  The reference uses uvicorn/ASGI; here the server is
+the stdlib threading HTTP server — ingress is control-path, the data path
+(model execution) stays in replicas.
+
+Request convention: POST body is JSON (or raw bytes if not JSON) passed as
+the single argument; the JSON-serialized return value is the response.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from ray_tpu.serve._private.long_poll import LongPollClient
+from ray_tpu.serve.controller import ROUTE_TABLE_KEY
+from ray_tpu.serve.handle import DeploymentHandle
+
+
+class HTTPProxy:
+    def __init__(self, controller_handle, host: str = "127.0.0.1",
+                 port: int = 0):
+        self._controller = controller_handle
+        self._routes: Dict[str, str] = {}
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._lock = threading.Lock()
+        import ray_tpu
+        self._routes = ray_tpu.get(
+            controller_handle.get_route_table.remote())
+        self._poller = LongPollClient(
+            controller_handle, {ROUTE_TABLE_KEY: self._update_routes})
+
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _dispatch(self, body: Optional[bytes]):
+                path = self.path.split("?")[0].rstrip("/") or "/"
+                name = proxy._match(path)
+                if name is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    self.wfile.write(b'{"error": "no route"}')
+                    return
+                try:
+                    arg = None
+                    if body:
+                        try:
+                            arg = json.loads(body)
+                        except json.JSONDecodeError:
+                            arg = body
+                    handle = proxy._get_handle(name)
+                    result = handle.remote(arg).result(timeout=60)
+                    payload = json.dumps(result).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    self.wfile.write(payload)
+                except Exception as e:
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(
+                        json.dumps({"error": str(e)}).encode())
+
+            def do_GET(self):
+                self._dispatch(None)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                self._dispatch(self.rfile.read(length) if length else None)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="serve-http-proxy")
+        self._thread.start()
+
+    def _update_routes(self, table: Dict[str, str]) -> None:
+        with self._lock:
+            self._routes = dict(table)
+            keep, dropped = {}, []
+            for name, handle in self._handles.items():
+                if name in table.values():
+                    keep[name] = handle
+                else:
+                    dropped.append(handle)
+            self._handles = keep
+        # Shut down routers of dropped handles outside the lock so their
+        # long-poll threads don't leak controller listener slots.
+        for handle in dropped:
+            try:
+                handle.shutdown()
+            except Exception:
+                pass
+
+    def _match(self, path: str) -> Optional[str]:
+        with self._lock:
+            # Longest-prefix match, '/' as catch-all.
+            best = None
+            for prefix, name in self._routes.items():
+                p = prefix.rstrip("/") or "/"
+                if path == p or path.startswith(p + "/") or p == "/":
+                    if best is None or len(p) > len(best[0]):
+                        best = (p, name)
+            return best[1] if best else None
+
+    def _get_handle(self, name: str) -> DeploymentHandle:
+        with self._lock:
+            if name not in self._handles:
+                self._handles[name] = DeploymentHandle(name, self._controller)
+            return self._handles[name]
+
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def shutdown(self) -> None:
+        self._poller.stop()
+        self._server.shutdown()
+        self._server.server_close()

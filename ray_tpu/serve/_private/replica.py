@@ -1,0 +1,88 @@
+"""Replica actor: hosts one copy of a deployment's user callable.
+
+Parity with ``python/ray/serve/_private/replica.py``: runs the user class
+(or function), counts ongoing requests for autoscaling/backpressure,
+supports ``reconfigure(user_config)`` in place, health checks, and
+graceful drain before shutdown.
+
+TPU note: a replica is where compiled inference lives — the user callable
+typically closes over a ``jax.jit``'d function.  Replicas stay alive across
+requests precisely so XLA compilation caches stay warm; a rolling update
+replaces replicas one at a time so the app never serves with a cold cache
+on every replica at once.
+"""
+
+from __future__ import annotations
+
+import inspect
+import threading
+import time
+from typing import Any, Optional
+
+
+class Replica:
+    def __init__(self, deployment_name: str, replica_tag: str,
+                 func_or_class, init_args, init_kwargs,
+                 user_config: Optional[Any] = None):
+        self.deployment_name = deployment_name
+        self.replica_tag = replica_tag
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+        self._draining = False
+        if inspect.isfunction(func_or_class):
+            self._callable = func_or_class
+            self._is_function = True
+        else:
+            self._callable = func_or_class(*init_args, **(init_kwargs or {}))
+            self._is_function = False
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    def reconfigure(self, user_config: Any) -> None:
+        if not self._is_function:
+            reconfigure = getattr(self._callable, "reconfigure", None)
+            if reconfigure is not None:
+                reconfigure(user_config)
+
+    def handle_request(self, method_name: str, args, kwargs) -> Any:
+        with self._lock:
+            if self._draining:
+                raise RuntimeError(
+                    f"Replica {self.replica_tag} is draining")
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if self._is_function:
+                return self._callable(*args, **kwargs)
+            if method_name == "__call__":
+                return self._callable(*args, **kwargs)
+            return getattr(self._callable, method_name)(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def get_metrics(self) -> dict:
+        with self._lock:
+            return {"replica_tag": self.replica_tag,
+                    "num_ongoing_requests": self._ongoing,
+                    "num_total_requests": self._total}
+
+    def check_health(self) -> bool:
+        checker = None if self._is_function else getattr(
+            self._callable, "check_health", None)
+        if checker is not None:
+            checker()
+        return True
+
+    def prepare_for_shutdown(self, timeout_s: float = 20.0) -> bool:
+        """Stop accepting requests and wait for in-flight ones to drain."""
+        with self._lock:
+            self._draining = True
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._ongoing == 0:
+                    return True
+            time.sleep(0.01)
+        return False

@@ -1,0 +1,165 @@
+"""Request router: picks a replica for each request.
+
+Parity with ``python/ray/serve/_private/router.py``: round-robin over
+running replicas while honoring ``max_concurrent_queries`` per replica —
+requests beyond the limit queue in the router until a replica frees up.
+Replica membership updates arrive via long-poll from the controller.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve._private.long_poll import LongPollClient
+from ray_tpu.serve.controller import _replica_key
+
+
+class Router:
+    def __init__(self, controller_handle, deployment_name: str):
+        self._deployment_name = deployment_name
+        self._controller = controller_handle
+        self._lock = threading.Condition()
+        self._replicas: List[Any] = []
+        self._max_concurrent = 100
+        self._in_flight: Dict[str, int] = {}  # replica repr -> count
+        self._rr = 0
+        # Seed synchronously so the first request doesn't race the poller.
+        info = ray_tpu.get(
+            controller_handle.get_replica_handles.remote(deployment_name))
+        self._apply(info)
+        self._poller = LongPollClient(
+            controller_handle,
+            {_replica_key(deployment_name): self._apply})
+
+    def _apply(self, info: dict) -> None:
+        with self._lock:
+            self._replicas = list(info["handles"])
+            self._max_concurrent = info["max_concurrent_queries"]
+            # Drop in-flight counters for replicas no longer in membership
+            # so the dict doesn't grow without bound under churn.
+            current = {repr(r) for r in self._replicas}
+            self._in_flight = {k: v for k, v in self._in_flight.items()
+                               if k in current}
+            self._lock.notify_all()
+
+    def _pick(self, timeout: Optional[float]) -> Any:
+        import time
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                n = len(self._replicas)
+                for i in range(n):
+                    replica = self._replicas[(self._rr + i) % n] if n else None
+                    if replica is None:
+                        break
+                    key = repr(replica)
+                    if self._in_flight.get(key, 0) < self._max_concurrent:
+                        self._rr = (self._rr + i + 1) % n
+                        self._in_flight[key] = self._in_flight.get(key, 0) + 1
+                        return replica
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"No replica of {self._deployment_name!r} available "
+                        f"within timeout")
+                self._lock.wait(remaining if remaining is not None else 1.0)
+
+    def _release(self, replica) -> None:
+        with self._lock:
+            key = repr(replica)
+            self._in_flight[key] = max(0, self._in_flight.get(key, 0) - 1)
+            self._lock.notify_all()
+
+    def assign_request(self, method_name: str, args, kwargs,
+                       timeout: Optional[float] = None):
+        """Submit to a replica; returns the ObjectRef of the result.
+
+        The replica slot is released when the result is consumed via
+        ``resolve`` (or eagerly on submit failure).
+        """
+        replica = self._pick(timeout)
+        try:
+            ref = replica.handle_request.remote(method_name, args, kwargs)
+        except Exception:
+            self._release(replica)
+            raise
+        return _TrackedRef(ref, self, replica, (method_name, args, kwargs))
+
+    def _refresh_membership(self) -> None:
+        """Pull current replicas from the controller (used on retry, when
+        the long-poll update may not have landed yet)."""
+        try:
+            info = ray_tpu.get(self._controller.get_replica_handles.remote(
+                self._deployment_name), timeout=10)
+            self._apply(info)
+        except Exception:
+            pass
+
+    def shutdown(self) -> None:
+        self._poller.stop()
+
+
+class _TrackedRef:
+    """An in-flight request: resolves to the result, releasing its slot.
+
+    If the chosen replica dies before completing (e.g. it was retired by a
+    rolling update or crashed), the request is transparently re-assigned to
+    another replica, like the reference router's dead-replica retry.
+    """
+
+    _MAX_RETRIES = 3
+
+    def __init__(self, ref, router: Router, replica, request):
+        self._ref = ref
+        self._router = router
+        self._replica = replica
+        self._request = request
+        self._released = False
+        self._retries = 0
+
+    def _settle(self) -> None:
+        if not self._released:
+            self._released = True
+            self._router._release(self._replica)
+
+    def result(self, timeout: Optional[float] = None):
+        import ray_tpu.exceptions as exc
+        while True:
+            try:
+                value = ray_tpu.get(self._ref, timeout=timeout)
+            except ray_tpu.GetTimeoutError:
+                # Still executing on the replica — keep its concurrency
+                # slot so backpressure stays correct; a later result()
+                # call settles it.
+                raise
+            except Exception as e:
+                # Replica death / retirement is retryable on another
+                # replica: the request never completed. (User exceptions
+                # arrive wrapped in TaskError and are not retried, except
+                # the replica's own "draining" rejection.)
+                retryable = isinstance(
+                    e, (exc.ActorDiedError, exc.ObjectLostError)) or \
+                    "is draining" in str(e)
+                self._settle()
+                if not retryable or self._retries >= self._MAX_RETRIES:
+                    raise
+                self._retries += 1
+                self._router._refresh_membership()
+                replaced = self._router.assign_request(
+                    *self._request, timeout=30)
+                self._ref = replaced._ref
+                self._replica = replaced._replica
+                self._released = False
+                continue
+            self._settle()
+            return value
+
+    def ref(self):
+        """Expose the raw ObjectRef (releases the slot immediately —
+        callers managing refs directly opt out of backpressure)."""
+        if not self._released:
+            self._released = True
+            self._router._release(self._replica)
+        return self._ref

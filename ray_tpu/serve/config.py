@@ -1,0 +1,68 @@
+"""Serve configuration dataclasses.
+
+Parity with the reference's ``python/ray/serve/config.py`` (DeploymentConfig,
+AutoscalingConfig) — the knobs a deployment exposes: replica counts,
+per-replica concurrency, autoscaling bounds, rolling-update rates, and
+user_config pushed to live replicas.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    """Queue-depth-driven autoscaling (reference:
+    ``serve/_private/autoscaling_policy.py``)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_num_ongoing_requests_per_replica: float = 1.0
+    upscale_delay_s: float = 0.0
+    downscale_delay_s: float = 30.0
+    smoothing_factor: float = 1.0
+
+    def desired_replicas(self, total_ongoing: float, current: int) -> int:
+        if current == 0:
+            return max(1, self.min_replicas)
+        per_replica = total_ongoing / current
+        error = per_replica / max(
+            self.target_num_ongoing_requests_per_replica, 1e-9)
+        desired = current * (1.0 + self.smoothing_factor * (error - 1.0))
+        import math
+        desired = math.ceil(desired - 1e-9)
+        return max(self.min_replicas, min(self.max_replicas, desired))
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_concurrent_queries: int = 100
+    user_config: Optional[Any] = None
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    ray_actor_options: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    health_check_period_s: float = 10.0
+    graceful_shutdown_timeout_s: float = 20.0
+
+    def version_hash(self, func_or_class, init_args, init_kwargs) -> str:
+        """Code/config version: changing it triggers a rolling update;
+        changing only user_config reconfigures replicas in place
+        (reference: deployment_state version semantics).  The hash covers
+        the callable's source (so edited code redeploys) plus init args
+        and actor options."""
+        import hashlib
+        import inspect
+        import pickle
+        try:
+            code = inspect.getsource(func_or_class)
+        except Exception:
+            code = getattr(func_or_class, "__qualname__",
+                           repr(func_or_class))
+        try:
+            payload = pickle.dumps(
+                (code, init_args, init_kwargs, self.ray_actor_options))
+        except Exception:
+            payload = repr((code, init_args, init_kwargs)).encode()
+        return hashlib.sha1(payload).hexdigest()[:12]

@@ -1,0 +1,165 @@
+"""The Serve controller actor.
+
+Parity with ``python/ray/serve/controller.py`` (``ServeController``
+``:59,225``): the single control-loop actor that owns all deployment
+targets, reconciles them to running replica actors
+(`_private/deployment_state.py`), drives queue-metric autoscaling, and
+pushes routing tables to handles/proxies via long-poll
+(`_private/long_poll.py`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ray_tpu.serve._private.deployment_state import DeploymentState
+from ray_tpu.serve._private.long_poll import LongPollHost
+from ray_tpu.serve.config import DeploymentConfig
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+ROUTE_TABLE_KEY = "route_table"
+
+
+def _replica_key(deployment_name: str) -> str:
+    return f"replicas::{deployment_name}"
+
+
+class ServeController:
+    def __init__(self, control_loop_period_s: float = 0.2):
+        self._deployments: Dict[str, DeploymentState] = {}
+        self._routes: Dict[str, str] = {}  # route prefix -> deployment name
+        self._long_poll = LongPollHost()
+        self._lock = threading.RLock()
+        self._period = control_loop_period_s
+        self._shutdown = threading.Event()
+        self._autoscale_state: Dict[str, float] = {}
+        self._loop_thread = threading.Thread(
+            target=self._control_loop, daemon=True, name="serve-control-loop")
+        self._loop_thread.start()
+
+    # -- deploy API --------------------------------------------------------
+
+    def deploy(self, name: str, func_or_class, init_args, init_kwargs,
+               config_dict: dict, route_prefix: Optional[str] = None) -> None:
+        config = DeploymentConfig(**config_dict)
+        with self._lock:
+            state = self._deployments.get(name)
+            if state is None:
+                state = self._deployments[name] = DeploymentState(name)
+            state.set_target(func_or_class, init_args, init_kwargs, config)
+            if route_prefix is not None:
+                # A deployment owns one route: drop any previous prefix so a
+                # retired route stops serving.
+                self._routes = {p: d for p, d in self._routes.items()
+                                if d != name}
+                self._routes[route_prefix] = name
+                self._long_poll.notify_changed(
+                    ROUTE_TABLE_KEY, dict(self._routes))
+            state.reconcile()
+            self._notify_replicas(state)
+
+    def delete_deployment(self, name: str) -> None:
+        with self._lock:
+            state = self._deployments.get(name)
+            if state is None:
+                return
+            state.delete()
+            state.reconcile()
+            self._notify_replicas(state)
+            del self._deployments[name]
+            self._routes = {p: d for p, d in self._routes.items()
+                            if d != name}
+            self._long_poll.notify_changed(ROUTE_TABLE_KEY, dict(self._routes))
+
+    def _notify_replicas(self, state: DeploymentState) -> None:
+        self._long_poll.notify_changed(
+            _replica_key(state.name),
+            {"handles": state.running_replica_handles(),
+             "max_concurrent_queries": state.config.max_concurrent_queries})
+
+    # -- queries -----------------------------------------------------------
+
+    def get_replica_handles(self, name: str):
+        with self._lock:
+            state = self._deployments.get(name)
+            if state is None:
+                raise KeyError(f"No deployment named {name!r}")
+            return {"handles": state.running_replica_handles(),
+                    "max_concurrent_queries": state.config.max_concurrent_queries}
+
+    def get_route_table(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._routes)
+
+    def list_deployments(self) -> Dict[str, dict]:
+        with self._lock:
+            return {n: s.status() for n, s in self._deployments.items()}
+
+    def listen_for_change(self, keys_to_snapshot_ids: Dict[str, int]):
+        # Blocks on the host's condvar; safe because the controller actor
+        # runs with max_concurrency and the control loop is its own thread.
+        return self._long_poll.listen_for_change(keys_to_snapshot_ids)
+
+    # -- control loop ------------------------------------------------------
+
+    def _control_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                self._run_control_loop_once()
+            except Exception:
+                pass
+            self._shutdown.wait(self._period)
+
+    def _run_control_loop_once(self) -> None:
+        with self._lock:
+            states = list(self._deployments.values())
+        for state in states:
+            self._autoscale(state)
+            with self._lock:
+                # A concurrent delete may have removed this deployment
+                # between the snapshot and here; reconciling the stale
+                # state would resurrect (and leak) replicas.
+                if self._deployments.get(state.name) is not state:
+                    continue
+                if state.reconcile():
+                    self._notify_replicas(state)
+
+    def _autoscale(self, state: DeploymentState) -> None:
+        cfg = state.config.autoscaling_config
+        if cfg is None or state.deleting:
+            return
+        ongoing = state.total_ongoing_requests()
+        desired = cfg.desired_replicas(ongoing, max(1, len(state.replicas)))
+        now = time.monotonic()
+        key = state.name
+        if desired > state.target_replicas:
+            # Upscale after upscale_delay_s of sustained demand.
+            first = self._autoscale_state.setdefault(f"{key}:up", now)
+            if now - first >= cfg.upscale_delay_s:
+                state.set_num_replicas(desired)
+                self._autoscale_state.pop(f"{key}:up", None)
+            self._autoscale_state.pop(f"{key}:down", None)
+        elif desired < state.target_replicas:
+            first = self._autoscale_state.setdefault(f"{key}:down", now)
+            if now - first >= cfg.downscale_delay_s:
+                state.set_num_replicas(desired)
+                self._autoscale_state.pop(f"{key}:down", None)
+            self._autoscale_state.pop(f"{key}:up", None)
+        else:
+            self._autoscale_state.pop(f"{key}:up", None)
+            self._autoscale_state.pop(f"{key}:down", None)
+
+    def autoscale_tick(self) -> None:
+        """Force one synchronous autoscale+reconcile pass (for tests)."""
+        self._run_control_loop_once()
+
+    # -- shutdown ----------------------------------------------------------
+
+    def graceful_shutdown(self) -> None:
+        self._shutdown.set()
+        with self._lock:
+            names = list(self._deployments)
+        for name in names:
+            self.delete_deployment(name)

@@ -30,8 +30,10 @@ class ActorPool:
             raise ValueError("ActorPool requires at least one actor")
         # future -> actor that produced it
         self._future_to_actor = {}
-        # ordered bookkeeping: index -> future, next index to submit/return
+        # ordered bookkeeping: index -> future (+ reverse), next index to
+        # submit/return
         self._index_to_future = {}
+        self._future_to_index = {}
         self._next_task_index = 0
         self._next_return_index = 0
         self._pending_submits: List[tuple] = []
@@ -47,6 +49,7 @@ class ActorPool:
             future = fn(actor, value)
             self._future_to_actor[future] = actor
             self._index_to_future[self._next_task_index] = future
+            self._future_to_index[future] = self._next_task_index
             self._next_task_index += 1
         else:
             self._pending_submits.append((fn, value))
@@ -69,6 +72,7 @@ class ActorPool:
         # Return the actor to the pool before ray_tpu.get so a task that
         # raises doesn't leak the actor as busy and wedge pending submits.
         del self._index_to_future[self._next_return_index]
+        del self._future_to_index[future]
         self._next_return_index += 1
         self._return_actor(self._future_to_actor.pop(future))
         return ray_tpu.get(future)
@@ -83,10 +87,9 @@ class ActorPool:
             raise TimeoutError("Timed out waiting for result")
         future = ready[0]
         # Drop it from the ordered index too.
-        for idx, fut in list(self._index_to_future.items()):
-            if fut == future:
-                del self._index_to_future[idx]
-                break
+        idx = self._future_to_index.pop(future, None)
+        if idx is not None:
+            del self._index_to_future[idx]
         self._return_actor(self._future_to_actor.pop(future))
         return ray_tpu.get(future)
 

@@ -15,13 +15,14 @@ from __future__ import annotations
 
 import itertools
 import threading
+# The stdlib contract raises multiprocessing.TimeoutError (a ProcessError
+# subclass), so ported ``except multiprocessing.TimeoutError`` keeps working.
+from multiprocessing import TimeoutError
 from typing import Any, Callable, Iterable, Iterator, List, Optional
 
 import ray_tpu
 
 __all__ = ["Pool", "AsyncResult", "TimeoutError"]
-
-TimeoutError = TimeoutError
 
 
 def _chunk(iterable: Iterable, size: int) -> Iterator[list]:
@@ -124,6 +125,8 @@ class Pool:
             raise ValueError("processes must be at least 1")
         self._processes = processes
         self._closed = False
+        self._terminated = False
+        self._outstanding: List[Any] = []  # refs cancellable by terminate()
         remote_args = dict(ray_remote_args or {})
         self._task = ray_tpu.remote(**remote_args)(_run_chunk) \
             if remote_args else ray_tpu.remote(_run_chunk)
@@ -142,23 +145,44 @@ class Pool:
             return max(1, chunksize)
         return max(1, n_items // (self._processes * 4) or 1)
 
+    def _spawn(self, fn, chunk, star, kwds=None):
+        ref = self._task.remote(fn, chunk, star, kwds or {})
+        if len(self._outstanding) >= 4096:  # prune finished refs
+            _, pending = ray_tpu.wait(
+                self._outstanding, num_returns=len(self._outstanding),
+                timeout=0)
+            self._outstanding = pending
+        self._outstanding.append(ref)
+        return ref
+
     def _submit_all(self, fn, iterable, star, chunksize,
                     kwds=None) -> List[Any]:
         items = list(iterable)
         size = self._chunksize(len(items), chunksize)
-        return [self._task.remote(fn, chunk, star, kwds or {})
+        return [self._spawn(fn, chunk, star, kwds)
                 for chunk in _chunk(items, size)]
 
     def _iter_chunks_bounded(self, fn, iterable, star, chunksize,
-                             ordered: bool) -> Iterator[Any]:
-        """Yield chunk results keeping ≤ ``processes`` chunks in flight."""
-        items = list(iterable)
-        size = self._chunksize(len(items), chunksize)
-        chunks = _chunk(items, size)
+                             ordered: bool, lazy: bool = False) -> Iterator[Any]:
+        """Yield chunk results keeping ≤ ``processes`` chunks in flight.
+
+        ``lazy=True`` (imap) consumes the input iterable incrementally —
+        infinite/streaming iterables work; chunksize then defaults to the
+        stdlib's 1 instead of a len-derived heuristic.
+        """
+        if lazy:
+            size = max(1, chunksize or 1)
+            chunks = _chunk(iterable, size)
+        else:
+            items = list(iterable)
+            size = self._chunksize(len(items), chunksize)
+            chunks = _chunk(items, size)
         in_flight: List[Any] = []
         for chunk in itertools.islice(chunks, self._processes):
-            in_flight.append(self._task.remote(fn, chunk, star, {}))
+            in_flight.append(self._spawn(fn, chunk, star))
         while in_flight:
+            if self._terminated:
+                return
             if ordered:
                 ref, in_flight = in_flight[0], in_flight[1:]
             else:
@@ -166,7 +190,7 @@ class Pool:
                 ref = ready[0]
             nxt = next(chunks, None)
             if nxt is not None:
-                in_flight.append(self._task.remote(fn, nxt, star, {}))
+                in_flight.append(self._spawn(fn, nxt, star))
             yield from ray_tpu.get(ref)
 
     def apply(self, func: Callable, args: tuple = (), kwds: dict = None) -> Any:
@@ -176,7 +200,7 @@ class Pool:
                     kwds: dict = None, callback=None,
                     error_callback=None) -> AsyncResult:
         self._check_running()
-        refs = [self._task.remote(func, [args], True, kwds or {})]
+        refs = [self._spawn(func, [args], True, kwds or {})]
         return AsyncResult(refs, single=True, callback=callback,
                            error_callback=error_callback)
 
@@ -215,19 +239,27 @@ class Pool:
              chunksize: Optional[int] = None) -> Iterator[Any]:
         self._check_running()
         return self._iter_chunks_bounded(
-            func, iterable, False, chunksize, ordered=True)
+            func, iterable, False, chunksize, ordered=True, lazy=True)
 
     def imap_unordered(self, func: Callable, iterable: Iterable,
                        chunksize: Optional[int] = None) -> Iterator[Any]:
         self._check_running()
         return self._iter_chunks_bounded(
-            func, iterable, False, chunksize, ordered=False)
+            func, iterable, False, chunksize, ordered=False, lazy=True)
 
     def close(self) -> None:
         self._closed = True
 
     def terminate(self) -> None:
+        """Close the pool and best-effort cancel outstanding chunk tasks."""
         self._closed = True
+        self._terminated = True
+        for ref in self._outstanding:
+            try:
+                ray_tpu.cancel(ref)
+            except Exception:
+                pass
+        self._outstanding.clear()
 
     def join(self) -> None:
         if not self._closed:

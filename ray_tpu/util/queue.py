@@ -18,19 +18,14 @@ from __future__ import annotations
 import collections
 import threading
 import time
+# Re-export the stdlib exceptions (as the reference does) so existing
+# ``except queue.Empty`` handlers keep matching.
+from queue import Empty, Full
 from typing import Any, List, Optional
 
 import ray_tpu
 
 _POLL_S = 0.005
-
-
-class Empty(Exception):
-    pass
-
-
-class Full(Exception):
-    pass
 
 
 class _QueueActor:
@@ -120,11 +115,21 @@ class Queue:
             timeout = 0.0
         elif timeout is not None and timeout < 0:
             raise ValueError("'timeout' must be a non-negative number")
-        ok, _ = self._poll(
-            lambda: (ray_tpu.get(self.actor.try_put.remote(item)), None),
-            timeout)
-        if not ok:
-            raise Full()
+        # First attempt ships the item; while the queue stays full, poll
+        # the cheap ``full()`` probe instead of re-serializing the payload
+        # every tick, and only re-send once capacity appears.
+        if ray_tpu.get(self.actor.try_put.remote(item)):
+            return
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Full()
+            self._poll(
+                lambda: (not ray_tpu.get(self.actor.full.remote()), None),
+                None if deadline is None
+                else max(0.0, deadline - time.monotonic()))
+            if ray_tpu.get(self.actor.try_put.remote(item)):
+                return
 
     def put_nowait(self, item: Any) -> None:
         self.put(item, block=False)
