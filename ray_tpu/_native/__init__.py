@@ -1,0 +1,11 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+The reference implements its runtime kernel in C++ (SURVEY §2.1); the
+pieces here are the TPU-build equivalents that benefit from native code in
+a host-granular runtime: the shared-memory object store arena
+(``object_store.cc`` — plasma's role) built lazily with the system g++ and
+cached next to the source.
+"""
+
+from ray_tpu._native.build import load_native_library  # noqa: F401
+from ray_tpu._native.store import NativeObjectStore  # noqa: F401

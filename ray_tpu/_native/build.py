@@ -1,0 +1,52 @@
+"""Build-on-first-use for the native components.
+
+The wheel-less analogue of the reference's bazel build of the C++ core:
+each ``.cc`` in this directory compiles to a shared library with the
+system toolchain, cached beside the source and rebuilt when the source is
+newer. No pybind11 — the libraries expose a C ABI consumed via ctypes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_CACHE = {}
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def load_native_library(name: str) -> Optional[ctypes.CDLL]:
+    """Compile ``<name>.cc`` (if stale) and dlopen it. Returns None if no
+    toolchain is available — callers fall back to pure-Python paths."""
+    with _LOCK:
+        if name in _CACHE:
+            return _CACHE[name]
+        src = os.path.join(_DIR, f"{name}.cc")
+        so = os.path.join(_DIR, f"lib{name}.so")
+        try:
+            if (not os.path.exists(so)
+                    or os.path.getmtime(so) < os.path.getmtime(src)):
+                tmp = so + ".tmp"
+                subprocess.run(
+                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                     "-o", tmp, src],
+                    check=True, capture_output=True, text=True)
+                os.replace(tmp, so)
+            lib = ctypes.CDLL(so)
+        except (OSError, subprocess.CalledProcessError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            import logging
+            logging.getLogger("ray_tpu").warning(
+                "native %s unavailable, using pure-Python fallback: %s",
+                name, detail.strip()[:500])
+            lib = None
+        _CACHE[name] = lib
+        return lib

@@ -1,0 +1,295 @@
+// Native shared-memory object store: the plasma equivalent
+// (reference: src/ray/object_manager/plasma/store.h, object_lifecycle_manager.h,
+// plasma_allocator.h, eviction_policy.h), redesigned for the host-granular
+// TPU runtime:
+//
+// - One mmap'd arena per host backed by memfd (sealed host-object bytes).
+//   The arena is MAP_SHARED so future helper processes can map the same fd;
+//   in the single-owner-process runtime, workers are threads and read the
+//   buffers zero-copy through pointers handed across the C ABI.
+// - Boundary-coalescing free-list allocator (dlmalloc.cc's role, simplified:
+//   first-fit over an ordered free map with neighbor coalescing on free).
+// - LRU eviction over sealed, unpinned objects (eviction_policy.h LRUCache):
+//   the caller asks for candidates, spills them (local_object_manager.h:99
+//   SpillObjects is the Python side), then deletes.
+// - create -> write -> seal lifecycle with get() blocking handled in Python
+//   (the store itself is non-blocking; CreateRequestQueue backpressure is
+//   expressed as the -NOSPACE error code the caller turns into spilling).
+//
+// C ABI only — bound from Python via ctypes (no pybind11 in the image).
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+#include <sys/mman.h>
+#include <unistd.h>
+
+#ifdef __linux__
+#include <sys/syscall.h>
+#endif
+
+namespace {
+
+struct IdKey {
+  uint8_t bytes[16];
+  bool operator==(const IdKey& o) const {
+    return std::memcmp(bytes, o.bytes, 16) == 0;
+  }
+};
+
+struct IdHash {
+  size_t operator()(const IdKey& k) const {
+    uint64_t h;
+    std::memcpy(&h, k.bytes, 8);
+    uint64_t l;
+    std::memcpy(&l, k.bytes + 8, 8);
+    return static_cast<size_t>(h ^ (l * 0x9e3779b97f4a7c15ULL));
+  }
+};
+
+struct Entry {
+  uint64_t offset = 0;
+  uint64_t size = 0;
+  int64_t pin_count = 0;
+  uint64_t lru_tick = 0;
+  bool sealed = false;
+};
+
+class Store {
+ public:
+  explicit Store(uint64_t capacity) : capacity_(capacity) {
+#ifdef __linux__
+    fd_ = static_cast<int>(syscall(SYS_memfd_create, "ray_tpu_plasma", 0));
+#else
+    fd_ = -1;
+#endif
+    if (fd_ >= 0 && ftruncate(fd_, static_cast<off_t>(capacity)) == 0) {
+      base_ = static_cast<uint8_t*>(mmap(nullptr, capacity,
+                                         PROT_READ | PROT_WRITE, MAP_SHARED,
+                                         fd_, 0));
+    }
+    if (base_ == MAP_FAILED || base_ == nullptr) {
+      // Fallback: anonymous private mapping (no cross-process sharing).
+      base_ = static_cast<uint8_t*>(mmap(nullptr, capacity,
+                                         PROT_READ | PROT_WRITE,
+                                         MAP_PRIVATE | MAP_ANONYMOUS, -1, 0));
+    }
+    free_by_offset_[0] = capacity;
+  }
+
+  ~Store() {
+    if (base_ != nullptr && base_ != MAP_FAILED) munmap(base_, capacity_);
+    if (fd_ >= 0) close(fd_);
+  }
+
+  bool ok() const { return base_ != nullptr && base_ != MAP_FAILED; }
+
+  int CreateObject(const IdKey& id, uint64_t size, uint8_t** out) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (objects_.count(id)) return -1;
+    uint64_t aligned = Align(size == 0 ? 1 : size);
+    uint64_t offset;
+    if (!Allocate(aligned, &offset)) return -2;
+    Entry e;
+    e.offset = offset;
+    e.size = size;
+    e.pin_count = 1;  // pinned until sealed
+    e.lru_tick = ++tick_;
+    objects_[id] = e;
+    used_ += aligned;
+    alloc_sizes_[offset] = aligned;
+    *out = base_ + offset;
+    return 0;
+  }
+
+  int Seal(const IdKey& id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = objects_.find(id);
+    if (it == objects_.end()) return -1;
+    if (!it->second.sealed) {
+      it->second.sealed = true;
+      it->second.pin_count -= 1;
+    }
+    return 0;
+  }
+
+  int Get(const IdKey& id, uint8_t** out, uint64_t* out_size, int pin) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = objects_.find(id);
+    if (it == objects_.end() || !it->second.sealed) return -1;
+    it->second.lru_tick = ++tick_;
+    if (pin) it->second.pin_count += 1;
+    *out = base_ + it->second.offset;
+    *out_size = it->second.size;
+    return 0;
+  }
+
+  int Unpin(const IdKey& id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = objects_.find(id);
+    if (it == objects_.end()) return -1;
+    if (it->second.pin_count > 0) it->second.pin_count -= 1;
+    return 0;
+  }
+
+  int Delete(const IdKey& id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = objects_.find(id);
+    if (it == objects_.end()) return -1;
+    if (it->second.pin_count > 0) return -3;  // in use
+    Free(it->second.offset);
+    objects_.erase(it);
+    return 0;
+  }
+
+  int Contains(const IdKey& id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = objects_.find(id);
+    return it != objects_.end() && it->second.sealed ? 1 : 0;
+  }
+
+  // LRU candidates (sealed, unpinned) totalling at least nbytes of arena.
+  uint64_t EvictCandidates(uint64_t nbytes, uint8_t* out_ids, uint64_t max) {
+    std::lock_guard<std::mutex> g(mu_);
+    std::map<uint64_t, const IdKey*> by_tick;
+    for (auto& kv : objects_) {
+      if (kv.second.sealed && kv.second.pin_count == 0)
+        by_tick[kv.second.lru_tick] = &kv.first;
+    }
+    uint64_t freed = 0, n = 0;
+    for (auto& kv : by_tick) {
+      if (freed >= nbytes || n >= max) break;
+      const Entry& e = objects_[*kv.second];
+      auto it = alloc_sizes_.find(e.offset);
+      freed += it != alloc_sizes_.end() ? it->second : e.size;
+      std::memcpy(out_ids + n * 16, kv.second->bytes, 16);
+      n += 1;
+    }
+    return freed >= nbytes ? n : (n > 0 ? n : 0);
+  }
+
+  void Stats(uint64_t* used, uint64_t* capacity, uint64_t* count) {
+    std::lock_guard<std::mutex> g(mu_);
+    *used = used_;
+    *capacity = capacity_;
+    *count = objects_.size();
+  }
+
+  int Fd() const { return fd_; }
+
+ private:
+  static uint64_t Align(uint64_t n) { return (n + 63) & ~uint64_t(63); }
+
+  bool Allocate(uint64_t size, uint64_t* out_offset) {
+    // First fit over the ordered free map.
+    for (auto it = free_by_offset_.begin(); it != free_by_offset_.end();
+         ++it) {
+      if (it->second >= size) {
+        *out_offset = it->first;
+        uint64_t rem = it->second - size;
+        uint64_t off = it->first;
+        free_by_offset_.erase(it);
+        if (rem > 0) free_by_offset_[off + size] = rem;
+        return true;
+      }
+    }
+    return false;
+  }
+
+  void Free(uint64_t offset) {
+    auto sz = alloc_sizes_.find(offset);
+    if (sz == alloc_sizes_.end()) return;
+    uint64_t size = sz->second;
+    alloc_sizes_.erase(sz);
+    used_ -= size;
+    auto next = free_by_offset_.lower_bound(offset);
+    // Coalesce with following free block.
+    if (next != free_by_offset_.end() && next->first == offset + size) {
+      size += next->second;
+      next = free_by_offset_.erase(next);
+    }
+    // Coalesce with preceding free block.
+    if (next != free_by_offset_.begin()) {
+      auto prev = std::prev(next);
+      if (prev->first + prev->second == offset) {
+        prev->second += size;
+        return;
+      }
+    }
+    free_by_offset_[offset] = size;
+  }
+
+  std::mutex mu_;
+  uint64_t capacity_;
+  uint64_t used_ = 0;
+  uint64_t tick_ = 0;
+  int fd_ = -1;
+  uint8_t* base_ = nullptr;
+  std::unordered_map<IdKey, Entry, IdHash> objects_;
+  std::map<uint64_t, uint64_t> free_by_offset_;   // offset -> size
+  std::unordered_map<uint64_t, uint64_t> alloc_sizes_;  // offset -> size
+};
+
+IdKey MakeKey(const uint8_t* id) {
+  IdKey k;
+  std::memcpy(k.bytes, id, 16);
+  return k;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* nps_create(uint64_t capacity) {
+  Store* s = new Store(capacity);
+  if (!s->ok()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void nps_destroy(void* s) { delete static_cast<Store*>(s); }
+
+int nps_create_object(void* s, const uint8_t* id, uint64_t size,
+                      uint8_t** out) {
+  return static_cast<Store*>(s)->CreateObject(MakeKey(id), size, out);
+}
+
+int nps_seal(void* s, const uint8_t* id) {
+  return static_cast<Store*>(s)->Seal(MakeKey(id));
+}
+
+int nps_get(void* s, const uint8_t* id, uint8_t** out, uint64_t* out_size,
+            int pin) {
+  return static_cast<Store*>(s)->Get(MakeKey(id), out, out_size, pin);
+}
+
+int nps_unpin(void* s, const uint8_t* id) {
+  return static_cast<Store*>(s)->Unpin(MakeKey(id));
+}
+
+int nps_delete(void* s, const uint8_t* id) {
+  return static_cast<Store*>(s)->Delete(MakeKey(id));
+}
+
+int nps_contains(void* s, const uint8_t* id) {
+  return static_cast<Store*>(s)->Contains(MakeKey(id));
+}
+
+uint64_t nps_evict_candidates(void* s, uint64_t nbytes, uint8_t* out_ids,
+                              uint64_t max) {
+  return static_cast<Store*>(s)->EvictCandidates(nbytes, out_ids, max);
+}
+
+void nps_stats(void* s, uint64_t* used, uint64_t* capacity, uint64_t* count) {
+  static_cast<Store*>(s)->Stats(used, capacity, count);
+}
+
+int nps_fd(void* s) { return static_cast<Store*>(s)->Fd(); }
+
+}  // extern "C"

@@ -1,0 +1,143 @@
+"""ctypes binding for the C++ shared-memory object store.
+
+The Python face of ``object_store.cc`` (plasma client role, reference
+``src/ray/object_manager/plasma/client.h``): put/get of immutable byte
+payloads in the mmap arena, zero-copy reads via memoryview, LRU eviction
+candidates for the spilling path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional, Tuple
+
+from ray_tpu._native.build import load_native_library
+
+
+class NativeObjectStore:
+    """Thin, thread-safe wrapper; raises ``RuntimeError`` if the native
+    library cannot be built (callers should gate on ``available()``)."""
+
+    @staticmethod
+    def available() -> bool:
+        return load_native_library("object_store") is not None
+
+    def __init__(self, capacity_bytes: int):
+        lib = load_native_library("object_store")
+        if lib is None:
+            raise RuntimeError("native object store unavailable")
+        self._lib = lib
+        lib.nps_create.restype = ctypes.c_void_p
+        lib.nps_create.argtypes = [ctypes.c_uint64]
+        lib.nps_destroy.argtypes = [ctypes.c_void_p]
+        lib.nps_create_object.restype = ctypes.c_int
+        lib.nps_create_object.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
+        lib.nps_seal.restype = ctypes.c_int
+        lib.nps_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.nps_get.restype = ctypes.c_int
+        lib.nps_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
+        lib.nps_unpin.restype = ctypes.c_int
+        lib.nps_unpin.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.nps_delete.restype = ctypes.c_int
+        lib.nps_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.nps_contains.restype = ctypes.c_int
+        lib.nps_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.nps_evict_candidates.restype = ctypes.c_uint64
+        lib.nps_evict_candidates.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_uint64]
+        lib.nps_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
+        self._handle = lib.nps_create(capacity_bytes)
+        if not self._handle:
+            raise RuntimeError("failed to create native store arena")
+        self.capacity = capacity_bytes
+
+    @staticmethod
+    def _key(object_id: bytes) -> bytes:
+        if len(object_id) > 16:
+            raise ValueError("object id must be <= 16 bytes")
+        return object_id.ljust(16, b"\0")
+
+    def put(self, object_id: bytes, data: bytes) -> bool:
+        """Create+write+seal. False if the id exists; raises MemoryError
+        when the arena is full (caller evicts/spills then retries)."""
+        key = self._key(object_id)
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        rc = self._lib.nps_create_object(
+            self._handle, key, len(data), ctypes.byref(out))
+        if rc == -1:
+            return False
+        if rc == -2:
+            raise MemoryError(
+                f"native store full ({self.capacity} bytes); evict first")
+        if data:
+            ctypes.memmove(out, data, len(data))
+        self._lib.nps_seal(self._handle, key)
+        return True
+
+    def get(self, object_id: bytes) -> Optional[memoryview]:
+        """Zero-copy read. The object is pinned until ``release``."""
+        key = self._key(object_id)
+        ptr = ctypes.POINTER(ctypes.c_uint8)()
+        size = ctypes.c_uint64()
+        rc = self._lib.nps_get(self._handle, key, ctypes.byref(ptr),
+                               ctypes.byref(size), 1)
+        if rc != 0:
+            return None
+        if size.value == 0:
+            self._lib.nps_unpin(self._handle, key)
+            return memoryview(b"")
+        array = (ctypes.c_uint8 * size.value).from_address(
+            ctypes.addressof(ptr.contents))
+        return memoryview(array).cast("B")
+
+    def get_bytes(self, object_id: bytes) -> Optional[bytes]:
+        """Copying read that immediately unpins."""
+        view = self.get(object_id)
+        if view is None:
+            return None
+        try:
+            return bytes(view)
+        finally:
+            self.release(object_id)
+
+    def release(self, object_id: bytes) -> None:
+        self._lib.nps_unpin(self._handle, self._key(object_id))
+
+    def delete(self, object_id: bytes) -> bool:
+        return self._lib.nps_delete(self._handle,
+                                    self._key(object_id)) == 0
+
+    def contains(self, object_id: bytes) -> bool:
+        return bool(self._lib.nps_contains(self._handle,
+                                           self._key(object_id)))
+
+    def evict_candidates(self, nbytes: int,
+                         max_candidates: int = 1024) -> List[bytes]:
+        """LRU (sealed, unpinned) ids whose eviction frees >= nbytes."""
+        buf = ctypes.create_string_buffer(16 * max_candidates)
+        n = self._lib.nps_evict_candidates(self._handle, nbytes, buf,
+                                           max_candidates)
+        return [buf.raw[i * 16:(i + 1) * 16] for i in range(n)]
+
+    def stats(self) -> Tuple[int, int, int]:
+        """-> (used_bytes, capacity_bytes, num_objects)."""
+        used = ctypes.c_uint64()
+        cap = ctypes.c_uint64()
+        count = ctypes.c_uint64()
+        self._lib.nps_stats(self._handle, ctypes.byref(used),
+                            ctypes.byref(cap), ctypes.byref(count))
+        return used.value, cap.value, count.value
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.nps_destroy(handle)
+            self._handle = None

@@ -94,6 +94,13 @@ _config.define("object_spilling_threshold", float, 0.8, "fraction of budget that
 _config.define("min_spilling_size_bytes", int, 1 << 20, "batch small objects up to this size")
 _config.define("inline_object_max_bytes", int, 100 * 1024,
                "small objects returned inline instead of via the store")
+_config.define("use_native_object_store", bool, True,
+               "keep pickled host objects in the C++ mmap arena "
+               "(ray_tpu/_native/object_store.cc); falls back to heap "
+               "bytes when the toolchain is unavailable")
+_config.define("native_store_min_object_bytes", int, 4096,
+               "objects smaller than this stay on the Python heap (arena "
+               "round-trip overhead dominates below it)")
 
 # -- Failure detection ----------------------------------------------------------
 _config.define("heartbeat_interval_ms", int, 100, "node heartbeat period")

@@ -65,6 +65,7 @@ class _Entry:
     size_bytes: int = 0
     spill_path: Optional[str] = None
     pin_count: int = 0
+    native: bool = False  # payload lives in the C++ arena, data is None
     last_access: float = field(default_factory=time.monotonic)
     sealed: threading.Event = field(default_factory=threading.Event)
 
@@ -85,6 +86,23 @@ class ObjectStore:
         self._spill_dir = _config.get("object_spilling_dir")
         self._num_spilled = 0
         self._num_restored = 0
+        # Large pickled payloads live in the C++ mmap arena
+        # (``_native/object_store.cc``, the plasma equivalent); the Python
+        # dict keeps only descriptors. Heap fallback if g++ is missing.
+        self._native = None
+        self._native_oids: Dict[bytes, ObjectID] = {}
+        if _config.get("use_native_object_store"):
+            try:
+                from ray_tpu._native import NativeObjectStore
+                if NativeObjectStore.available():
+                    self._native = NativeObjectStore(self._capacity)
+            except Exception:
+                self._native = None
+
+    @staticmethod
+    def _native_key(object_id: ObjectID) -> bytes:
+        import hashlib
+        return hashlib.blake2b(object_id.binary(), digest_size=16).digest()
 
     # -- put ------------------------------------------------------------------
 
@@ -100,8 +118,66 @@ class ObjectStore:
             self._entries[object_id] = entry
             if entry.kind in (KIND_NUMPY, KIND_PICKLED):
                 self._host_bytes += entry.size_bytes
+            if (entry.kind == KIND_PICKLED and self._native is not None
+                    and entry.size_bytes
+                    >= _config.get("native_store_min_object_bytes")):
+                self._place_native_locked(object_id, entry)
             entry.sealed.set()
             self._maybe_spill_locked()
+
+    def _place_native_locked(self, object_id: ObjectID, entry: _Entry):
+        """Move the pickled payload into the C++ arena, evicting LRU arena
+        objects to disk if needed (plasma create + spill backpressure)."""
+        key = self._native_key(object_id)
+        data = entry.data
+        for _ in range(2):
+            try:
+                if self._native.put(key, data):
+                    self._native_oids[key] = object_id
+                    entry.data = None
+                    entry.native = True
+                return
+            except MemoryError:
+                if not self._evict_native_locked(len(data)):
+                    return  # arena can't fit it; keep on heap
+
+    def _evict_native_locked(self, nbytes: int) -> bool:
+        """Spill LRU arena objects to disk to free >= nbytes.
+
+        Python-level pins (in-flight task arguments) must stay resident —
+        the arena's own pin count only tracks open reads, so filter here.
+        No ``min_spilling_size`` filter: this is hard backpressure, where
+        freeing anything beats failing the create.
+        """
+        # Over-ask so pinned candidates can be skipped and still free
+        # enough.
+        candidates = self._native.evict_candidates(nbytes * 2)
+        os.makedirs(self._spill_dir, exist_ok=True)
+        oids = self._native_oids
+        spilled_any = False
+        freed = 0
+        for key in candidates:
+            if freed >= nbytes and spilled_any:
+                break
+            oid = oids.get(key)
+            e = self._entries.get(oid) if oid is not None else None
+            if e is not None and e.pin_count > 0:
+                continue  # in use by a dispatched task
+            data = self._native.get_bytes(key)
+            if e is not None and data is not None:
+                path = os.path.join(self._spill_dir, oid.hex())
+                with open(path, "wb") as f:
+                    f.write(data)
+                e.spill_path = path
+                e.kind = KIND_SPILLED
+                e.native = False
+                self._host_bytes -= e.size_bytes
+                self._num_spilled += 1
+            self._native.delete(key)
+            oids.pop(key, None)
+            freed += len(data) if data is not None else 0
+            spilled_any = True
+        return spilled_any
 
     def put_error(self, object_id: ObjectID, error: BaseException) -> None:
         with self._lock:
@@ -109,6 +185,16 @@ class ObjectStore:
             entry = _Entry(kind=KIND_ERROR, data=error)
             if existing is not None:
                 entry.sealed = existing.sealed
+                # Replacing a sealed value: release its payload (arena
+                # bytes would otherwise leak for the process lifetime).
+                if existing.native:
+                    key = self._native_key(object_id)
+                    self._native.delete(key)
+                    self._native_oids.pop(key, None)
+                if existing.kind in (KIND_NUMPY, KIND_PICKLED):
+                    self._host_bytes -= existing.size_bytes
+                if existing.spill_path and os.path.exists(existing.spill_path):
+                    os.unlink(existing.spill_path)
             self._entries[object_id] = entry
             entry.sealed.set()
 
@@ -158,6 +244,18 @@ class ObjectStore:
             if entry.kind == KIND_ERROR:
                 raise entry.data
             if entry.kind == KIND_PICKLED:
+                if entry.native:
+                    # Zero-copy read: unpickle straight out of the pinned
+                    # arena buffer (loads copies what it keeps).
+                    key = self._native_key(object_id)
+                    view = self._native.get(key)
+                    if view is None:
+                        raise ObjectLostError(f"{object_id} lost from arena")
+                    try:
+                        return cloudpickle.loads(view)
+                    finally:
+                        view.release()
+                        self._native.release(key)
                 return cloudpickle.loads(entry.data)
             return entry.data  # device array or read-only numpy view
 
@@ -198,6 +296,10 @@ class ObjectStore:
                 return
             if e.kind in (KIND_NUMPY, KIND_PICKLED):
                 self._host_bytes -= e.size_bytes
+            if e.native:
+                key = self._native_key(object_id)
+                self._native.delete(key)
+                self._native_oids.pop(key, None)
             if e.spill_path and os.path.exists(e.spill_path):
                 os.unlink(e.spill_path)
 
@@ -220,9 +322,17 @@ class ObjectStore:
         for _, oid, e in candidates:
             if self._host_bytes <= threshold:
                 break
+            if e.native:
+                key = self._native_key(oid)
+                data = self._native.get_bytes(key)
+                self._native.delete(key)
+                self._native_oids.pop(key, None)
+                e.native = False
+            else:
+                data = e.data
             path = os.path.join(self._spill_dir, oid.hex())
             with open(path, "wb") as f:
-                f.write(e.data)
+                f.write(data)
             self._host_bytes -= e.size_bytes
             e.spill_path = path
             e.data = None
@@ -243,13 +353,19 @@ class ObjectStore:
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
-            return {
+            out = {
                 "num_objects": len(self._entries),
                 "host_bytes": self._host_bytes,
                 "capacity_bytes": self._capacity,
                 "num_spilled": self._num_spilled,
                 "num_restored": self._num_restored,
+                "native_arena": self._native is not None,
             }
+            if self._native is not None:
+                used, cap, count = self._native.stats()
+                out["native_used_bytes"] = used
+                out["native_num_objects"] = count
+            return out
 
     def object_ids(self) -> List[ObjectID]:
         with self._lock:

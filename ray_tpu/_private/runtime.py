@@ -217,6 +217,7 @@ class Runtime:
         # Pending queue of tasks waiting for resources / dependencies.
         self._pending: List[dict] = []
         self._pending_cv = threading.Condition()
+        self._dispatch_dirty = False  # kick arrived while loop was busy
         self._util_pool = ThreadPoolExecutor(max_workers=32,
                                              thread_name_prefix="rt-util")
         self._shutdown = False
@@ -440,21 +441,37 @@ class Runtime:
             if still_waiting:
                 with self._pending_cv:
                     self._pending.extend(still_waiting)
-                time.sleep(0.002)
+                    # Event-driven backoff: a seal/submit kick wakes the
+                    # loop immediately instead of paying a fixed sleep per
+                    # dependency-chain hop; the dirty flag covers kicks
+                    # that raced with this pass (lost-wakeup).
+                    if not self._dispatch_dirty:
+                        self._pending_cv.wait(timeout=0.02)
+                    self._dispatch_dirty = False
 
     def _kick(self):
         with self._pending_cv:
+            self._dispatch_dirty = True
             self._pending_cv.notify_all()
 
     def _deps_ready(self, spec: TaskSpec) -> bool:
         for oid in _ref_ids_in(spec.args, spec.kwargs):
             if not self.object_ready(oid):
-                # Trigger reconstruction of lost deps.
                 node = self._locate(oid)
                 if node is None:
+                    # Reconstruct ONLY if the producing task already ran
+                    # (value existed and was lost with its node). While the
+                    # producer is merely pending/running, resubmitting it
+                    # here would duplicate it on every dispatcher pass — a
+                    # task storm that grows combinatorially on dependency
+                    # chains.
                     with self.lock:
                         known = oid in self.object_locations
-                    if not known:
+                        dep_spec = self.lineage.get(oid)
+                        state = (self.task_states.get(dep_spec.task_id)
+                                 if dep_spec is not None else None)
+                    if (not known and dep_spec is not None
+                            and state in ("FINISHED", "FAILED")):
                         self._try_reconstruct(oid)
                 return False
         return True

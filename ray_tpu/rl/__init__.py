@@ -1,0 +1,31 @@
+"""ray_tpu.rl — reinforcement learning on TPU.
+
+The TPU-native redesign of the reference's RLlib (``rllib/``, SURVEY §2.6):
+``Algorithm`` is a Tune ``Trainable`` whose ``training_step`` composes
+rollout collection from CPU env actors with a JAX learner compiled over a
+device mesh. Where RLlib splits batches across GPU "towers" with loader
+threads (``rllib/execution/multi_gpu_learner_thread.py``), here the batch is
+sharded over the mesh's data axis and XLA inserts the gradient ``psum`` —
+the tower logic is a sharding annotation, not an engine.
+"""
+
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.dqn import DQN, DQNConfig
+from ray_tpu.rl.env import (CartPoleEnv, EnvSpec, PendulumEnv, VectorEnv,
+                            make_env, register_env)
+from ray_tpu.rl.impala import Impala, ImpalaConfig
+from ray_tpu.rl.policy import Policy
+from ray_tpu.rl.ppo import PPO, PPOConfig
+from ray_tpu.rl.replay_buffer import (PrioritizedReplayBuffer, ReplayBuffer)
+from ray_tpu.rl.rollout_worker import (RolloutWorker, WorkerSet,
+                                       synchronous_parallel_sample)
+from ray_tpu.rl.sample_batch import SampleBatch, concat_samples
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "Policy", "SampleBatch", "concat_samples",
+    "RolloutWorker", "WorkerSet", "synchronous_parallel_sample",
+    "ReplayBuffer", "PrioritizedReplayBuffer",
+    "PPO", "PPOConfig", "DQN", "DQNConfig", "Impala", "ImpalaConfig",
+    "CartPoleEnv", "PendulumEnv", "VectorEnv", "EnvSpec", "make_env",
+    "register_env",
+]

@@ -1,0 +1,231 @@
+"""IMPALA: asynchronous actor-learner with V-trace off-policy correction.
+
+Parity with ``rllib/algorithms/impala/`` (async sampling into a central
+learner, ``vtrace_torch.py``). The reference's ``MultiGPULearnerThread`` +
+loader threads (``multi_gpu_learner_thread.py:20-46``) become: in-flight
+``sample.remote()`` futures kept saturated per worker, and ONE jitted
+V-trace update the batch enters with a single device transfer — the
+"loader thread" is ``jax.device_put``'s async dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl import models as _models
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.sample_batch import SampleBatch, concat_samples
+
+
+class ImpalaConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or Impala)
+        self.lr = 5e-4
+        self.vtrace_clip_rho_threshold = 1.0
+        self.vtrace_clip_c_threshold = 1.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.grad_clip = 40.0
+        self.num_rollout_workers = 2
+        self.rollout_fragment_length = 50
+        self.max_sample_requests_in_flight_per_worker = 2
+        self.broadcast_interval = 1
+
+
+def vtrace(behavior_logp, target_logp, rewards, values, bootstrap_value,
+           discounts, clip_rho: float = 1.0, clip_c: float = 1.0):
+    """V-trace targets (Espeholt et al. 2018), time-major [T, B] inputs.
+
+    Returns (vs, pg_advantages). Pure function; used under jit.
+    """
+    rhos = jnp.exp(target_logp - behavior_logp)
+    clipped_rhos = jnp.minimum(clip_rho, rhos)
+    cs = jnp.minimum(clip_c, rhos)
+    values_t_plus_1 = jnp.concatenate(
+        [values[1:], bootstrap_value[None]], axis=0)
+    deltas = clipped_rhos * (
+        rewards + discounts * values_t_plus_1 - values)
+
+    def backward(acc, xs):
+        delta, discount, c = xs
+        acc = delta + discount * c * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        backward, jnp.zeros_like(bootstrap_value),
+        (deltas, discounts, cs), reverse=True)
+    vs = vs_minus_v + values
+    vs_t_plus_1 = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    pg_adv = clipped_rhos * (rewards + discounts * vs_t_plus_1 - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
+class ImpalaLearner:
+    def __init__(self, init_params, cfg: ImpalaConfig, continuous: bool):
+        self.cfg = cfg
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip),
+            optax.rmsprop(cfg.lr, decay=0.99, eps=0.1))
+        self.params = jax.tree_util.tree_map(jnp.asarray, init_params)
+        self.opt_state = self.optimizer.init(self.params)
+        gamma = cfg.gamma
+
+        def update(params, opt_state, batch):
+            # Columns arrive time-major [T, B, ...].
+            def loss_fn(p):
+                T, B = batch[SampleBatch.REWARDS].shape
+                obs = batch[SampleBatch.OBS]
+                dist_in, values = _models.actor_critic_apply(
+                    p, obs.reshape((T * B,) + obs.shape[2:]))
+                dist = _models.make_distribution(
+                    p, dist_in, continuous)
+                actions = batch[SampleBatch.ACTIONS].reshape(
+                    (T * B,) + batch[SampleBatch.ACTIONS].shape[2:])
+                target_logp = dist.logp(actions).reshape(T, B)
+                values = values.reshape(T, B)
+                entropy = dist.entropy().mean()
+                _, boot_values = _models.actor_critic_apply(
+                    p, batch["bootstrap_obs"][-1])
+                # Truncation cuts the recursion too: the next in-fragment
+                # row belongs to the auto-reset episode, so bootstrapping
+                # across it would blend unrelated returns. Treating
+                # truncation as terminal trades that leak for a small
+                # no-bootstrap bias at time limits.
+                boundary = (batch[SampleBatch.TERMINATEDS]
+                            | batch[SampleBatch.TRUNCATEDS])
+                discounts = gamma * (1.0 - boundary.astype(jnp.float32))
+                vs, pg_adv = vtrace(
+                    batch[SampleBatch.ACTION_LOGP], target_logp,
+                    batch[SampleBatch.REWARDS], values,
+                    jax.lax.stop_gradient(boot_values), discounts,
+                    cfg.vtrace_clip_rho_threshold,
+                    cfg.vtrace_clip_c_threshold)
+                pg_loss = -jnp.mean(target_logp * pg_adv)
+                vf_loss = 0.5 * jnp.mean((vs - values) ** 2)
+                total = (pg_loss + cfg.vf_loss_coeff * vf_loss
+                         - cfg.entropy_coeff * entropy)
+                return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                               "entropy": entropy}
+
+            (_, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, aux
+
+        self._update = jax.jit(update, donate_argnums=(0, 1))
+
+    def train(self, batch_tm: Dict[str, np.ndarray]) -> Dict[str, float]:
+        arrays = {k: jnp.asarray(v) for k, v in batch_tm.items()}
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.opt_state, arrays)
+        return {k: float(v) for k, v in aux.items()}
+
+    def state(self):
+        return jax.device_get((self.params, self.opt_state))
+
+    def set_state(self, state):
+        p, o = state
+        self.params = jax.tree_util.tree_map(jnp.asarray, p)
+        self.opt_state = jax.tree_util.tree_map(jnp.asarray, o)
+
+
+class Impala(Algorithm):
+    _config_cls = ImpalaConfig
+
+    @classmethod
+    def get_default_config(cls) -> ImpalaConfig:
+        return ImpalaConfig(cls)
+
+    def _needs_advantages(self) -> bool:
+        return False
+
+    def _make_learner(self) -> ImpalaLearner:
+        cfg = self.algo_config
+        lw = self.workers.local_worker
+        self._in_flight: Dict[Any, Any] = {}
+        self._broadcast_countdown = 0
+        return ImpalaLearner(lw.get_weights(), cfg, lw.policy.continuous)
+
+    def _to_time_major(self, batch: SampleBatch) -> Dict[str, np.ndarray]:
+        T = self.algo_config.rollout_fragment_length
+        n = (len(batch) // T) * T
+        out = {}
+        for k in (SampleBatch.OBS, SampleBatch.ACTIONS, SampleBatch.REWARDS,
+                  SampleBatch.TERMINATEDS, SampleBatch.TRUNCATEDS,
+                  SampleBatch.ACTION_LOGP, "bootstrap_obs"):
+            v = batch[k][:n]
+            out[k] = np.swapaxes(
+                v.reshape((n // T, T) + v.shape[1:]), 0, 1)
+        return out
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+        cfg = self.algo_config
+        metrics: Dict[str, Any] = {}
+        if not self.workers.remote_workers:
+            # Degenerate sync mode with only the local worker.
+            batch = self.workers.local_worker.sample()
+            batches = [batch]
+        else:
+            # Keep every worker saturated with in-flight sample requests.
+            for w in self.workers.remote_workers:
+                pending = sum(1 for ref, src in self._in_flight.items()
+                              if src is w)
+                for _ in range(
+                        cfg.max_sample_requests_in_flight_per_worker
+                        - pending):
+                    self._in_flight[w.sample.remote()] = w
+            ready, _ = ray_tpu.wait(
+                list(self._in_flight), num_returns=1, timeout=30.0)
+            from ray_tpu.exceptions import ActorDiedError
+            batches = []
+            stale_workers = set()
+            for r in ready:
+                w = self._in_flight.pop(r)
+                try:
+                    batches.append(ray_tpu.get(r))
+                    stale_workers.add(w)
+                except ActorDiedError:
+                    fresh = self.workers.recreate_failed_worker(w)
+                    # Drop the dead worker's other in-flight refs.
+                    for ref, src in list(self._in_flight.items()):
+                        if src is w:
+                            self._in_flight.pop(ref)
+                    stale_workers.add(fresh)
+            # Async weight push: only refresh the workers just harvested
+            # (reference broadcast_interval semantics).
+            self._broadcast_countdown -= 1
+            if self._broadcast_countdown <= 0:
+                weights_ref = ray_tpu.put(
+                    jax.device_get(self.learner.params))
+                for w in stale_workers:
+                    w.set_weights.remote(weights_ref)
+                self._broadcast_countdown = cfg.broadcast_interval
+        total = 0
+        per_batch: List[Dict[str, float]] = []
+        for batch in batches:
+            tm = self._to_time_major(batch)
+            per_batch.append(self.learner.train(tm))
+            total += len(batch)
+        self._timesteps_total += total
+        self.workers.local_worker.set_weights(
+            jax.device_get(self.learner.params))
+        if per_batch:
+            metrics = {k: float(np.mean([m[k] for m in per_batch]))
+                       for k in per_batch[0]}
+        metrics["timesteps_this_iter"] = total
+        return metrics
+
+    def _learner_state(self):
+        return {"learner": self.learner.state()}
+
+    def _set_learner_state(self, state):
+        if state:
+            self.learner.set_state(state["learner"])

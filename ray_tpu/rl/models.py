@@ -1,0 +1,126 @@
+"""Policy/value networks and action distributions in pure JAX.
+
+Parity with ``rllib/models/`` (``catalog.py`` fcnet defaults,
+``torch/torch_action_dist.py`` Categorical/DiagGaussian). Networks are
+(init, apply) pairs over pytrees so they compose with pjit sharding the
+same way the model layer in ``ray_tpu.models`` does.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def mlp_init(key: jax.Array, in_dim: int, hidden: Sequence[int],
+             out_dim: int, out_scale: float = 0.01) -> Dict[str, Any]:
+    """Orthogonal-init MLP; small final layer like RLlib's fcnet."""
+    sizes = [in_dim, *hidden, out_dim]
+    layers = []
+    for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, k = jax.random.split(key)
+        w = jax.nn.initializers.orthogonal(
+            jnp.sqrt(2.0) if i < len(sizes) - 2 else out_scale)(
+                k, (a, b), jnp.float32)
+        layers.append({"w": w, "b": jnp.zeros((b,), jnp.float32)})
+    return {"layers": layers}
+
+
+def mlp_apply(params: Dict[str, Any], x: jax.Array,
+              activation: str = "tanh") -> jax.Array:
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+    *hidden_layers, last = params["layers"]
+    for lyr in hidden_layers:
+        x = act(x @ lyr["w"] + lyr["b"])
+    return x @ last["w"] + last["b"]
+
+
+def actor_critic_init(key: jax.Array, obs_dim: int, action_dim: int,
+                      hidden: Sequence[int] = (64, 64),
+                      continuous: bool = False) -> Dict[str, Any]:
+    kp, kv = jax.random.split(key)
+    params = {
+        "pi": mlp_init(kp, obs_dim, hidden, action_dim),
+        "vf": mlp_init(kv, obs_dim, hidden, 1, out_scale=1.0),
+    }
+    if continuous:
+        params["log_std"] = jnp.zeros((action_dim,), jnp.float32)
+    return params
+
+
+def actor_critic_apply(params, obs) -> Tuple[jax.Array, jax.Array]:
+    """-> (distribution inputs [B, A], value estimates [B])."""
+    logits = mlp_apply(params["pi"], obs)
+    values = mlp_apply(params["vf"], obs)[..., 0]
+    return logits, values
+
+
+class Categorical:
+    """Categorical over logits (rllib TorchCategorical equivalent)."""
+
+    def __init__(self, logits: jax.Array):
+        self.logits = logits - jax.scipy.special.logsumexp(
+            logits, axis=-1, keepdims=True)
+
+    def sample(self, key: jax.Array) -> jax.Array:
+        return jax.random.categorical(key, self.logits, axis=-1)
+
+    def logp(self, actions: jax.Array) -> jax.Array:
+        return jnp.take_along_axis(
+            self.logits, actions[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+    def entropy(self) -> jax.Array:
+        p = jnp.exp(self.logits)
+        return -jnp.sum(p * self.logits, axis=-1)
+
+    def kl(self, other: "Categorical") -> jax.Array:
+        p = jnp.exp(self.logits)
+        return jnp.sum(p * (self.logits - other.logits), axis=-1)
+
+    def deterministic(self) -> jax.Array:
+        return jnp.argmax(self.logits, axis=-1)
+
+
+class DiagGaussian:
+    """Diagonal gaussian with state-independent log_std."""
+
+    def __init__(self, mean: jax.Array, log_std: jax.Array):
+        self.mean = mean
+        self.log_std = jnp.broadcast_to(log_std, mean.shape)
+
+    def sample(self, key: jax.Array) -> jax.Array:
+        return self.mean + jnp.exp(self.log_std) * jax.random.normal(
+            key, self.mean.shape)
+
+    def logp(self, actions: jax.Array) -> jax.Array:
+        var = jnp.exp(2 * self.log_std)
+        ll = (-0.5 * ((actions - self.mean) ** 2 / var)
+              - self.log_std - 0.5 * jnp.log(2 * jnp.pi))
+        return jnp.sum(ll, axis=-1)
+
+    def entropy(self) -> jax.Array:
+        return jnp.sum(self.log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e),
+                       axis=-1)
+
+    def kl(self, other: "DiagGaussian") -> jax.Array:
+        v0, v1 = jnp.exp(2 * self.log_std), jnp.exp(2 * other.log_std)
+        return jnp.sum(other.log_std - self.log_std
+                       + (v0 + (self.mean - other.mean) ** 2) / (2 * v1)
+                       - 0.5, axis=-1)
+
+    def deterministic(self) -> jax.Array:
+        return self.mean
+
+
+def make_distribution(params: Dict[str, Any], dist_inputs: jax.Array,
+                      continuous: bool):
+    if continuous:
+        return DiagGaussian(dist_inputs, params["log_std"])
+    return Categorical(dist_inputs)
+
+
+def num_params(params: Any) -> int:
+    return int(sum(np.prod(p.shape) for p in jax.tree_util.tree_leaves(params)))

@@ -1,0 +1,86 @@
+"""Policy: parameters + jitted action computation.
+
+Parity with ``rllib/policy/policy.py`` + ``torch_policy.py``
+(``compute_actions`` ``torch_policy.py:231``, ``get/set_weights``). The
+torch policy's device juggling and tower copies disappear: parameters are
+one pytree, action computation is one jitted function, and the learner's
+"towers" are a sharding of the same pytree over a mesh (SURVEY §2.5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl import models as _models
+from ray_tpu.rl.env import Box, Discrete, EnvSpec
+
+
+class Policy:
+    """Actor-critic policy over an MLP; subclass for custom networks."""
+
+    def __init__(self, spec: EnvSpec, config: Optional[dict] = None,
+                 seed: int = 0):
+        self.spec = spec
+        self.config = dict(config or {})
+        self.continuous = isinstance(spec.action_space, Box)
+        obs_dim = int(np.prod(spec.observation_space.shape))
+        if self.continuous:
+            self.action_dim = int(np.prod(spec.action_space.shape))
+        else:
+            self.action_dim = spec.action_space.n
+        hidden = tuple(self.config.get("fcnet_hiddens", (64, 64)))
+        self.params = _models.actor_critic_init(
+            jax.random.key(seed), obs_dim, self.action_dim, hidden,
+            continuous=self.continuous)
+        self._rng = jax.random.key(seed + 1)
+
+        continuous = self.continuous
+
+        def _compute(params, rng, obs, explore):
+            dist_inputs, values = _models.actor_critic_apply(params, obs)
+            dist = _models.make_distribution(params, dist_inputs, continuous)
+            actions = jax.lax.cond(
+                explore,
+                lambda: dist.sample(rng),
+                lambda: dist.deterministic())
+            return actions, dist.logp(actions), values
+
+        self._compute = jax.jit(_compute, static_argnames=())
+
+        def _value(params, obs):
+            _, values = _models.actor_critic_apply(params, obs)
+            return values
+
+        self._value = jax.jit(_value)
+
+    # -- acting ------------------------------------------------------------
+
+    def compute_actions(self, obs: np.ndarray, explore: bool = True
+                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """-> (actions, action_logp, vf_preds), all host numpy."""
+        self._rng, key = jax.random.split(self._rng)
+        obs = jnp.asarray(obs, jnp.float32)
+        actions, logp, values = self._compute(
+            self.params, key, obs, jnp.asarray(explore))
+        actions = np.asarray(actions)
+        if self.continuous:
+            lo = self.spec.action_space.low
+            hi = self.spec.action_space.high
+            actions = np.clip(actions, lo, hi)
+        return actions, np.asarray(logp), np.asarray(values)
+
+    def value(self, obs: np.ndarray) -> np.ndarray:
+        return np.asarray(
+            self._value(self.params, jnp.asarray(obs, jnp.float32)))
+
+    # -- weights -----------------------------------------------------------
+
+    def get_weights(self) -> Any:
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights: Any) -> None:
+        self.params = jax.tree_util.tree_map(jnp.asarray, weights)

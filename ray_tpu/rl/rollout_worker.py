@@ -1,0 +1,241 @@
+"""Rollout collection: env-stepping workers and the set that manages them.
+
+Parity with ``rllib/evaluation/rollout_worker.py`` (``RolloutWorker.sample``),
+``worker_set.py`` (``WorkerSet``, ``sync_weights``) and
+``rllib/execution/rollout_ops.py:36`` (``synchronous_parallel_sample``).
+Workers are CPU actors stepping numpy envs; the policy network runs in the
+worker's JAX-CPU context. The learner never sees an env.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rl.env import VectorEnv, make_env
+from ray_tpu.rl.policy import Policy
+from ray_tpu.rl.postprocessing import compute_gae
+from ray_tpu.rl.sample_batch import SampleBatch, concat_samples
+
+
+class RolloutWorker:
+    """Steps a VectorEnv with the current policy, emitting SampleBatches.
+
+    Plain class — usable inline (local worker) or as a ``ray_tpu`` actor
+    (remote workers), same as the reference's dual-use RolloutWorker.
+    """
+
+    def __init__(self, env_name_or_maker, env_config: Optional[dict] = None,
+                 num_envs: int = 1, rollout_fragment_length: int = 200,
+                 policy_config: Optional[dict] = None, seed: int = 0,
+                 worker_index: int = 0,
+                 policy_cls: Callable[..., Policy] = Policy,
+                 gamma: float = 0.99, lambda_: float = 0.95,
+                 compute_advantages: bool = True):
+        base_seed = seed + worker_index * 10007
+        self.vector_env = VectorEnv(
+            lambda c: make_env(env_name_or_maker, c), num_envs,
+            env_config, seed=base_seed)
+        self.policy = policy_cls(self.vector_env.spec, policy_config,
+                                 seed=base_seed)
+        self.fragment_length = rollout_fragment_length
+        self.gamma = gamma
+        self.lambda_ = lambda_
+        self.compute_advantages = compute_advantages
+        self.worker_index = worker_index
+        self._obs = self.vector_env.reset(seed=base_seed)
+        self._eps_ids = np.arange(num_envs, dtype=np.int64)
+        self._next_eps_id = num_envs
+        self._eps_return = np.zeros(num_envs, np.float64)
+        self._eps_len = np.zeros(num_envs, np.int64)
+        self._completed: List[dict] = []
+
+    def sample(self) -> SampleBatch:
+        """Collect ``fragment_length`` steps per sub-env (column-major)."""
+        n_envs = self.vector_env.num_envs
+        T = self.fragment_length
+        cols: Dict[str, list] = {k: [] for k in (
+            SampleBatch.OBS, SampleBatch.ACTIONS, SampleBatch.REWARDS,
+            SampleBatch.TERMINATEDS, SampleBatch.TRUNCATEDS,
+            SampleBatch.ACTION_LOGP, SampleBatch.VF_PREDS,
+            SampleBatch.EPS_ID, "bootstrap_values")}
+        for _ in range(T):
+            actions, logp, values = self.policy.compute_actions(self._obs)
+            obs2, rews, terms, truncs, infos = self.vector_env.step(actions)
+            boots = np.zeros(n_envs, np.float32)
+            trunc_idx = [i for i in range(n_envs)
+                         if truncs[i] and not terms[i]]
+            if trunc_idx:
+                term_obs = np.stack(
+                    [infos[i]["terminal_obs"] for i in trunc_idx])
+                vals = self.policy.value(term_obs)
+                for j, i in enumerate(trunc_idx):
+                    boots[i] = vals[j]
+            cols[SampleBatch.OBS].append(self._obs)
+            cols[SampleBatch.ACTIONS].append(actions)
+            cols[SampleBatch.REWARDS].append(rews)
+            cols[SampleBatch.TERMINATEDS].append(terms)
+            cols[SampleBatch.TRUNCATEDS].append(truncs)
+            cols[SampleBatch.ACTION_LOGP].append(logp)
+            cols[SampleBatch.VF_PREDS].append(values)
+            cols[SampleBatch.EPS_ID].append(self._eps_ids.copy())
+            cols["bootstrap_values"].append(boots)
+            self._eps_return += rews
+            self._eps_len += 1
+            for i in range(n_envs):
+                if terms[i] or truncs[i]:
+                    self._completed.append({
+                        "episode_reward": float(self._eps_return[i]),
+                        "episode_len": int(self._eps_len[i])})
+                    self._eps_return[i] = 0.0
+                    self._eps_len[i] = 0
+                    self._eps_ids[i] = self._next_eps_id
+                    self._next_eps_id += 1
+            self._obs = obs2
+
+        # Per-env fragments so GAE recursion never crosses env boundaries.
+        stacked = {k: np.stack(v) for k, v in cols.items()}  # [T, n_envs,...]
+        # Bootstrap obs for the step after the fragment end: the live obs,
+        # or the pre-reset terminal obs if the final step truncated.
+        boot_obs = self._obs.copy()
+        for i in range(n_envs):
+            if truncs[i] and not terms[i] and "terminal_obs" in infos[i]:
+                boot_obs[i] = infos[i]["terminal_obs"]
+        last_values = self.policy.value(boot_obs)
+        frags = []
+        for i in range(n_envs):
+            frag = SampleBatch({k: v[:, i] for k, v in stacked.items()})
+            if self.compute_advantages:
+                compute_gae(frag, float(last_values[i]),
+                            self.gamma, self.lambda_)
+            else:
+                # Off-policy learners (V-trace) re-evaluate values with the
+                # learner's own network; ship the bootstrap obs per step
+                # (broadcast per fragment) so no worker-side values leak in.
+                frag["bootstrap_obs"] = np.repeat(boot_obs[i][None], T, 0)
+            frags.append(frag)
+        return concat_samples(frags)
+
+    def pop_metrics(self) -> List[dict]:
+        out, self._completed = self._completed, []
+        return out
+
+    def get_weights(self):
+        return self.policy.get_weights()
+
+    def set_weights(self, weights) -> None:
+        self.policy.set_weights(weights)
+
+    def get_spec(self):
+        return self.vector_env.spec
+
+    def apply(self, fn: Callable[["RolloutWorker"], Any]) -> Any:
+        return fn(self)
+
+    def stop(self) -> None:
+        pass
+
+
+class WorkerSet:
+    """A local worker + N remote worker actors (``worker_set.py``).
+
+    Dead remote workers are transparently recreated and re-synced on the
+    next operation that touches them (the reference's
+    ``recreate_failed_workers``, ``worker_set.py``)."""
+
+    def __init__(self, num_workers: int, worker_kwargs: Dict[str, Any],
+                 num_cpus_per_worker: float = 1.0):
+        import ray_tpu
+        self.local_worker = RolloutWorker(worker_index=0, **worker_kwargs)
+        self._worker_kwargs = dict(worker_kwargs)
+        self._num_cpus_per_worker = num_cpus_per_worker
+        self._remote_cls = ray_tpu.remote(RolloutWorker)
+        self.remote_workers = [self._spawn(i + 1)
+                               for i in range(num_workers)]
+
+    def _spawn(self, worker_index: int):
+        return self._remote_cls.options(
+            num_cpus=self._num_cpus_per_worker).remote(
+                worker_index=worker_index, **self._worker_kwargs)
+
+    def recreate_failed_worker(self, worker) -> Any:
+        """Replace a dead worker handle with a fresh actor carrying the
+        local worker's current weights."""
+        import ray_tpu
+        i = self.remote_workers.index(worker)
+        fresh = self._spawn(i + 1)
+        fresh.set_weights.remote(self.local_worker.get_weights())
+        self.remote_workers[i] = fresh
+        return fresh
+
+    def sync_weights(self) -> None:
+        """Broadcast local weights to remotes (``ppo.py:427-430``)."""
+        import ray_tpu
+        from ray_tpu.exceptions import ActorDiedError
+        if not self.remote_workers:
+            return
+        weights_ref = ray_tpu.put(self.local_worker.get_weights())
+        for w, ref in [(w, w.set_weights.remote(weights_ref))
+                       for w in list(self.remote_workers)]:
+            try:
+                ray_tpu.get(ref)
+            except ActorDiedError:
+                self.recreate_failed_worker(w)
+
+    def foreach_worker(self, fn: Callable[[RolloutWorker], Any]) -> List[Any]:
+        import ray_tpu
+        results = [fn(self.local_worker)]
+        if self.remote_workers:
+            results += ray_tpu.get(
+                [w.apply.remote(fn) for w in self.remote_workers])
+        return results
+
+    def collect_metrics(self) -> List[dict]:
+        import ray_tpu
+        from ray_tpu.exceptions import ActorDiedError
+        episodes = self.local_worker.pop_metrics()
+        for w, ref in [(w, w.pop_metrics.remote())
+                       for w in list(self.remote_workers)]:
+            try:
+                episodes.extend(ray_tpu.get(ref))
+            except ActorDiedError:
+                self.recreate_failed_worker(w)
+        return episodes
+
+    def stop(self) -> None:
+        import ray_tpu
+        for w in self.remote_workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.remote_workers = []
+
+
+def synchronous_parallel_sample(workers: WorkerSet,
+                                max_env_steps: Optional[int] = None
+                                ) -> SampleBatch:
+    """Round-robin sample() across all workers until the step budget is met
+    (reference: ``rollout_ops.py:36``)."""
+    import ray_tpu
+    from ray_tpu.exceptions import ActorDiedError
+    batches: List[SampleBatch] = []
+    total = 0
+    while True:
+        round_batches = []
+        if workers.remote_workers:
+            refs = [(w, w.sample.remote()) for w in workers.remote_workers]
+            for w, ref in refs:
+                try:
+                    round_batches.append(ray_tpu.get(ref))
+                except ActorDiedError:
+                    workers.recreate_failed_worker(w)
+        else:
+            round_batches = [workers.local_worker.sample()]
+        for b in round_batches:
+            batches.append(b)
+            total += len(b)
+        if max_env_steps is None or total >= max_env_steps:
+            break
+    return concat_samples(batches)

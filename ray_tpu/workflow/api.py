@@ -21,7 +21,6 @@ from ray_tpu.workflow.storage import WorkflowStorage
 
 _base_dir: Optional[str] = None
 _async_runs: Dict[str, threading.Thread] = {}
-_async_results: Dict[str, Any] = {}
 
 
 def init(storage_base_dir: Optional[str] = None) -> None:
@@ -51,10 +50,15 @@ def run_async(dag, *, workflow_id: Optional[str] = None) -> str:
     workflow_id = workflow_id or f"workflow-{uuid.uuid4().hex[:8]}"
 
     def target():
+        # Storage is the authoritative result (status + per-task values);
+        # nothing is cached in process globals, so finished runs leave no
+        # unbounded state behind. The run itself persists SUCCESS/FAILED.
         try:
-            _async_results[workflow_id] = run(dag, workflow_id=workflow_id)
-        except BaseException as e:
-            _async_results[workflow_id] = e
+            run(dag, workflow_id=workflow_id)
+        except BaseException:
+            pass  # recorded in storage as FAILED by the executor
+        finally:
+            _async_runs.pop(workflow_id, None)
 
     t = threading.Thread(target=target, daemon=True,
                          name=f"workflow-{workflow_id}")
@@ -89,12 +93,13 @@ def get_output(workflow_id: str, *, wait: bool = False,
         # created its storage directory yet. Storage stays authoritative
         # afterwards (a deleted workflow must raise, not return a stale
         # in-memory value).
-        t = _async_runs.pop(workflow_id, None)
+        t = _async_runs.get(workflow_id)
         if t is not None:
             t.join(timeout)
-        res = _async_results.pop(workflow_id, None)
-        if isinstance(res, BaseException):
-            raise res
+            if t.is_alive():
+                raise TimeoutError(
+                    f"Workflow {workflow_id!r} still running after "
+                    f"{timeout}s")
     if not storage.exists():
         raise ValueError(f"No workflow with id {workflow_id!r}")
     info = storage.load_status()

@@ -31,31 +31,44 @@ def _node_children(node: dag_mod.DAGNode):
             yield a
 
 
+def topo_order(root: dag_mod.DAGNode) -> list:
+    """Dependencies-before-dependents node list, iteratively (deep chains
+    must not hit the recursion limit). The single source of truth for DAG
+    traversal order — task-id assignment and execution both use it, so
+    resume matching can never desynchronize from run order."""
+    order = []
+    stack = [(root, False)]
+    seen = set()
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        # Reversed so the first child is visited (and ordered) first,
+        # matching depth-first order.
+        for child in reversed(list(_node_children(node))):
+            stack.append((child, False))
+    return order
+
+
 def assign_task_ids(root: dag_mod.DAGNode) -> Dict[int, str]:
     """Deterministic structural task IDs: depth-first position + name.
 
     The same DAG built twice gets the same IDs, which is what makes
     resume able to match persisted results to nodes.
     """
-    ids: Dict[int, str] = {}
-    counter = [0]
-
     def name_of(node) -> str:
         if isinstance(node, dag_mod.FunctionNode):
             fn = getattr(node._remote_fn, "_function", None)
             return getattr(fn, "__name__", "task")
         return type(node).__name__.lower()
 
-    def visit(node):
-        if id(node) in ids:
-            return
-        for child in _node_children(node):
-            visit(child)
-        ids[id(node)] = f"{counter[0]:04d}_{name_of(node)}"
-        counter[0] += 1
-
-    visit(root)
-    return ids
+    return {id(node): f"{i:04d}_{name_of(node)}"
+            for i, node in enumerate(topo_order(root))}
 
 
 class WorkflowExecutor:
@@ -64,45 +77,55 @@ class WorkflowExecutor:
         self.storage = storage
 
     def execute(self, root: dag_mod.DAGNode) -> Any:
-        """Run the DAG to completion, persisting each task result."""
+        """Run the DAG to completion, persisting each task result.
+
+        Iterative (deep chains must not hit the recursion limit) and
+        submission-eager: every task whose dependencies are submitted is
+        itself submitted with the upstream ``ObjectRef``s as arguments, so
+        independent branches run concurrently on the cluster; results are
+        then gathered and persisted in topological order. Crash-safety is
+        unchanged — an unpersisted task is simply re-run on resume.
+        """
         import ray_tpu
+        order = topo_order(root)
         ids = assign_task_ids(root)
         self.storage.save_status("RUNNING")
-        memo: Dict[int, Any] = {}
 
-        def evaluate(node: dag_mod.DAGNode) -> Any:
-            key = id(node)
-            if key in memo:
-                return memo[key]
-            task_id = ids[key]
-            if self.storage.has_task_result(task_id):
-                logger.info("workflow %s: task %s replayed from storage",
-                            self.workflow_id, task_id)
-                memo[key] = self.storage.load_task_result(task_id)
-                return memo[key]
+        refs: Dict[int, Any] = {}      # submitted this run
+        memo: Dict[int, Any] = {}      # replayed from storage
 
-            def resolve(v):
-                if isinstance(v, dag_mod.DAGNode):
-                    return evaluate(v)
-                return v
-
-            args = tuple(resolve(a) for a in node._bound_args)
-            kwargs = {k: resolve(v) for k, v in node._bound_kwargs.items()}
-            if isinstance(node, dag_mod.FunctionNode):
-                ref = node._remote_fn.remote(*args, **kwargs)
-                result = ray_tpu.get(ref)
-            else:
-                # InputNode included: workflows take no runtime input, so
-                # an InputNode in the DAG is a user error, not a None.
-                raise TypeError(
-                    f"Workflows support function nodes, got {type(node)}; "
-                    f"wrap stateful steps in tasks")
-            self.storage.save_task_result(task_id, result)
-            memo[key] = result
-            return result
+        def resolve(v):
+            if isinstance(v, dag_mod.DAGNode):
+                k = id(v)
+                return memo[k] if k in memo else refs[k]
+            return v
 
         try:
-            result = evaluate(root)
+            for node in order:
+                key = id(node)
+                task_id = ids[key]
+                if self.storage.has_task_result(task_id):
+                    logger.info("workflow %s: task %s replayed from storage",
+                                self.workflow_id, task_id)
+                    memo[key] = self.storage.load_task_result(task_id)
+                    continue
+                if not isinstance(node, dag_mod.FunctionNode):
+                    # InputNode included: workflows take no runtime input,
+                    # so an InputNode in the DAG is a user error.
+                    raise TypeError(
+                        f"Workflows support function nodes, got "
+                        f"{type(node)}; wrap stateful steps in tasks")
+                args = tuple(resolve(a) for a in node._bound_args)
+                kwargs = {k: resolve(v)
+                          for k, v in node._bound_kwargs.items()}
+                refs[key] = node._remote_fn.remote(*args, **kwargs)
+            for node in order:
+                key = id(node)
+                if key in refs:
+                    value = ray_tpu.get(refs[key])
+                    self.storage.save_task_result(ids[key], value)
+                    memo[key] = value
+            result = memo[id(root)]
         except Exception as e:
             self.storage.save_status("FAILED", error=repr(e))
             raise WorkflowExecutionError(self.workflow_id, e) from e
