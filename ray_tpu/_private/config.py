@@ -114,6 +114,8 @@ _config.define("ici_axes_preference", str, "data,fsdp,tensor",
 
 # -- Logging / events -----------------------------------------------------------
 _config.define("event_log_dir", str, "/tmp/ray_tpu/events", "")
+_config.define("event_log_enabled", bool, False,
+               "persist structured events as JSONL under event_log_dir")
 _config.define("log_dir", str, "/tmp/ray_tpu/logs", "")
 _config.define("metrics_report_interval_ms", int, 2000, "")
 

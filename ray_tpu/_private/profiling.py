@@ -1,0 +1,123 @@
+"""Task/actor span recording and chrome-tracing export.
+
+Parity with the reference's timeline pipeline: per-worker profile events
+(``src/ray/core_worker/profiling.h:30``) aggregated by
+``GlobalState.chrome_tracing_dump`` (``python/ray/_private/state.py:419``)
+behind the ``ray timeline`` CLI (``scripts.py:1755``). Spans are recorded
+in-process (the host-granular runtime has no cross-process hop) and
+dumped in the chrome://tracing "X" (complete-event) format.
+
+For device-side detail the TPU story is strictly better than py-spy:
+``start_device_trace``/``stop_device_trace`` wrap ``jax.profiler`` so an
+XLA trace (HLO timings, HBM usage) lands next to the host spans.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.config import _config
+
+
+class Profiler:
+    """Bounded in-memory span buffer. Thread-safe, cheap when disabled."""
+
+    def __init__(self, max_spans: int = 200_000):
+        self._lock = threading.Lock()
+        self._spans: List[dict] = []
+        self._max = max_spans
+
+    @property
+    def enabled(self) -> bool:
+        return bool(_config.get("profiling_enabled"))
+
+    def record(self, name: str, cat: str, pid: str, start_s: float,
+               dur_s: float, args: Optional[Dict[str, Any]] = None):
+        if not self.enabled:
+            return
+        span = {
+            "name": name,
+            "cat": cat,
+            "ph": "X",
+            "pid": pid,
+            "tid": threading.current_thread().name,
+            "ts": start_s * 1e6,
+            "dur": dur_s * 1e6,
+        }
+        if args:
+            span["args"] = args
+        with self._lock:
+            self._spans.append(span)
+            if len(self._spans) > self._max:
+                del self._spans[: self._max // 2]
+
+    def chrome_trace(self) -> List[dict]:
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self):
+        with self._lock:
+            self._spans.clear()
+
+
+_profiler = Profiler()
+
+
+def get_profiler() -> Profiler:
+    return _profiler
+
+
+def dump_timeline(filename: Optional[str] = None) -> Any:
+    """Chrome-tracing dump of recorded spans (``ray timeline``,
+    ``state.py:419``). Returns the event list, or writes it to
+    ``filename`` and returns the path."""
+    trace = _profiler.chrome_trace()
+    if filename is None:
+        return trace
+    with open(filename, "w") as f:
+        json.dump(trace, f)
+    return filename
+
+
+# -- device-side tracing ----------------------------------------------------
+
+_device_trace_dir: Optional[str] = None
+
+
+def start_device_trace(log_dir: str) -> None:
+    """Begin an XLA profiler trace (TPU timeline; jax.profiler)."""
+    global _device_trace_dir
+    import jax
+    jax.profiler.start_trace(log_dir)
+    _device_trace_dir = log_dir
+
+
+def stop_device_trace() -> Optional[str]:
+    global _device_trace_dir
+    import jax
+    jax.profiler.stop_trace()
+    out, _device_trace_dir = _device_trace_dir, None
+    return out
+
+
+class profile_span:
+    """Context manager for user code spans (reference:
+    ``ray.profiling.profile`` events, ``_raylet.pyx:1613``)."""
+
+    def __init__(self, name: str, cat: str = "user",
+                 args: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def __enter__(self):
+        self._t0 = time.time()
+        return self
+
+    def __exit__(self, *exc_info):
+        _profiler.record(self.name, self.cat, pid="driver",
+                         start_s=self._t0, dur_s=time.time() - self._t0,
+                         args=self.args)

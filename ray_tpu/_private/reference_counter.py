@@ -66,6 +66,10 @@ class ReferenceCounter:
         with self._lock:
             return self._local_refs.get(oid, 0) > 0 or self._pins.get(oid, 0) > 0
 
+    def count(self, oid: ObjectID) -> int:
+        with self._lock:
+            return self._local_refs.get(oid, 0) + self._pins.get(oid, 0)
+
     def live_objects(self) -> Set[ObjectID]:
         with self._lock:
             return set(self._local_refs) | set(self._pins)

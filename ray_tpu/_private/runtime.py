@@ -218,6 +218,7 @@ class Runtime:
         self._pending: List[dict] = []
         self._pending_cv = threading.Condition()
         self._dispatch_dirty = False  # kick arrived while loop was busy
+        self.autoscaling_enabled = False  # set by StandardAutoscaler
         self._util_pool = ThreadPoolExecutor(max_workers=32,
                                              thread_name_prefix="rt-util")
         self._shutdown = False
@@ -225,6 +226,8 @@ class Runtime:
                                             name="rt-dispatcher", daemon=True)
         self._dispatcher.start()
         self._events: List[dict] = []  # structured event log
+        self._event_file = None
+        self._event_file_lock = threading.Lock()
 
     # ------------------------------------------------------------------ nodes
 
@@ -416,6 +419,12 @@ class Runtime:
                 try:
                     action = self._try_dispatch(item)
                 except Infeasible as e:
+                    if self.autoscaling_enabled:
+                        # The cluster can grow: keep infeasible tasks
+                        # queued as autoscaler demand (reference: pending
+                        # infeasible tasks feed resource_demand_scheduler).
+                        still_waiting.append(item)
+                        continue
                     spec = item["spec"]
                     err_cls = (exc.PlacementGroupSchedulingError
                                if spec.options.placement_group is not None
@@ -616,7 +625,12 @@ class Runtime:
                 raise exc.TaskCancelledError(spec.task_id)
             args = _resolve_refs(spec.args, self)
             kwargs = _resolve_refs(spec.kwargs, self)
-            result = spec.function(*args, **kwargs)
+            env = _materialize_env(spec)
+            if env is not None:
+                with env.applied():
+                    result = spec.function(*args, **kwargs)
+            else:
+                result = spec.function(*args, **kwargs)
             if cancel.is_set():
                 raise exc.TaskCancelledError(spec.task_id)
             self._seal_results(spec, node, result)
@@ -627,8 +641,13 @@ class Runtime:
         finally:
             alloc_target.release(request)
             self._unpin_args(spec)
+            dur = time.monotonic() - t0
             self.emit_event("TASK_DONE", task=spec.function_name,
-                            ms=round((time.monotonic() - t0) * 1e3, 3))
+                            ms=round(dur * 1e3, 3))
+            _prof().record(spec.function_name, "task",
+                           pid=f"node:{node.node_id.hex()[:8]}",
+                           start_s=time.time() - dur, dur_s=dur,
+                           args={"task_id": spec.task_id.hex()})
             (ctx.node_id, ctx.task_id, ctx.job_id, ctx.put_counter,
              ctx.devices, ctx.cancel_flag, ctx.placement_group) = prev
             self._kick()
@@ -747,7 +766,12 @@ class Runtime:
             try:
                 args = _resolve_refs(state.args, self)
                 kwargs = _resolve_refs(state.kwargs, self)
-                state.instance = state.cls(*args, **kwargs)
+                env = _materialize_env_for_actor(state)
+                if env is not None:
+                    with env.applied():
+                        state.instance = state.cls(*args, **kwargs)
+                else:
+                    state.instance = state.cls(*args, **kwargs)
                 state.status = ActorState.ALIVE
                 state.ready.set()
                 self.emit_event("ACTOR_ALIVE", actor=state.cls.__name__)
@@ -800,13 +824,19 @@ class Runtime:
             ctx.task_id = spec.task_id
             ctx.cancel_flag = cancel
             ctx.put_counter = 0
+            t0 = time.monotonic()
             try:
                 if cancel.is_set():
                     raise exc.TaskCancelledError(spec.task_id)
                 args = _resolve_refs(spec.args, self)
                 kwargs = _resolve_refs(spec.kwargs, self)
                 method = getattr(state.instance, spec.method_name)
-                result = method(*args, **kwargs)
+                env = _materialize_env(spec, state)
+                if env is not None:
+                    with env.applied():
+                        result = method(*args, **kwargs)
+                else:
+                    result = method(*args, **kwargs)
                 self._seal_results(spec, node, result)
                 with self.lock:
                     self.task_states[spec.task_id] = "FINISHED"
@@ -822,6 +852,12 @@ class Runtime:
                     self.task_states[spec.task_id] = "FAILED"
             finally:
                 self._unpin_args(spec)
+                dur = time.monotonic() - t0
+                _prof().record(
+                    f"{state.cls.__name__}.{spec.method_name}",
+                    "actor_task", pid=f"node:{node.node_id.hex()[:8]}",
+                    start_s=time.time() - dur, dur_s=dur,
+                    args={"actor_id": state.actor_id.hex()})
                 self._kick()
 
     def _run_async_actor_loop(self, state: ActorState, max_concurrency: int):
@@ -839,7 +875,12 @@ class Runtime:
                     args = _resolve_refs(spec.args, self)
                     kwargs = _resolve_refs(spec.kwargs, self)
                     method = getattr(state.instance, spec.method_name)
-                    result = method(*args, **kwargs)
+                    env = _materialize_env(spec, state)
+                    if env is not None:
+                        with env.applied():
+                            result = method(*args, **kwargs)
+                    else:
+                        result = method(*args, **kwargs)
                     if asyncio.iscoroutine(result):
                         result = await result
                     self._seal_results(spec, node, result)
@@ -1047,13 +1088,44 @@ class Runtime:
         self._util_pool.submit(fn)
 
     def emit_event(self, kind: str, **fields):
+        """Structured event (the RAY_EVENT/EventManager role,
+        ``src/ray/util/event.h:42,102``): in-memory ring for the state
+        API, JSONL on disk when ``event_log_enabled``."""
         ev = {"ts": time.time(), "kind": kind, **fields}
         self._events.append(ev)
         if len(self._events) > 100000:
             del self._events[:50000]
+        if _config.get("event_log_enabled"):
+            self._persist_event(ev)
+
+    def _persist_event(self, ev: dict):
+        import json
+        with self._event_file_lock:
+            if self._event_file is None:
+                d = _config.get("event_log_dir")
+                os.makedirs(d, exist_ok=True)
+                path = os.path.join(
+                    d, f"events_{self.job_id.hex()[:8]}.jsonl")
+                self._event_file = open(path, "a", buffering=1)
+            try:
+                self._event_file.write(json.dumps(ev, default=str) + "\n")
+            except Exception:
+                pass
 
     def events(self) -> List[dict]:
         return list(self._events)
+
+    def pending_resource_demands(self) -> List[Dict[str, float]]:
+        """Resource requests of queued (not yet dispatched) tasks — the
+        autoscaler's demand signal (reference: LoadMetrics fed from GCS
+        resource reports, ``autoscaler/_private/load_metrics.py``)."""
+        with self._pending_cv:
+            pending = list(self._pending)
+        out = []
+        for item in pending:
+            spec = item["spec"]
+            out.append(self._effective_request(spec).to_dict())
+        return out
 
     def shutdown(self):
         self._shutdown = True
@@ -1064,9 +1136,38 @@ class Runtime:
         for node in self.nodes.values():
             node.shutdown()
         self._util_pool.shutdown(wait=False, cancel_futures=True)
+        if self._event_file is not None:
+            try:
+                self._event_file.close()
+            except Exception:
+                pass
+            self._event_file = None
 
 
 # -- helpers -----------------------------------------------------------------
+
+
+def _prof():
+    from ray_tpu._private.profiling import get_profiler
+    return get_profiler()
+
+
+def _materialize_env(spec: TaskSpec, actor_state=None):
+    """Task-level runtime_env, else the actor's creation-time env."""
+    env = spec.options.runtime_env
+    if env is None and actor_state is not None:
+        env = actor_state.options.runtime_env
+    if not env:
+        return None
+    from ray_tpu._private.runtime_env import get_manager
+    return get_manager().get_or_create(env)
+
+
+def _materialize_env_for_actor(state):
+    if not state.options.runtime_env:
+        return None
+    from ray_tpu._private.runtime_env import get_manager
+    return get_manager().get_or_create(state.options.runtime_env)
 
 
 def _ref_ids_in(args, kwargs) -> List[ObjectID]:

@@ -1,0 +1,166 @@
+"""Per-task / per-actor runtime environments.
+
+Parity with ``python/ray/_private/runtime_env/`` (working_dir/py_modules
+packaging ``packaging.py``, env_vars, URI-keyed caching ``uri_cache.py``;
+materialized by the per-node runtime-env agent
+``dashboard/modules/runtime_env/runtime_env_agent.py:159,256``).
+
+Host-granular redesign: workers are threads of the device-owner process,
+so "materialize" means (a) stage working_dir/py_modules into a
+content-hashed cache directory and put them on ``sys.path``, and (b)
+apply ``env_vars`` around execution under a global env lock (os.environ
+is process-wide — concurrent tasks with conflicting env_vars serialize
+on this lock rather than racing). ``pip``/``conda`` fields are rejected:
+the runtime has no network egress and one shared interpreter.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import os
+import shutil
+import sys
+import threading
+import zipfile
+from typing import Any, Dict, List, Optional
+
+_CACHE_DIR = "/tmp/ray_tpu/runtime_envs"
+_ENV_LOCK = threading.RLock()
+_SUPPORTED = {"env_vars", "working_dir", "py_modules"}
+
+
+class RuntimeEnvError(ValueError):
+    pass
+
+
+def validate(runtime_env: Dict[str, Any]) -> None:
+    unsupported = set(runtime_env) - _SUPPORTED
+    if unsupported & {"pip", "conda", "container"}:
+        raise RuntimeEnvError(
+            f"runtime_env fields {sorted(unsupported)} are not supported: "
+            "the host-granular runtime shares one interpreter per host and "
+            "has no package egress. Bake dependencies into the image.")
+    if unsupported:
+        raise RuntimeEnvError(
+            f"unknown runtime_env fields {sorted(unsupported)}; "
+            f"supported: {sorted(_SUPPORTED)}")
+
+
+def _hash_path(path: str) -> str:
+    """Content hash of a file or directory tree (the URI in uri_cache)."""
+    h = hashlib.blake2b(digest_size=16)
+    if os.path.isfile(path):
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+    else:
+        for root, dirs, files in sorted(os.walk(path)):
+            dirs.sort()
+            for name in sorted(files):
+                p = os.path.join(root, name)
+                h.update(os.path.relpath(p, path).encode())
+                with open(p, "rb") as f:
+                    for chunk in iter(lambda: f.read(1 << 20), b""):
+                        h.update(chunk)
+    return h.hexdigest()
+
+
+def _stage(path: str) -> str:
+    """Copy/extract ``path`` (dir or .zip) into the content-hash cache and
+    return the staged directory (idempotent — cache hit is free)."""
+    if not os.path.exists(path):
+        raise RuntimeEnvError(f"runtime_env path {path!r} does not exist")
+    digest = _hash_path(path)
+    target = os.path.join(_CACHE_DIR, digest)
+    if os.path.isdir(target):
+        return target
+    tmp = target + ".staging"
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp, exist_ok=True)
+    if zipfile.is_zipfile(path):
+        with zipfile.ZipFile(path) as z:
+            z.extractall(tmp)
+    elif os.path.isdir(path):
+        shutil.copytree(path, tmp, dirs_exist_ok=True)
+    else:
+        raise RuntimeEnvError(
+            f"working_dir/py_modules must be a directory or zip: {path!r}")
+    try:
+        os.replace(tmp, target)
+    except OSError:
+        # A concurrent materialization won the race; use its copy.
+        shutil.rmtree(tmp, ignore_errors=True)
+    return target
+
+
+class MaterializedEnv:
+    """A staged environment ready to wrap task execution."""
+
+    def __init__(self, env_vars: Dict[str, str],
+                 sys_paths: List[str]):
+        self.env_vars = env_vars
+        self.sys_paths = sys_paths
+
+    @contextlib.contextmanager
+    def applied(self):
+        with _ENV_LOCK:
+            saved = {k: os.environ.get(k) for k in self.env_vars}
+            inserted = []
+            try:
+                os.environ.update(self.env_vars)
+                for p in self.sys_paths:
+                    if p not in sys.path:
+                        sys.path.insert(0, p)
+                        inserted.append(p)
+                yield
+            finally:
+                for k, v in saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+                for p in inserted:
+                    with contextlib.suppress(ValueError):
+                        sys.path.remove(p)
+
+
+class RuntimeEnvManager:
+    """Materializes and caches runtime envs (the runtime-env agent role,
+    ``GetOrCreateRuntimeEnv`` ``runtime_env_agent.py:256``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cache: Dict[str, MaterializedEnv] = {}
+        self.num_materialized = 0
+
+    def get_or_create(self, runtime_env: Optional[Dict[str, Any]]
+                      ) -> Optional[MaterializedEnv]:
+        if not runtime_env:
+            return None
+        validate(runtime_env)
+        # Stage first: staging is content-hashed, so the cache key reflects
+        # the CURRENT file contents — editing working_dir and resubmitting
+        # must pick up the new code, not a stale repr-keyed entry.
+        sys_paths: List[str] = []
+        if "working_dir" in runtime_env:
+            sys_paths.append(_stage(runtime_env["working_dir"]))
+        for mod in runtime_env.get("py_modules", ()):
+            sys_paths.append(_stage(mod))
+        env_vars = dict(runtime_env.get("env_vars", {}))
+        key = repr((sorted(env_vars.items()), sys_paths))
+        with self._lock:
+            hit = self._cache.get(key)
+            if hit is not None:
+                return hit
+            env = MaterializedEnv(env_vars, sys_paths)
+            self._cache[key] = env
+            self.num_materialized += 1
+            return env
+
+
+_manager = RuntimeEnvManager()
+
+
+def get_manager() -> RuntimeEnvManager:
+    return _manager

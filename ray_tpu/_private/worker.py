@@ -44,6 +44,8 @@ def init(num_cpus: Optional[float] = None,
          resources: Optional[Dict[str, float]] = None,
          namespace: Optional[str] = None,
          ignore_reinit_error: bool = False,
+         include_dashboard: bool = False,
+         dashboard_port: int = 0,
          _system_config: Optional[dict] = None,
          _create_default_node: bool = True,
          **kwargs) -> "Worker":
@@ -69,6 +71,9 @@ def init(num_cpus: Optional[float] = None,
                 amounts.update(resources)
             runtime.add_node(ResourceSet(amounts))
         _global = Worker(runtime, namespace or "default")
+        if include_dashboard:
+            from ray_tpu._private.state_server import start_state_server
+            _global.dashboard_port = start_state_server(dashboard_port)
         return _global
 
 
@@ -76,6 +81,9 @@ def shutdown():
     global _global
     with _global_lock:
         if _global is not None:
+            if getattr(_global, "dashboard_port", None) is not None:
+                from ray_tpu._private.state_server import stop_state_server
+                stop_state_server()
             _global.runtime.shutdown()
             _global = None
 
@@ -202,3 +210,11 @@ def nodes() -> List[dict]:
         "Resources": ns.resources.total.to_dict(),
         "Available": ns.resources.available.to_dict(),
     } for ns in w.runtime.node_states()]
+
+
+def timeline(filename: Optional[str] = None):
+    """Chrome-tracing dump of task/actor spans (reference: ``ray timeline``
+    CLI ``scripts.py:1755`` → ``GlobalState.chrome_tracing_dump``
+    ``state.py:419``)."""
+    from ray_tpu._private.profiling import dump_timeline
+    return dump_timeline(filename)

@@ -1,0 +1,17 @@
+"""Autoscaling: demand-driven node launch/terminate.
+
+Parity with ``python/ray/autoscaler/`` (``StandardAutoscaler``
+``_private/autoscaler.py:147``, ``LoadMetrics``, the pluggable
+``NodeProvider`` API ``node_provider.py``, and the in-process
+``FakeMultiNodeProvider`` ``_private/fake_multi_node/node_provider.py:237``
+used by CI). The TPU deployment target is pod slices: a provider models
+node types like ``tpu-v4-8`` host groups; the fake provider adds/removes
+nodes of the in-process runtime for tests.
+"""
+
+from ray_tpu.autoscaler.autoscaler import (AutoscalerConfig, LoadMetrics,
+                                           StandardAutoscaler)
+from ray_tpu.autoscaler.node_provider import (FakeNodeProvider, NodeProvider)
+
+__all__ = ["StandardAutoscaler", "AutoscalerConfig", "LoadMetrics",
+           "NodeProvider", "FakeNodeProvider"]

@@ -1,0 +1,189 @@
+"""The autoscaling control loop.
+
+Parity with ``StandardAutoscaler.update``
+(``autoscaler/_private/autoscaler.py:147,336``): read demand from
+``LoadMetrics``, bin-pack unmet demand onto the cheapest feasible node
+types (``resource_demand_scheduler.py``'s role), launch within
+``max_workers``, terminate nodes idle past ``idle_timeout_s``. Driven
+either manually (tests call ``update()``) or by ``start()``'s monitor
+thread (the head-side ``Monitor`` process, ``monitor.py:125``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+
+@dataclass
+class AutoscalerConfig:
+    node_types: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    max_workers: int = 10
+    min_workers: int = 0
+    max_workers_per_type: Dict[str, int] = field(default_factory=dict)
+    idle_timeout_s: float = 60.0
+    update_interval_s: float = 5.0
+    upscaling_speed: float = 1.0  # max new nodes = max(1, speed * current)
+
+
+class LoadMetrics:
+    """Demand + utilization snapshot from the runtime (reference:
+    ``load_metrics.py`` fed by GCS resource-usage reports)."""
+
+    def __init__(self, runtime):
+        self._runtime = runtime
+
+    def pending_demands(self) -> List[Dict[str, float]]:
+        return self._runtime.pending_resource_demands()
+
+    def node_utilization(self) -> Dict[str, dict]:
+        """node hex id -> {"total": .., "available": .., "idle": bool}."""
+        out = {}
+        for ns in self._runtime.node_states():
+            if not ns.alive:
+                continue
+            total = ns.resources.total.to_dict()
+            avail = ns.resources.available.to_dict()
+            idle = all(avail.get(k, 0.0) >= v for k, v in total.items())
+            out[ns.node_id.hex()] = {
+                "total": total, "available": avail, "idle": idle}
+        return out
+
+
+def _fits(demand: Dict[str, float], capacity: Dict[str, float]) -> bool:
+    return all(capacity.get(k, 0.0) >= v for k, v in demand.items() if v > 0)
+
+
+class StandardAutoscaler:
+    def __init__(self, config: AutoscalerConfig, provider: NodeProvider,
+                 runtime=None):
+        if runtime is None:
+            from ray_tpu._private import worker as _worker
+            runtime = _worker.global_worker().runtime
+        self.config = config
+        self.provider = provider
+        self.load_metrics = LoadMetrics(runtime)
+        self._runtime = runtime
+        # Infeasible tasks must queue (as demand) rather than fail fast.
+        runtime.autoscaling_enabled = True
+        self._idle_since: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.num_launches = 0
+        self.num_terminations = 0
+
+    # -- one reconciliation pass (autoscaler.py:336 update) ---------------
+
+    def update(self) -> Dict[str, int]:
+        launched = self._scale_up()
+        terminated = self._scale_down()
+        return {"launched": launched, "terminated": terminated}
+
+    def _unmet_demands(self) -> List[Dict[str, float]]:
+        """Demands that no live node could satisfy even when empty."""
+        demands = self.load_metrics.pending_demands()
+        if not demands:
+            return []
+        node_totals = [u["total"] for u in
+                       self.load_metrics.node_utilization().values()]
+        return [d for d in demands
+                if not any(_fits(d, t) for t in node_totals)]
+
+    def _scale_up(self) -> int:
+        unmet = self._unmet_demands()
+        current = self.provider.non_terminated_nodes()
+        budget = self.config.max_workers - len(current)
+        if budget <= 0 and len(current) >= self.config.min_workers:
+            if not unmet:
+                return 0
+        min_needed = max(0, self.config.min_workers - len(current))
+        # min_workers is a hard floor — not throttled by upscaling_speed.
+        launch_cap = max(1, min_needed,
+                         int(self.config.upscaling_speed
+                             * max(1, len(current))))
+        to_launch: Dict[str, int] = {}
+        # Ensure min_workers of the first declared type.
+        if len(current) < self.config.min_workers and self.config.node_types:
+            first = next(iter(self.config.node_types))
+            to_launch[first] = self.config.min_workers - len(current)
+        # Bin-pack each unmet demand onto the smallest feasible type
+        # (types are assumed declared small->large, reference sorts by
+        # resources; we sort by total resource sum).
+        types_sorted = sorted(
+            self.config.node_types.items(),
+            key=lambda kv: sum(kv[1].values()))
+        for demand in unmet:
+            for tname, tres in types_sorted:
+                if _fits(demand, tres):
+                    cap = self.config.max_workers_per_type.get(
+                        tname, self.config.max_workers)
+                    already = sum(
+                        1 for pid in current
+                        if self.provider.node_type(pid) == tname)
+                    if already + to_launch.get(tname, 0) < cap:
+                        to_launch[tname] = to_launch.get(tname, 0) + 1
+                    break
+        launched = 0
+        for tname, count in to_launch.items():
+            count = min(count,
+                        self.config.max_workers - len(current) - launched,
+                        launch_cap - launched)
+            if count <= 0:
+                continue
+            self.provider.create_node(tname, count)
+            launched += count
+        self.num_launches += launched
+        return launched
+
+    def _scale_down(self) -> int:
+        util = self.load_metrics.node_utilization()
+        now = time.monotonic()
+        current = self.provider.non_terminated_nodes()
+        terminated = 0
+        for pid in current:
+            if len(current) - terminated <= self.config.min_workers:
+                break
+            try:
+                rid = self.provider.runtime_node_id(pid).hex()
+            except (AttributeError, KeyError):
+                continue
+            info = util.get(rid)
+            if info is None or not info["idle"]:
+                self._idle_since.pop(pid, None)
+                continue
+            first_idle = self._idle_since.setdefault(pid, now)
+            if now - first_idle >= self.config.idle_timeout_s:
+                self.provider.terminate_node(pid)
+                self._idle_since.pop(pid, None)
+                terminated += 1
+        self.num_terminations += terminated
+        return terminated
+
+    # -- monitor thread (reference: Monitor process, monitor.py:125) ------
+
+    def start(self):
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="autoscaler-monitor")
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(self.config.update_interval_s):
+            try:
+                self.update()
+            except Exception:  # pragma: no cover — monitor must survive
+                import logging
+                logging.getLogger("ray_tpu").exception("autoscaler update")
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        # Restore fail-fast for infeasible tasks: nothing will grow the
+        # cluster anymore, so queued-forever would hang callers.
+        self._runtime.autoscaling_enabled = False
+        self._runtime._kick()

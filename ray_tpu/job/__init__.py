@@ -1,0 +1,4 @@
+from ray_tpu.job.job_manager import (JobInfo, JobManager, JobStatus,
+                                     JobSubmissionClient)
+
+__all__ = ["JobManager", "JobSubmissionClient", "JobStatus", "JobInfo"]

