@@ -84,6 +84,9 @@ _config.define("scheduler_spread_threshold", float, 0.5,
 _config.define("scheduler_top_k_fraction", float, 0.2,
                "fraction of nodes in the hybrid policy random top-k pick")
 _config.define("max_pending_lease_requests_per_scheduling_category", int, 10, "")
+_config.define("use_native_scheduler", bool, True,
+               "hybrid/spread policy selection via the C++ kernels "
+               "(ray_tpu/_native/scheduling.cc); Python fallback otherwise")
 
 # -- Object store ---------------------------------------------------------------
 _config.define("object_store_memory_bytes", int, 2 << 30,

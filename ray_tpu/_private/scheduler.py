@@ -28,6 +28,64 @@ from ray_tpu._private.config import _config
 from ray_tpu._private.ids import NodeID
 from ray_tpu._private.resources import NodeResources, ResourceSet
 
+_native_sched = None
+_native_checked = False
+
+
+def _native():
+    """The C++ policy kernels (``_native/scheduling.cc``), or None."""
+    global _native_sched, _native_checked
+    if not _native_checked:
+        _native_checked = True
+        if _config.get("use_native_scheduler"):
+            try:
+                from ray_tpu._native.build import load_native_library
+                _native_sched = load_native_library("scheduling")
+                if _native_sched is not None:
+                    import ctypes
+                    dp = ctypes.POINTER(ctypes.c_double)
+                    up = ctypes.POINTER(ctypes.c_uint8)
+                    i64 = ctypes.c_int64
+                    _native_sched.sched_hybrid_select.restype = i64
+                    _native_sched.sched_hybrid_select.argtypes = [
+                        dp, dp, up, dp, i64, i64, i64,
+                        ctypes.c_double, ctypes.c_double, i64]
+                    _native_sched.sched_spread_select.restype = i64
+                    _native_sched.sched_spread_select.argtypes = [
+                        dp, up, dp, i64, i64, i64]
+            except Exception:
+                _native_sched = None
+    return _native_sched
+
+
+def _flatten(nodes: Sequence["NodeState"], request: ResourceSet):
+    """Dense (available, total, alive, request) arrays over the union of
+    resource keys, for the native kernels."""
+    import ctypes
+    keys = list(request.to_dict().keys())
+    seen = set(keys)
+    for n in nodes:
+        for k in n.resources.total.to_dict():
+            if k not in seen:
+                seen.add(k)
+                keys.append(k)
+    n_nodes, n_res = len(nodes), max(1, len(keys))
+    avail = (ctypes.c_double * (n_nodes * n_res))()
+    total = (ctypes.c_double * (n_nodes * n_res))()
+    alive = (ctypes.c_uint8 * n_nodes)()
+    req = (ctypes.c_double * n_res)()
+    req_d = request.to_dict()
+    for j, k in enumerate(keys):
+        req[j] = req_d.get(k, 0.0)
+    for i, n in enumerate(nodes):
+        alive[i] = 1 if n.alive else 0
+        a = n.resources.available.to_dict()
+        t = n.resources.total.to_dict()
+        for j, k in enumerate(keys):
+            avail[i * n_res + j] = a.get(k, 0.0)
+            total[i * n_res + j] = t.get(k, 0.0)
+    return avail, total, alive, req, n_nodes, n_res
+
 
 class NodeState:
     """Scheduler-visible view of one node."""
@@ -57,6 +115,20 @@ class HybridPolicy:
                      else _config.get("scheduler_spread_threshold"))
         top_k_frac = (self.top_k_fraction if self.top_k_fraction is not None
                       else _config.get("scheduler_top_k_fraction"))
+        lib = _native()
+        if lib is not None:
+            avail, total, alive, req, n_nodes, n_res = _flatten(nodes,
+                                                                request)
+            preferred_idx = -1
+            if preferred is not None:
+                for i, n in enumerate(nodes):
+                    if n.node_id == preferred:
+                        preferred_idx = i
+                        break
+            idx = lib.sched_hybrid_select(
+                avail, total, alive, req, n_nodes, n_res, preferred_idx,
+                threshold, top_k_frac, self._rng.getrandbits(62))
+            return nodes[idx].node_id if idx >= 0 else None
         scored: List[Tuple[float, int, NodeID]] = []
         for i, n in enumerate(nodes):
             if not n.alive or not n.resources.can_fit(request):
@@ -81,6 +153,16 @@ class SpreadPolicy:
 
     def select(self, nodes: Sequence[NodeState], request: ResourceSet,
                preferred: Optional[NodeID] = None) -> Optional[NodeID]:
+        lib = _native()
+        if lib is not None:
+            avail, _total, alive, req, n_nodes, n_res = _flatten(nodes,
+                                                                 request)
+            with self._lock:
+                cursor = self._next
+                self._next += 1
+            idx = lib.sched_spread_select(avail, alive, req, n_nodes,
+                                          n_res, cursor)
+            return nodes[idx].node_id if idx >= 0 else None
         feasible = [n for n in nodes if n.alive and n.resources.can_fit(request)]
         if not feasible:
             return None

@@ -32,10 +32,23 @@ def normalize_block(block: Any):
     if isinstance(block, pd.DataFrame):
         return block
     if isinstance(block, dict):
-        return pd.DataFrame(block)
+        # Multi-dim columns (e.g. one-hot, images) become object columns
+        # of per-row arrays — pandas requires 1-D column arrays.
+        cols = {}
+        for k, v in block.items():
+            arr = np.asarray(v)
+            cols[k] = list(arr) if arr.ndim > 1 else arr
+        return pd.DataFrame(cols)
     if isinstance(block, np.ndarray):
-        return list(block)
-    return list(block)
+        block = list(block)
+    else:
+        block = list(block)
+    # Dict rows become tabular at block creation (the reference stores
+    # them as arrow blocks), so "numpy" batches are dicts of column
+    # arrays rather than object arrays of dicts.
+    if block and isinstance(block[0], dict):
+        return pd.DataFrame(block)
+    return block
 
 
 class BlockAccessor:

@@ -31,6 +31,13 @@ class TaskError(RayTpuError):
             f"task {function_name} failed: {type(cause).__name__}: {cause}\n"
             f"{self.remote_traceback}")
 
+    def __reduce__(self):
+        # Exception.__reduce__ replays BaseException.args into __init__,
+        # which doesn't match this signature — rebuild from our fields so
+        # the error survives pickling (e.g. across the thin-client wire).
+        return (TaskError, (self.function_name, self.cause,
+                            self.remote_traceback))
+
 
 class ActorError(RayTpuError):
     pass

@@ -1,0 +1,182 @@
+"""Client side: stubs that proxy the public API over the socket.
+
+Parity with the stub layer of Ray Client (``util/client/common.py``
+``ClientObjectRef``/``ClientActorHandle``/``ClientRemoteFunc``). One
+socket, one lock: calls are serialized per connection (the reference
+multiplexes streams; for a control-plane API the simple protocol wins).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ray_tpu.util.client.protocol import recv_msg, send_msg
+
+
+class ClientObjectRef:
+    def __init__(self, api: "ClientAPI", ref_id: str):
+        self._api = api
+        self.ref_id = ref_id
+
+    def __repr__(self):
+        return f"ClientObjectRef({self.ref_id[:8]})"
+
+    def __reduce__(self):
+        # On the wire a ref is just its server-side id; the server swaps
+        # the marker for the real ObjectRef (args travel pickled).
+        from ray_tpu.util.client.protocol import RefMarker
+        return (RefMarker, (self.ref_id,))
+
+
+class _ClientActorMethod:
+    def __init__(self, api: "ClientAPI", actor_key: str, method: str):
+        self._api = api
+        self._actor_key = actor_key
+        self._method = method
+
+    def remote(self, *args, **kwargs) -> ClientObjectRef:
+        rid = self._api._call({
+            "op": "actor_call", "actor_key": self._actor_key,
+            "method": self._method,
+            "args": args, "kwargs": kwargs})
+        return ClientObjectRef(self._api, rid)
+
+
+class ClientActorHandle:
+    def __init__(self, api: "ClientAPI", actor_key: str):
+        self._api = api
+        self._actor_key = actor_key
+
+    def __getattr__(self, name: str) -> _ClientActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _ClientActorMethod(self._api, self._actor_key, name)
+
+
+class ClientRemoteFunction:
+    def __init__(self, api: "ClientAPI", fn_id: str,
+                 options: Optional[dict] = None):
+        self._api = api
+        self._fn_id = fn_id
+        self._options = options
+
+    def options(self, **opts) -> "ClientRemoteFunction":
+        merged = dict(self._options or {})
+        merged.update(opts)
+        return ClientRemoteFunction(self._api, self._fn_id, merged)
+
+    def remote(self, *args, **kwargs):
+        out = self._api._call({
+            "op": "task", "fn_id": self._fn_id,
+            "options": self._options,
+            "args": args, "kwargs": kwargs})
+        if isinstance(out, list):
+            return [ClientObjectRef(self._api, r) for r in out]
+        return ClientObjectRef(self._api, out)
+
+
+class ClientActorClass:
+    def __init__(self, api: "ClientAPI", cls_id: str,
+                 options: Optional[dict] = None):
+        self._api = api
+        self._cls_id = cls_id
+        self._options = options
+
+    def options(self, **opts) -> "ClientActorClass":
+        merged = dict(self._options or {})
+        merged.update(opts)
+        return ClientActorClass(self._api, self._cls_id, merged)
+
+    def remote(self, *args, **kwargs) -> ClientActorHandle:
+        key = self._api._call({
+            "op": "actor_create", "cls_id": self._cls_id,
+            "options": self._options,
+            "args": args, "kwargs": kwargs})
+        return ClientActorHandle(self._api, key)
+
+
+class ClientAPI:
+    """The ``ray_tpu`` surface, proxied (init/get/put/wait/remote/...)."""
+
+    def __init__(self, address: str, timeout: float = 30.0):
+        host, _, port = address.partition(":")
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._sock.settimeout(None)
+        self._lock = threading.Lock()
+        assert self._call({"op": "ping"})["initialized"], \
+            "server head is not initialized"
+
+    def _call(self, req: dict):
+        with self._lock:
+            send_msg(self._sock, req)
+            resp = recv_msg(self._sock)
+        if resp is None:
+            raise ConnectionError("client server closed the connection")
+        if "error" in resp:
+            raise resp["error"]
+        return resp["ok"]
+
+    # -- API ----------------------------------------------------------------
+
+    def remote(self, fn_or_class, **options):
+        """Wrap a function or class for remote execution on the server."""
+        if isinstance(fn_or_class, type):
+            cls_id = self._call({"op": "register_class",
+                                 "cls": fn_or_class})
+            return ClientActorClass(self, cls_id, options or None)
+        fn_id = self._call({"op": "register_function",
+                            "function": fn_or_class})
+        return ClientRemoteFunction(self, fn_id, options or None)
+
+    def put(self, value: Any) -> ClientObjectRef:
+        return ClientObjectRef(self, self._call({"op": "put",
+                                                 "value": value}))
+
+    def get(self, refs: Union[ClientObjectRef, Sequence[ClientObjectRef]],
+            timeout: Optional[float] = None):
+        single = isinstance(refs, ClientObjectRef)
+        ref_list = [refs] if single else list(refs)
+        values = self._call({"op": "get",
+                             "refs": [r.ref_id for r in ref_list],
+                             "timeout": timeout})
+        return values[0] if single else values
+
+    def wait(self, refs: Sequence[ClientObjectRef], *,
+             num_returns: int = 1, timeout: Optional[float] = None):
+        by_id: Dict[str, ClientObjectRef] = {r.ref_id: r for r in refs}
+        ready, pending = self._call({
+            "op": "wait", "refs": [r.ref_id for r in refs],
+            "num_returns": num_returns, "timeout": timeout})
+        return ([by_id[r] for r in ready], [by_id[r] for r in pending])
+
+    def get_actor(self, name: str,
+                  namespace: Optional[str] = None) -> ClientActorHandle:
+        key = self._call({"op": "get_actor", "name": name,
+                          "namespace": namespace})
+        return ClientActorHandle(self, key)
+
+    def kill(self, actor: ClientActorHandle, *, no_restart: bool = True):
+        return self._call({"op": "kill", "actor_key": actor._actor_key,
+                           "no_restart": no_restart})
+
+    def release(self, refs: Sequence[ClientObjectRef]):
+        """Drop the server-side pins for these refs."""
+        self._call({"op": "release",
+                    "refs": [r.ref_id for r in refs]})
+
+    def cluster_resources(self) -> Dict[str, float]:
+        return self._call({"op": "cluster_resources"})
+
+    def disconnect(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def connect(address: str, timeout: float = 30.0) -> ClientAPI:
+    """Connect to a ``ClientServer`` in a head process."""
+    return ClientAPI(address, timeout=timeout)

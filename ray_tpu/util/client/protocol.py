@@ -1,0 +1,53 @@
+"""Wire framing shared by client and server.
+
+Length-prefixed cloudpickle frames (the ``ray_client.proto`` role). Every
+request carries an ``op`` and gets exactly one response frame:
+``{"ok": value}`` or ``{"error": exception}``.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+import cloudpickle
+
+MAX_FRAME = 1 << 30
+
+
+class RefMarker:
+    """Wire stand-in for a ClientObjectRef inside pickled args: carries
+    only the server-side ref id; the server swaps in the real ObjectRef."""
+
+    __slots__ = ("ref_id",)
+
+    def __init__(self, ref_id: str):
+        self.ref_id = ref_id
+
+
+def send_msg(sock: socket.socket, payload) -> None:
+    data = cloudpickle.dumps(payload)
+    sock.sendall(struct.pack("!Q", len(data)) + data)
+
+
+def recv_msg(sock: socket.socket):
+    header = _recv_exact(sock, 8)
+    if header is None:
+        return None
+    (length,) = struct.unpack("!Q", header)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame too large: {length}")
+    data = _recv_exact(sock, length)
+    if data is None:
+        return None
+    return cloudpickle.loads(data)
+
+
+def _recv_exact(sock: socket.socket, n: int):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)

@@ -1,0 +1,170 @@
+"""Server side: executes client requests against the in-process runtime.
+
+Parity with ``python/ray/util/client/server/server.py`` (the dataservicer
+running a real driver) and ``proxier.py`` (N clients multiplexed onto one
+head — here each connection gets a thread, all sharing the runtime).
+Object and actor ownership lives here: the server pins every ObjectRef a
+client has been handed until that client releases it or disconnects
+(reference: server-side reference tracking in ``server.py``).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import socketserver
+import threading
+import uuid
+from typing import Any, Dict
+
+from ray_tpu.util.client.protocol import recv_msg, send_msg
+
+logger = logging.getLogger("ray_tpu")
+
+
+class _ClientSession:
+    """Per-connection state: the refs/actors this client holds."""
+
+    def __init__(self):
+        self.refs: Dict[str, Any] = {}       # ref id -> ObjectRef
+        self.actors: Dict[str, Any] = {}     # actor key -> ActorHandle
+        self.functions: Dict[str, Any] = {}  # fn id -> RemoteFunction
+        self.classes: Dict[str, Any] = {}    # cls id -> ActorClass
+
+
+class ClientServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                session = _ClientSession()
+                sock = self.request
+                try:
+                    while True:
+                        req = recv_msg(sock)
+                        if req is None:
+                            break
+                        try:
+                            result = outer._dispatch(session, req)
+                            send_msg(sock, {"ok": result})
+                        except BaseException as e:  # noqa: BLE001
+                            send_msg(sock, {"error": e})
+                except (ConnectionError, OSError):
+                    pass
+                finally:
+                    # Disconnect releases everything the client held.
+                    session.refs.clear()
+                    session.actors.clear()
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="client-server")
+        self._thread.start()
+
+    # -- op dispatch --------------------------------------------------------
+
+    def _dispatch(self, session: _ClientSession, req: dict):
+        import ray_tpu
+        op = req["op"]
+        if op == "ping":
+            return {"initialized": ray_tpu.is_initialized()}
+        if op == "put":
+            ref = ray_tpu.put(req["value"])
+            return self._track(session, [ref])[0]
+        if op == "get":
+            refs = [session.refs[r] for r in req["refs"]]
+            return ray_tpu.get(refs, timeout=req.get("timeout"))
+        if op == "wait":
+            refs = [session.refs[r] for r in req["refs"]]
+            by_id = {id(ref): rid for rid, ref in
+                     zip(req["refs"], refs)}
+            ready, pending = ray_tpu.wait(
+                refs, num_returns=req["num_returns"],
+                timeout=req.get("timeout"))
+            return ([by_id[id(r)] for r in ready],
+                    [by_id[id(r)] for r in pending])
+        if op == "register_function":
+            fn_id = uuid.uuid4().hex
+            session.functions[fn_id] = ray_tpu.remote(req["function"]) \
+                if not hasattr(req["function"], "remote") \
+                else req["function"]
+            return fn_id
+        if op == "task":
+            fn = session.functions[req["fn_id"]]
+            if req.get("options"):
+                fn = fn.options(**req["options"])
+            args, kwargs = self._restore_refs(session, req["args"],
+                                              req["kwargs"])
+            out = fn.remote(*args, **kwargs)
+            refs = out if isinstance(out, list) else [out]
+            ids = self._track(session, refs)
+            return ids if isinstance(out, list) else ids[0]
+        if op == "register_class":
+            cls_id = uuid.uuid4().hex
+            session.classes[cls_id] = ray_tpu.remote(req["cls"])
+            return cls_id
+        if op == "actor_create":
+            cls = session.classes[req["cls_id"]]
+            if req.get("options"):
+                cls = cls.options(**req["options"])
+            args, kwargs = self._restore_refs(session, req["args"],
+                                              req["kwargs"])
+            handle = cls.remote(*args, **kwargs)
+            actor_key = uuid.uuid4().hex
+            session.actors[actor_key] = handle
+            return actor_key
+        if op == "actor_call":
+            handle = session.actors[req["actor_key"]]
+            args, kwargs = self._restore_refs(session, req["args"],
+                                              req["kwargs"])
+            ref = getattr(handle, req["method"]).remote(*args, **kwargs)
+            return self._track(session, [ref])[0]
+        if op == "get_actor":
+            handle = ray_tpu.get_actor(req["name"],
+                                       namespace=req.get("namespace"))
+            actor_key = uuid.uuid4().hex
+            session.actors[actor_key] = handle
+            return actor_key
+        if op == "kill":
+            ray_tpu.kill(session.actors[req["actor_key"]],
+                         no_restart=req.get("no_restart", True))
+            return True
+        if op == "release":
+            for rid in req["refs"]:
+                session.refs.pop(rid, None)
+            return True
+        if op == "cluster_resources":
+            return ray_tpu.cluster_resources()
+        raise ValueError(f"unknown op {op!r}")
+
+    @staticmethod
+    def _track(session: _ClientSession, refs) -> list:
+        ids = []
+        for ref in refs:
+            rid = uuid.uuid4().hex
+            session.refs[rid] = ref
+            ids.append(rid)
+        return ids
+
+    @staticmethod
+    def _restore_refs(session: _ClientSession, args, kwargs):
+        from ray_tpu.util.client.protocol import RefMarker
+
+        def restore(v):
+            if isinstance(v, RefMarker):
+                return session.refs[v.ref_id]
+            return v
+
+        return (tuple(restore(a) for a in args),
+                {k: restore(v) for k, v in kwargs.items()})
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()

@@ -1,0 +1,192 @@
+"""AIR predictors/preprocessors, native scheduler kernels, util extras.
+
+Mirrors the reference's ``air/tests/test_batch_predictor.py``,
+``data/tests/test_preprocessors.py``, scheduling policy gtests
+(``scheduling_policy_test.cc``), ``test_check_serialize``, and the
+joblib backend tests.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rt_data
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.predictor import BatchPredictor, JaxPredictor
+from ray_tpu.air.preprocessors import (BatchMapper, Chain, LabelEncoder,
+                                       MinMaxScaler, OneHotEncoder,
+                                       SimpleImputer, StandardScaler)
+
+
+# -- preprocessors ----------------------------------------------------------
+
+def _tabular_ds():
+    rows = [{"x": float(i), "y": float(i * 2), "label": "ab"[i % 2]}
+            for i in range(20)]
+    return rt_data.from_items(rows, parallelism=4)
+
+
+def test_standard_scaler(ray_start_regular):
+    ds = _tabular_ds()
+    scaler = StandardScaler(columns=["x"])
+    out = scaler.fit_transform(ds)
+    xs = np.array([r["x"] for r in out.take_all()])
+    assert abs(xs.mean()) < 1e-6
+    assert abs(xs.std() - 1.0) < 1e-6
+
+
+def test_minmax_label_onehot_imputer(ray_start_regular):
+    ds = _tabular_ds()
+    out = MinMaxScaler(columns=["y"]).fit_transform(ds)
+    ys = np.array([r["y"] for r in out.take_all()])
+    assert ys.min() == 0.0 and ys.max() == 1.0
+
+    out = LabelEncoder("label").fit_transform(ds)
+    labels = {r["label"] for r in out.take_all()}
+    assert labels == {0, 1}
+
+    out = OneHotEncoder(columns=["label"]).fit_transform(ds)
+    row = out.take(1)[0]
+    assert "label_onehot" in row and len(row["label_onehot"]) == 2
+
+    rows = [{"v": 1.0}, {"v": float("nan")}, {"v": 3.0}]
+    ds2 = rt_data.from_items(rows, parallelism=1)
+    out = SimpleImputer(columns=["v"]).fit_transform(ds2)
+    vs = [r["v"] for r in out.take_all()]
+    assert vs[1] == 2.0  # mean of 1 and 3
+
+
+def test_chain_and_batch_mapper(ray_start_regular):
+    ds = _tabular_ds()
+    chain = Chain(StandardScaler(columns=["x"]),
+                  BatchMapper(lambda b: {**b, "x2": b["x"] * 2}))
+    out = chain.fit_transform(ds)
+    row = out.take(1)[0]
+    assert "x2" in row
+    # transform_batch composes for serving-time use.
+    batch = chain.transform_batch({"x": np.array([0.0]),
+                                   "y": np.array([1.0]),
+                                   "label": np.array(["a"])})
+    assert "x2" in batch
+
+
+# -- predictors -------------------------------------------------------------
+
+def _linear_apply(params, batch):
+    x = batch["x"] if isinstance(batch, dict) else batch
+    return x * params["w"] + params["b"]
+
+
+def test_jax_predictor_from_checkpoint():
+    ckpt = Checkpoint.from_dict({"params": {"w": 3.0, "b": 1.0}})
+    pred = JaxPredictor.from_checkpoint(ckpt, apply_fn=_linear_apply)
+    out = pred.predict({"x": np.array([1.0, 2.0])})
+    np.testing.assert_allclose(out, [4.0, 7.0])
+
+
+def test_batch_predictor_over_dataset(ray_start_regular):
+    ds = rt_data.from_items([{"x": float(i)} for i in range(10)],
+                            parallelism=2)
+    ckpt = Checkpoint.from_dict({"params": {"w": 2.0, "b": 0.0}})
+    bp = BatchPredictor.from_checkpoint(ckpt, JaxPredictor,
+                                        apply_fn=_linear_apply)
+    out = bp.predict(ds, batch_size=4, keep_columns=["x"])
+    rows = out.take_all()
+    for r in rows:
+        assert r["predictions"] == r["x"] * 2.0
+
+
+def test_predictor_with_preprocessor(ray_start_regular):
+    ds = rt_data.from_items([{"x": float(i)} for i in range(10)],
+                            parallelism=2)
+    pre = StandardScaler(columns=["x"]).fit(ds)
+    ckpt = Checkpoint.from_dict({"params": {"w": 1.0, "b": 0.0}})
+    pred = JaxPredictor.from_checkpoint(ckpt, apply_fn=_linear_apply,
+                                        preprocessor=pre)
+    out = pred.predict({"x": np.array([4.5])})  # the mean -> 0
+    assert abs(out[0]) < 1e-6
+
+
+# -- native scheduler kernels ----------------------------------------------
+
+def test_native_scheduler_matches_python():
+    from ray_tpu._private import scheduler as sched
+    from ray_tpu._private.ids import NodeID
+    from ray_tpu._private.resources import NodeResources, ResourceSet
+
+    if sched._native() is None:
+        pytest.skip("no C++ toolchain")
+
+    def make_nodes(utils):
+        nodes = []
+        for u in utils:
+            res = NodeResources(ResourceSet({"CPU": 10.0}))
+            res.allocate(ResourceSet({"CPU": u * 10.0}))
+            nodes.append(sched.NodeState(NodeID.from_random(), res))
+        return nodes
+
+    request = ResourceSet({"CPU": 1.0})
+    # Pack regime: below-threshold nodes all score 0 -> preferred wins.
+    nodes = make_nodes([0.1, 0.2, 0.3])
+    native = sched.HybridPolicy(spread_threshold=0.5, top_k_fraction=0.01,
+                                seed=0)
+    chosen = native.select(nodes, request, preferred=nodes[1].node_id)
+    assert chosen == nodes[1].node_id
+    # Spread regime: all above threshold -> lightest node wins.
+    nodes = make_nodes([0.9, 0.6, 0.8])
+    chosen = sched.HybridPolicy(spread_threshold=0.5,
+                                top_k_fraction=0.01).select(nodes, request)
+    assert chosen == nodes[1].node_id
+    # Infeasible request -> None.
+    assert sched.HybridPolicy().select(
+        nodes, ResourceSet({"CPU": 100.0})) is None
+    # Spread policy round-robins over feasible nodes.
+    nodes = make_nodes([0.0, 0.0])
+    sp = sched.SpreadPolicy()
+    picks = {sp.select(nodes, request).hex() for _ in range(4)}
+    assert len(picks) == 2
+
+
+def test_native_scheduler_dead_nodes_skipped():
+    from ray_tpu._private import scheduler as sched
+    from ray_tpu._private.ids import NodeID
+    from ray_tpu._private.resources import NodeResources, ResourceSet
+
+    if sched._native() is None:
+        pytest.skip("no C++ toolchain")
+    alive = sched.NodeState(NodeID.from_random(),
+                            NodeResources(ResourceSet({"CPU": 4.0})))
+    dead = sched.NodeState(NodeID.from_random(),
+                           NodeResources(ResourceSet({"CPU": 4.0})),
+                           alive=False)
+    chosen = sched.HybridPolicy().select([dead, alive],
+                                         ResourceSet({"CPU": 1.0}))
+    assert chosen == alive.node_id
+
+
+# -- util extras ------------------------------------------------------------
+
+def test_inspect_serializability():
+    from ray_tpu.util.check_serialize import inspect_serializability
+    import threading
+    ok, failures = inspect_serializability(lambda: 1)
+    assert ok and not failures
+
+    lock = threading.Lock()
+
+    def closure_over_lock():
+        return lock
+
+    ok, failures = inspect_serializability(closure_over_lock)
+    assert not ok
+    assert any(f.name == "lock" for f in failures)
+
+
+def test_joblib_backend(ray_start_regular):
+    import joblib
+    from ray_tpu.util.joblib import register_ray_tpu
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu", n_jobs=4):
+        out = joblib.Parallel()(joblib.delayed(lambda x: x * x)(i)
+                                for i in range(10))
+    assert out == [i * i for i in range(10)]
