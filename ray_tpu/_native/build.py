@@ -50,3 +50,46 @@ def load_native_library(name: str) -> Optional[ctypes.CDLL]:
             lib = None
         _CACHE[name] = lib
         return lib
+
+
+def build_state_service() -> str:
+    """Build the C++ state-service binary (protoc gen + g++ + libprotobuf);
+    returns the executable path. Cached until sources change."""
+    proto_dir = os.path.normpath(
+        os.path.join(_DIR, os.pardir, "protocol"))
+    proto = os.path.join(proto_dir, "raytpu.proto")
+    src = os.path.join(_DIR, "state_service.cc")
+    gen_dir = os.path.join(_DIR, "gen")
+    pb_cc = os.path.join(gen_dir, "raytpu.pb.cc")
+    exe = os.path.join(_DIR, "raytpu_state_service")
+    with _LOCK:
+        try:
+            src_mtime = max(os.path.getmtime(src), os.path.getmtime(proto))
+            if os.path.exists(exe) and os.path.getmtime(exe) >= src_mtime:
+                return exe
+            os.makedirs(gen_dir, exist_ok=True)
+            if (not os.path.exists(pb_cc)
+                    or os.path.getmtime(pb_cc) < os.path.getmtime(proto)):
+                subprocess.run(
+                    ["protoc", f"--proto_path={proto_dir}",
+                     f"--cpp_out={gen_dir}", proto],
+                    check=True, capture_output=True, text=True)
+            # Unique tmp name: concurrent builders (parallel test workers)
+            # must not interleave writes into one file.
+            import tempfile
+            fd, tmp = tempfile.mkstemp(prefix="raytpu_state_service_",
+                                       dir=_DIR)
+            os.close(fd)
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-o", tmp, src, pb_cc,
+                 f"-I{_DIR}", "-lprotobuf", "-lpthread"],
+                check=True, capture_output=True, text=True)
+            os.chmod(tmp, 0o755)
+            os.replace(tmp, exe)
+        except subprocess.CalledProcessError as e:
+            raise NativeBuildError(
+                f"state service build failed:\n{e.stderr}") from e
+        except OSError as e:
+            raise NativeBuildError(
+                f"state service build failed: {e}") from e
+        return exe

@@ -1,0 +1,992 @@
+// raytpu_state_service — the cluster state service daemon.
+//
+// The C++ control-plane process playing the reference's GCS server role
+// (src/ray/gcs/gcs_server/gcs_server.h:70, gcs_server_main.cc): node table
+// with heartbeat failure detection (gcs_heartbeat_manager.h:36), internal
+// KV (gcs_kv_manager.h), actor/placement-group/job tables
+// (gcs_actor_manager.h, gcs_placement_group_mgr.h), an object directory,
+// and long-poll-free pubsub (src/ray/pubsub/) — all over the framed
+// protobuf protocol defined in ray_tpu/protocol/raytpu.proto instead of
+// gRPC: a single epoll loop multiplexes every client on one socket each.
+//
+// Persistence (gcs_table_storage.h role): every mutating RPC is appended
+// to a journal; periodic snapshots compact it. On restart the tables are
+// rebuilt, so named actors stay resolvable and nodes resume with their
+// next heartbeat (the reference's GCS fault-tolerance contract, tested by
+// python/ray/tests/test_gcs_fault_tolerance.py — ours by
+// tests/test_state_service.py::test_head_restart_rebuilds_state).
+//
+// Build: ray_tpu/_native/build.py::build_state_service (g++ + libprotobuf).
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/timerfd.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <deque>
+#include <map>
+#include <memory>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+#include "gen/raytpu.pb.h"
+
+namespace {
+
+volatile sig_atomic_t g_stop = 0;
+void on_signal(int) { g_stop = 1; }
+
+double now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return ts.tv_sec * 1e3 + ts.tv_nsec / 1e6;
+}
+
+double mono_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1e3 + ts.tv_nsec / 1e6;
+}
+
+void set_nonblocking(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+std::string frame(const raytpu::Envelope& env) {
+  std::string payload;
+  env.SerializeToString(&payload);
+  std::string out(4, '\0');
+  uint32_t n = payload.size();
+  out[0] = (n >> 24) & 0xff;
+  out[1] = (n >> 16) & 0xff;
+  out[2] = (n >> 8) & 0xff;
+  out[3] = n & 0xff;
+  out += payload;
+  return out;
+}
+
+struct Conn {
+  int fd = -1;
+  std::string rbuf;
+  std::string wbuf;
+  std::set<std::string> channels;  // pubsub subscriptions
+};
+
+class StateService {
+ public:
+  StateService(int port, const std::string& host, const std::string& data_dir,
+               double hb_timeout_ms, double snapshot_interval_s)
+      : host_(host),
+        port_(port),
+        data_dir_(data_dir),
+        hb_timeout_ms_(hb_timeout_ms),
+        snapshot_interval_s_(snapshot_interval_s) {}
+
+  int Run(const std::string& port_file) {
+    if (!data_dir_.empty()) {
+      mkdir(data_dir_.c_str(), 0755);
+      LoadPersisted();
+      cluster_epoch_++;
+      WriteSnapshot();  // persist the epoch bump immediately
+      OpenJournal();
+    }
+    listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port_);
+    if (inet_pton(AF_INET, host_.c_str(), &addr.sin_addr) != 1) {
+      fprintf(stderr, "bad host %s\n", host_.c_str());
+      return 1;
+    }
+    if (bind(listen_fd_, (sockaddr*)&addr, sizeof(addr)) != 0) {
+      perror("bind");
+      return 1;
+    }
+    socklen_t alen = sizeof(addr);
+    getsockname(listen_fd_, (sockaddr*)&addr, &alen);
+    port_ = ntohs(addr.sin_port);
+    listen(listen_fd_, 128);
+    set_nonblocking(listen_fd_);
+
+    if (!port_file.empty()) {
+      std::string tmp = port_file + ".tmp";
+      FILE* f = fopen(tmp.c_str(), "w");
+      if (f) {
+        fprintf(f, "%d\n", port_);
+        fclose(f);
+        rename(tmp.c_str(), port_file.c_str());
+      }
+    }
+    fprintf(stderr, "[state_service] listening on %s:%d epoch=%llu\n",
+            host_.c_str(), port_, (unsigned long long)cluster_epoch_);
+
+    epfd_ = epoll_create1(0);
+    AddFd(listen_fd_, EPOLLIN);
+
+    timer_fd_ = timerfd_create(CLOCK_MONOTONIC, TFD_NONBLOCK);
+    struct itimerspec its {};
+    its.it_interval.tv_nsec = 250 * 1000000;  // 250ms sweep
+    its.it_value.tv_nsec = 250 * 1000000;
+    timerfd_settime(timer_fd_, 0, &its, nullptr);
+    AddFd(timer_fd_, EPOLLIN);
+
+    std::vector<epoll_event> events(256);
+    double last_snapshot = mono_ms();
+    while (!g_stop) {
+      int n = epoll_wait(epfd_, events.data(), events.size(), 500);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        perror("epoll_wait");
+        break;
+      }
+      for (int i = 0; i < n; i++) {
+        int fd = events[i].data.fd;
+        uint32_t ev = events[i].events;
+        if (fd == listen_fd_) {
+          Accept();
+        } else if (fd == timer_fd_) {
+          uint64_t expirations;
+          while (read(timer_fd_, &expirations, 8) > 0) {
+          }
+          SweepHeartbeats();
+          if (!data_dir_.empty() &&
+              mono_ms() - last_snapshot > snapshot_interval_s_ * 1e3) {
+            WriteSnapshot();
+            last_snapshot = mono_ms();
+          }
+        } else {
+          if (ev & (EPOLLHUP | EPOLLERR)) {
+            CloseConn(fd);
+            continue;
+          }
+          if (ev & EPOLLIN) HandleReadable(fd);
+          if (conns_.count(fd) && (ev & EPOLLOUT)) FlushWrites(fd);
+        }
+      }
+    }
+    if (!data_dir_.empty()) WriteSnapshot();
+    fprintf(stderr, "[state_service] shutting down\n");
+    return 0;
+  }
+
+ private:
+  // ------------------------------------------------------------- event loop
+
+  void AddFd(int fd, uint32_t events) {
+    epoll_event ev{};
+    ev.events = events;
+    ev.data.fd = fd;
+    epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev);
+  }
+
+  void ModFd(int fd, uint32_t events) {
+    epoll_event ev{};
+    ev.events = events;
+    ev.data.fd = fd;
+    epoll_ctl(epfd_, EPOLL_CTL_MOD, fd, &ev);
+  }
+
+  void Accept() {
+    while (true) {
+      int fd = accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) return;
+      set_nonblocking(fd);
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      conns_[fd] = Conn{};
+      conns_[fd].fd = fd;
+      AddFd(fd, EPOLLIN);
+    }
+  }
+
+  void CloseConn(int fd) {
+    auto it = conns_.find(fd);
+    if (it == conns_.end()) return;
+    conns_.erase(it);
+    epoll_ctl(epfd_, EPOLL_CTL_DEL, fd, nullptr);
+    close(fd);
+  }
+
+  void HandleReadable(int fd) {
+    auto it = conns_.find(fd);
+    if (it == conns_.end()) return;
+    Conn& c = it->second;
+    char buf[1 << 16];
+    while (true) {
+      ssize_t n = recv(fd, buf, sizeof(buf), 0);
+      if (n > 0) {
+        c.rbuf.append(buf, n);
+      } else if (n == 0) {
+        CloseConn(fd);
+        return;
+      } else {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        CloseConn(fd);
+        return;
+      }
+    }
+    // Parse complete frames.
+    size_t off = 0;
+    while (c.rbuf.size() - off >= 4) {
+      const unsigned char* p = (const unsigned char*)c.rbuf.data() + off;
+      uint32_t len = (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+                     (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+      if (len > (1u << 30)) {  // 1 GiB sanity cap
+        CloseConn(fd);
+        return;
+      }
+      if (c.rbuf.size() - off - 4 < len) break;
+      raytpu::Envelope env;
+      if (env.ParseFromArray(c.rbuf.data() + off + 4, len)) {
+        Dispatch(fd, env);
+        if (!conns_.count(fd)) return;  // handler closed us
+      }
+      off += 4 + len;
+    }
+    if (off > 0) c.rbuf.erase(0, off);
+  }
+
+  void SendTo(int fd, const raytpu::Envelope& env) {
+    auto it = conns_.find(fd);
+    if (it == conns_.end()) return;
+    it->second.wbuf += frame(env);
+    FlushWrites(fd);
+  }
+
+  void FlushWrites(int fd) {
+    auto it = conns_.find(fd);
+    if (it == conns_.end()) return;
+    Conn& c = it->second;
+    while (!c.wbuf.empty()) {
+      ssize_t n = send(fd, c.wbuf.data(), c.wbuf.size(), MSG_NOSIGNAL);
+      if (n > 0) {
+        c.wbuf.erase(0, n);
+      } else {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          ModFd(fd, EPOLLIN | EPOLLOUT);
+          return;
+        }
+        CloseConn(fd);
+        return;
+      }
+    }
+    ModFd(fd, EPOLLIN);
+  }
+
+  // ------------------------------------------------------------ dispatching
+
+  void Reply(int fd, const raytpu::Envelope& req,
+             const google::protobuf::Message& msg) {
+    raytpu::Envelope env;
+    env.set_seq(req.seq());
+    env.set_method(req.method());
+    env.set_reply(true);
+    std::string body;
+    msg.SerializeToString(&body);
+    env.set_body(body);
+    SendTo(fd, env);
+  }
+
+  void ReplyError(int fd, const raytpu::Envelope& req, const std::string& e) {
+    raytpu::Envelope env;
+    env.set_seq(req.seq());
+    env.set_method(req.method());
+    env.set_reply(true);
+    env.set_error(e);
+    SendTo(fd, env);
+  }
+
+  void Journal(uint32_t method, const std::string& body) {
+    if (journal_ == nullptr) return;
+    raytpu::JournalRecord rec;
+    rec.set_method(method);
+    rec.set_body(body);
+    rec.set_ts_ms(now_ms());
+    std::string payload;
+    rec.SerializeToString(&payload);
+    uint32_t n = payload.size();
+    unsigned char hdr[4] = {(unsigned char)((n >> 24) & 0xff),
+                            (unsigned char)((n >> 16) & 0xff),
+                            (unsigned char)((n >> 8) & 0xff),
+                            (unsigned char)(n & 0xff)};
+    fwrite(hdr, 1, 4, journal_);
+    fwrite(payload.data(), 1, n, journal_);
+    fflush(journal_);
+  }
+
+  void Publish(const std::string& channel, const std::string& kind,
+               const std::string& payload) {
+    raytpu::Event ev;
+    ev.set_channel(channel);
+    ev.set_kind(kind);
+    ev.set_payload(payload);
+    ev.set_ts_ms(now_ms());
+    raytpu::Envelope env;
+    env.set_seq(0);
+    env.set_method(raytpu::PUBLISH);
+    std::string body;
+    ev.SerializeToString(&body);
+    env.set_body(body);
+    std::vector<int> fds;
+    for (auto& [fd, c] : conns_) {
+      if (c.channels.count(channel)) fds.push_back(fd);
+    }
+    for (int fd : fds) SendTo(fd, env);
+    counters_["published"]++;
+  }
+
+  // Applies a mutating method to the tables. `live` is false during journal
+  // replay (no fd, no pubsub, no re-journaling).
+  void Dispatch(int fd, const raytpu::Envelope& env) {
+    counters_["rpc_total"]++;
+    switch (env.method()) {
+      case raytpu::REGISTER_NODE:
+        return HandleRegisterNode(fd, env);
+      case raytpu::HEARTBEAT:
+        return HandleHeartbeat(fd, env);
+      case raytpu::LIST_NODES:
+        return HandleListNodes(fd, env);
+      case raytpu::MARK_NODE_DEAD:
+        return HandleMarkNodeDead(fd, env);
+      case raytpu::KV_PUT:
+        return HandleKvPut(fd, env);
+      case raytpu::KV_GET:
+        return HandleKvGet(fd, env);
+      case raytpu::KV_DEL:
+        return HandleKvDel(fd, env);
+      case raytpu::KV_KEYS:
+        return HandleKvKeys(fd, env);
+      case raytpu::SUBSCRIBE:
+        return HandleSubscribe(fd, env);
+      case raytpu::PUBLISH:
+        return HandlePublish(fd, env);
+      case raytpu::ADD_LOCATION:
+        return HandleAddLocation(fd, env);
+      case raytpu::REMOVE_LOCATION:
+        return HandleRemoveLocation(fd, env);
+      case raytpu::GET_LOCATIONS:
+        return HandleGetLocations(fd, env);
+      case raytpu::REGISTER_ACTOR:
+      case raytpu::UPDATE_ACTOR:
+        return HandleUpsertActor(fd, env);
+      case raytpu::GET_ACTOR:
+        return HandleGetActor(fd, env);
+      case raytpu::GET_NAMED_ACTOR:
+        return HandleGetNamedActor(fd, env);
+      case raytpu::LIST_ACTORS:
+        return HandleListActors(fd, env);
+      case raytpu::REGISTER_PG:
+      case raytpu::UPDATE_PG:
+        return HandleUpsertPg(fd, env);
+      case raytpu::REMOVE_PG:
+        return HandleRemovePg(fd, env);
+      case raytpu::LIST_PGS:
+        return HandleListPgs(fd, env);
+      case raytpu::REGISTER_JOB:
+        return HandleRegisterJob(fd, env);
+      case raytpu::LIST_JOBS:
+        return HandleListJobs(fd, env);
+      case raytpu::STATE_STATS:
+        return HandleStats(fd, env);
+      case raytpu::CHECKPOINT: {
+        if (!data_dir_.empty()) WriteSnapshot();
+        raytpu::Empty e;
+        return Reply(fd, env, e);
+      }
+      case raytpu::PING: {
+        raytpu::PingReply r;
+        r.set_time_ms(now_ms());
+        return Reply(fd, env, r);
+      }
+      default:
+        return ReplyError(fd, env, "unknown method");
+    }
+  }
+
+  // ------------------------------------------------------------- node table
+
+  void ApplyRegisterNode(const raytpu::RegisterNodeRequest& req) {
+    raytpu::NodeInfo info = req.info();
+    info.set_alive(true);
+    info.set_last_heartbeat_ms(now_ms());
+    nodes_[info.node_id()] = info;
+    hb_deadline_[info.node_id()] = mono_ms() + hb_timeout_ms_;
+  }
+
+  void HandleRegisterNode(int fd, const raytpu::Envelope& env) {
+    raytpu::RegisterNodeRequest req;
+    if (!req.ParseFromString(env.body()))
+      return ReplyError(fd, env, "bad RegisterNodeRequest");
+    ApplyRegisterNode(req);
+    Journal(raytpu::REGISTER_NODE, env.body());
+    std::string info_bytes;
+    req.info().SerializeToString(&info_bytes);
+    Publish("nodes", "NODE_ADDED", info_bytes);
+    raytpu::RegisterNodeReply rep;
+    rep.set_server_time_ms(now_ms());
+    rep.set_cluster_epoch(cluster_epoch_);
+    Reply(fd, env, rep);
+  }
+
+  void HandleHeartbeat(int fd, const raytpu::Envelope& env) {
+    raytpu::HeartbeatRequest req;
+    if (!req.ParseFromString(env.body()))
+      return ReplyError(fd, env, "bad HeartbeatRequest");
+    raytpu::HeartbeatReply rep;
+    auto it = nodes_.find(req.node_id());
+    if (it == nodes_.end() || !it->second.alive()) {
+      rep.set_recognized(false);  // node must re-register
+    } else {
+      rep.set_recognized(true);
+      it->second.set_last_heartbeat_ms(now_ms());
+      if (req.has_available()) *it->second.mutable_available() = req.available();
+      hb_deadline_[req.node_id()] = mono_ms() + hb_timeout_ms_;
+    }
+    Reply(fd, env, rep);
+  }
+
+  void HandleListNodes(int fd, const raytpu::Envelope& env) {
+    raytpu::ListNodesReply rep;
+    for (auto& [id, info] : nodes_) *rep.add_nodes() = info;
+    Reply(fd, env, rep);
+  }
+
+  void ApplyMarkNodeDead(const raytpu::MarkNodeDeadRequest& req) {
+    auto it = nodes_.find(req.node_id());
+    if (it != nodes_.end()) {
+      it->second.set_alive(false);
+      it->second.set_death_reason(req.reason());
+    }
+    hb_deadline_.erase(req.node_id());
+    // Objects on a dead node are gone.
+    for (auto dit = obj_dir_.begin(); dit != obj_dir_.end();) {
+      dit->second.erase(req.node_id());
+      if (dit->second.empty()) {
+        obj_sizes_.erase(dit->first);
+        dit = obj_dir_.erase(dit);
+      } else {
+        ++dit;
+      }
+    }
+  }
+
+  void MarkDead(const std::string& node_id, const std::string& reason) {
+    raytpu::MarkNodeDeadRequest req;
+    req.set_node_id(node_id);
+    req.set_reason(reason);
+    ApplyMarkNodeDead(req);
+    std::string body;
+    req.SerializeToString(&body);
+    Journal(raytpu::MARK_NODE_DEAD, body);
+    Publish("nodes", "NODE_DEAD", body);
+    counters_["nodes_dead"]++;
+  }
+
+  void HandleMarkNodeDead(int fd, const raytpu::Envelope& env) {
+    raytpu::MarkNodeDeadRequest req;
+    if (!req.ParseFromString(env.body()))
+      return ReplyError(fd, env, "bad MarkNodeDeadRequest");
+    MarkDead(req.node_id(), req.reason());
+    raytpu::Empty e;
+    Reply(fd, env, e);
+  }
+
+  void SweepHeartbeats() {
+    double now = mono_ms();
+    std::vector<std::string> dead;
+    for (auto& [id, deadline] : hb_deadline_) {
+      if (now > deadline) dead.push_back(id);
+    }
+    for (auto& id : dead) MarkDead(id, "heartbeat timeout");
+  }
+
+  // --------------------------------------------------------------------- kv
+
+  void HandleKvPut(int fd, const raytpu::Envelope& env) {
+    raytpu::KvPutRequest req;
+    if (!req.ParseFromString(env.body()))
+      return ReplyError(fd, env, "bad KvPutRequest");
+    auto& ns = kv_[req.ns()];
+    raytpu::KvPutReply rep;
+    if (!req.overwrite() && ns.count(req.key())) {
+      rep.set_added(false);
+    } else {
+      ns[req.key()] = req.value();
+      rep.set_added(true);
+      Journal(raytpu::KV_PUT, env.body());
+      Publish("kv:" + req.ns(), "PUT", req.key());
+    }
+    Reply(fd, env, rep);
+  }
+
+  void HandleKvGet(int fd, const raytpu::Envelope& env) {
+    raytpu::KvGetRequest req;
+    if (!req.ParseFromString(env.body()))
+      return ReplyError(fd, env, "bad KvGetRequest");
+    raytpu::KvGetReply rep;
+    auto nit = kv_.find(req.ns());
+    if (nit != kv_.end()) {
+      auto kit = nit->second.find(req.key());
+      if (kit != nit->second.end()) {
+        rep.set_found(true);
+        rep.set_value(kit->second);
+      }
+    }
+    Reply(fd, env, rep);
+  }
+
+  void HandleKvDel(int fd, const raytpu::Envelope& env) {
+    raytpu::KvDelRequest req;
+    if (!req.ParseFromString(env.body()))
+      return ReplyError(fd, env, "bad KvDelRequest");
+    raytpu::KvDelReply rep;
+    auto nit = kv_.find(req.ns());
+    if (nit != kv_.end()) rep.set_deleted(nit->second.erase(req.key()) > 0);
+    if (rep.deleted()) Journal(raytpu::KV_DEL, env.body());
+    Reply(fd, env, rep);
+  }
+
+  void HandleKvKeys(int fd, const raytpu::Envelope& env) {
+    raytpu::KvKeysRequest req;
+    if (!req.ParseFromString(env.body()))
+      return ReplyError(fd, env, "bad KvKeysRequest");
+    raytpu::KvKeysReply rep;
+    auto nit = kv_.find(req.ns());
+    if (nit != kv_.end()) {
+      for (auto& [k, v] : nit->second) {
+        if (k.rfind(req.prefix(), 0) == 0) rep.add_keys(k);
+      }
+    }
+    Reply(fd, env, rep);
+  }
+
+  // ----------------------------------------------------------------- pubsub
+
+  void HandleSubscribe(int fd, const raytpu::Envelope& env) {
+    raytpu::SubscribeRequest req;
+    if (!req.ParseFromString(env.body()))
+      return ReplyError(fd, env, "bad SubscribeRequest");
+    auto it = conns_.find(fd);
+    if (it != conns_.end()) {
+      for (auto& ch : req.channels()) it->second.channels.insert(ch);
+    }
+    raytpu::Empty e;
+    Reply(fd, env, e);
+  }
+
+  void HandlePublish(int fd, const raytpu::Envelope& env) {
+    raytpu::PublishRequest req;
+    if (!req.ParseFromString(env.body()))
+      return ReplyError(fd, env, "bad PublishRequest");
+    Publish(req.event().channel(), req.event().kind(), req.event().payload());
+    raytpu::Empty e;
+    Reply(fd, env, e);
+  }
+
+  // ------------------------------------------------------- object directory
+
+  void HandleAddLocation(int fd, const raytpu::Envelope& env) {
+    raytpu::ObjectLocRequest req;
+    if (!req.ParseFromString(env.body()))
+      return ReplyError(fd, env, "bad ObjectLocRequest");
+    obj_dir_[req.object_id()].insert(req.node_id());
+    if (req.size() > 0) obj_sizes_[req.object_id()] = req.size();
+    raytpu::Empty e;
+    Reply(fd, env, e);
+  }
+
+  void HandleRemoveLocation(int fd, const raytpu::Envelope& env) {
+    raytpu::ObjectLocRequest req;
+    if (!req.ParseFromString(env.body()))
+      return ReplyError(fd, env, "bad ObjectLocRequest");
+    auto it = obj_dir_.find(req.object_id());
+    if (it != obj_dir_.end()) {
+      it->second.erase(req.node_id());
+      if (it->second.empty()) {
+        obj_dir_.erase(it);
+        obj_sizes_.erase(req.object_id());
+      }
+    }
+    raytpu::Empty e;
+    Reply(fd, env, e);
+  }
+
+  void HandleGetLocations(int fd, const raytpu::Envelope& env) {
+    raytpu::GetLocationsRequest req;
+    if (!req.ParseFromString(env.body()))
+      return ReplyError(fd, env, "bad GetLocationsRequest");
+    raytpu::GetLocationsReply rep;
+    auto it = obj_dir_.find(req.object_id());
+    if (it != obj_dir_.end()) {
+      for (auto& nid : it->second) {
+        rep.add_node_ids(nid);
+        auto nit = nodes_.find(nid);
+        rep.add_addresses(nit != nodes_.end() ? nit->second.address() : "");
+      }
+    }
+    auto sit = obj_sizes_.find(req.object_id());
+    if (sit != obj_sizes_.end()) rep.set_size(sit->second);
+    Reply(fd, env, rep);
+  }
+
+  // ------------------------------------------------------------ actor table
+
+  void HandleUpsertActor(int fd, const raytpu::Envelope& env) {
+    raytpu::RegisterActorRequest req;
+    if (!req.ParseFromString(env.body()))
+      return ReplyError(fd, env, "bad RegisterActorRequest");
+    const raytpu::ActorInfo& info = req.info();
+    // Name collision check on first registration.
+    if (env.method() == raytpu::REGISTER_ACTOR && !info.name().empty()) {
+      auto it = named_.find({info.namespace_(), info.name()});
+      if (it != named_.end() && it->second != info.actor_id()) {
+        auto ait = actors_.find(it->second);
+        if (ait != actors_.end() && ait->second.state() != "DEAD") {
+          return ReplyError(fd, env, "actor name already taken: " + info.name());
+        }
+      }
+    }
+    ApplyUpsertActor(req);
+    Journal(env.method(), env.body());
+    std::string body;
+    info.SerializeToString(&body);
+    Publish("actors", info.state(), body);
+    raytpu::Empty e;
+    Reply(fd, env, e);
+  }
+
+  void ApplyUpsertActor(const raytpu::RegisterActorRequest& req) {
+    const raytpu::ActorInfo& info = req.info();
+    auto prev = actors_.find(info.actor_id());
+    if (prev != actors_.end() && !prev->second.name().empty()) {
+      named_.erase({prev->second.namespace_(), prev->second.name()});
+    }
+    actors_[info.actor_id()] = info;
+    if (!info.name().empty() && info.state() != "DEAD") {
+      named_[{info.namespace_(), info.name()}] = info.actor_id();
+    }
+  }
+
+  void HandleGetActor(int fd, const raytpu::Envelope& env) {
+    raytpu::GetActorRequest req;
+    if (!req.ParseFromString(env.body()))
+      return ReplyError(fd, env, "bad GetActorRequest");
+    raytpu::ActorReply rep;
+    auto it = actors_.find(req.actor_id());
+    if (it != actors_.end()) {
+      rep.set_found(true);
+      *rep.mutable_info() = it->second;
+    }
+    Reply(fd, env, rep);
+  }
+
+  void HandleGetNamedActor(int fd, const raytpu::Envelope& env) {
+    raytpu::GetNamedActorRequest req;
+    if (!req.ParseFromString(env.body()))
+      return ReplyError(fd, env, "bad GetNamedActorRequest");
+    raytpu::ActorReply rep;
+    auto it = named_.find({req.namespace_(), req.name()});
+    if (it != named_.end()) {
+      auto ait = actors_.find(it->second);
+      if (ait != actors_.end()) {
+        rep.set_found(true);
+        *rep.mutable_info() = ait->second;
+      }
+    }
+    Reply(fd, env, rep);
+  }
+
+  void HandleListActors(int fd, const raytpu::Envelope& env) {
+    raytpu::ListActorsReply rep;
+    for (auto& [id, info] : actors_) *rep.add_actors() = info;
+    Reply(fd, env, rep);
+  }
+
+  // ------------------------------------------------------------ pg / job
+
+  void HandleUpsertPg(int fd, const raytpu::Envelope& env) {
+    raytpu::RegisterPgRequest req;
+    if (!req.ParseFromString(env.body()))
+      return ReplyError(fd, env, "bad RegisterPgRequest");
+    pgs_[req.info().pg_id()] = req.info();
+    Journal(env.method(), env.body());
+    raytpu::Empty e;
+    Reply(fd, env, e);
+  }
+
+  void HandleRemovePg(int fd, const raytpu::Envelope& env) {
+    raytpu::RemovePgRequest req;
+    if (!req.ParseFromString(env.body()))
+      return ReplyError(fd, env, "bad RemovePgRequest");
+    pgs_.erase(req.pg_id());
+    Journal(raytpu::REMOVE_PG, env.body());
+    raytpu::Empty e;
+    Reply(fd, env, e);
+  }
+
+  void HandleListPgs(int fd, const raytpu::Envelope& env) {
+    raytpu::ListPgsReply rep;
+    for (auto& [id, info] : pgs_) *rep.add_pgs() = info;
+    Reply(fd, env, rep);
+  }
+
+  void HandleRegisterJob(int fd, const raytpu::Envelope& env) {
+    raytpu::RegisterJobRequest req;
+    if (!req.ParseFromString(env.body()))
+      return ReplyError(fd, env, "bad RegisterJobRequest");
+    jobs_[req.info().job_id()] = req.info();
+    Journal(raytpu::REGISTER_JOB, env.body());
+    raytpu::Empty e;
+    Reply(fd, env, e);
+  }
+
+  void HandleListJobs(int fd, const raytpu::Envelope& env) {
+    raytpu::ListJobsReply rep;
+    for (auto& [id, info] : jobs_) *rep.add_jobs() = info;
+    Reply(fd, env, rep);
+  }
+
+  void HandleStats(int fd, const raytpu::Envelope& env) {
+    raytpu::StatsReply rep;
+    auto& m = *rep.mutable_counters();
+    m["nodes_total"] = nodes_.size();
+    uint64_t alive = 0;
+    for (auto& [id, n] : nodes_)
+      if (n.alive()) alive++;
+    m["nodes_alive"] = alive;
+    m["actors"] = actors_.size();
+    m["pgs"] = pgs_.size();
+    m["jobs"] = jobs_.size();
+    m["objects_tracked"] = obj_dir_.size();
+    m["connections"] = conns_.size();
+    m["cluster_epoch"] = cluster_epoch_;
+    for (auto& [k, v] : counters_) m[k] = v;
+    Reply(fd, env, rep);
+  }
+
+  // ------------------------------------------------------------ persistence
+
+  std::string SnapshotPath() { return data_dir_ + "/state_snapshot.pb"; }
+  std::string JournalPath() { return data_dir_ + "/state_journal.pb"; }
+
+  void OpenJournal() {
+    journal_ = fopen(JournalPath().c_str(), "ab");
+    if (journal_ == nullptr) perror("open journal");
+  }
+
+  void WriteSnapshot() {
+    raytpu::StateSnapshot snap;
+    for (auto& [id, info] : nodes_) *snap.add_nodes() = info;
+    for (auto& [id, info] : actors_) *snap.add_actors() = info;
+    for (auto& [id, info] : pgs_) *snap.add_pgs() = info;
+    for (auto& [id, info] : jobs_) *snap.add_jobs() = info;
+    for (auto& [ns, entries] : kv_) {
+      for (auto& [k, v] : entries) {
+        auto* e = snap.add_kv();
+        e->set_ns(ns);
+        e->set_key(k);
+        e->set_value(v);
+      }
+    }
+    snap.set_cluster_epoch(cluster_epoch_);
+    std::string tmp = SnapshotPath() + ".tmp";
+    FILE* f = fopen(tmp.c_str(), "wb");
+    if (f == nullptr) return;
+    std::string data;
+    snap.SerializeToString(&data);
+    fwrite(data.data(), 1, data.size(), f);
+    fclose(f);
+    rename(tmp.c_str(), SnapshotPath().c_str());
+    // Journal entries up to this snapshot are now redundant.
+    if (journal_ != nullptr) {
+      fclose(journal_);
+      journal_ = nullptr;
+    }
+    FILE* j = fopen(JournalPath().c_str(), "wb");  // truncate
+    if (j != nullptr) fclose(j);
+    OpenJournal();
+  }
+
+  void LoadPersisted() {
+    // 1. snapshot
+    FILE* f = fopen(SnapshotPath().c_str(), "rb");
+    if (f != nullptr) {
+      std::string data;
+      char buf[1 << 16];
+      size_t n;
+      while ((n = fread(buf, 1, sizeof(buf), f)) > 0) data.append(buf, n);
+      fclose(f);
+      raytpu::StateSnapshot snap;
+      if (snap.ParseFromString(data)) {
+        for (auto& info : snap.nodes()) nodes_[info.node_id()] = info;
+        for (auto& info : snap.actors()) {
+          actors_[info.actor_id()] = info;
+          if (!info.name().empty() && info.state() != "DEAD")
+            named_[{info.namespace_(), info.name()}] = info.actor_id();
+        }
+        for (auto& info : snap.pgs()) pgs_[info.pg_id()] = info;
+        for (auto& info : snap.jobs()) jobs_[info.job_id()] = info;
+        for (auto& e : snap.kv()) kv_[e.ns()][e.key()] = e.value();
+        cluster_epoch_ = snap.cluster_epoch();
+      }
+    }
+    // 2. journal replay
+    f = fopen(JournalPath().c_str(), "rb");
+    if (f != nullptr) {
+      std::string data;
+      char buf[1 << 16];
+      size_t n;
+      while ((n = fread(buf, 1, sizeof(buf), f)) > 0) data.append(buf, n);
+      fclose(f);
+      size_t off = 0;
+      while (data.size() - off >= 4) {
+        const unsigned char* p = (const unsigned char*)data.data() + off;
+        uint32_t len = (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+                       (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+        if (data.size() - off - 4 < len) break;  // torn tail write
+        raytpu::JournalRecord rec;
+        if (rec.ParseFromString(data.substr(off + 4, len))) ReplayRecord(rec);
+        off += 4 + len;
+      }
+    }
+    // Give restored nodes a grace period to resume heartbeating.
+    for (auto& [id, info] : nodes_) {
+      if (info.alive()) hb_deadline_[id] = mono_ms() + 2 * hb_timeout_ms_;
+    }
+  }
+
+  void ReplayRecord(const raytpu::JournalRecord& rec) {
+    switch (rec.method()) {
+      case raytpu::REGISTER_NODE: {
+        raytpu::RegisterNodeRequest req;
+        if (req.ParseFromString(rec.body())) ApplyRegisterNode(req);
+        break;
+      }
+      case raytpu::MARK_NODE_DEAD: {
+        raytpu::MarkNodeDeadRequest req;
+        if (req.ParseFromString(rec.body())) ApplyMarkNodeDead(req);
+        break;
+      }
+      case raytpu::KV_PUT: {
+        raytpu::KvPutRequest req;
+        if (req.ParseFromString(rec.body())) kv_[req.ns()][req.key()] = req.value();
+        break;
+      }
+      case raytpu::KV_DEL: {
+        raytpu::KvDelRequest req;
+        if (req.ParseFromString(rec.body())) {
+          auto it = kv_.find(req.ns());
+          if (it != kv_.end()) it->second.erase(req.key());
+        }
+        break;
+      }
+      case raytpu::REGISTER_ACTOR:
+      case raytpu::UPDATE_ACTOR: {
+        raytpu::RegisterActorRequest req;
+        if (req.ParseFromString(rec.body())) ApplyUpsertActor(req);
+        break;
+      }
+      case raytpu::REGISTER_PG:
+      case raytpu::UPDATE_PG: {
+        raytpu::RegisterPgRequest req;
+        if (req.ParseFromString(rec.body())) pgs_[req.info().pg_id()] = req.info();
+        break;
+      }
+      case raytpu::REMOVE_PG: {
+        raytpu::RemovePgRequest req;
+        if (req.ParseFromString(rec.body())) pgs_.erase(req.pg_id());
+        break;
+      }
+      case raytpu::REGISTER_JOB: {
+        raytpu::RegisterJobRequest req;
+        if (req.ParseFromString(rec.body())) jobs_[req.info().job_id()] = req.info();
+        break;
+      }
+      default:
+        break;
+    }
+  }
+
+  // -------------------------------------------------------------- members
+
+  std::string host_;
+  int port_;
+  std::string data_dir_;
+  double hb_timeout_ms_;
+  double snapshot_interval_s_;
+  int listen_fd_ = -1;
+  int epfd_ = -1;
+  int timer_fd_ = -1;
+  FILE* journal_ = nullptr;
+  uint64_t cluster_epoch_ = 0;
+
+  std::unordered_map<int, Conn> conns_;
+  std::unordered_map<std::string, raytpu::NodeInfo> nodes_;
+  std::unordered_map<std::string, double> hb_deadline_;  // mono ms
+  std::unordered_map<std::string,
+                     std::unordered_map<std::string, std::string>>
+      kv_;
+  std::unordered_map<std::string, raytpu::ActorInfo> actors_;
+  std::map<std::pair<std::string, std::string>, std::string> named_;
+  std::unordered_map<std::string, raytpu::PgInfo> pgs_;
+  std::unordered_map<std::string, raytpu::JobInfo> jobs_;
+  std::unordered_map<std::string, std::set<std::string>> obj_dir_;
+  std::unordered_map<std::string, uint64_t> obj_sizes_;
+  std::map<std::string, uint64_t> counters_;
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = 0;
+  std::string host = "127.0.0.1";
+  std::string data_dir;
+  std::string port_file;
+  double hb_timeout_ms = 10000;
+  double snapshot_interval_s = 30;
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    auto next = [&](const char* flag) -> std::string {
+      if (i + 1 >= argc) {
+        fprintf(stderr, "missing value for %s\n", flag);
+        exit(2);
+      }
+      return argv[++i];
+    };
+    if (a == "--port") port = atoi(next("--port").c_str());
+    else if (a == "--host") host = next("--host");
+    else if (a == "--data-dir") data_dir = next("--data-dir");
+    else if (a == "--port-file") port_file = next("--port-file");
+    else if (a == "--heartbeat-timeout-ms")
+      hb_timeout_ms = atof(next("--heartbeat-timeout-ms").c_str());
+    else if (a == "--snapshot-interval-s")
+      snapshot_interval_s = atof(next("--snapshot-interval-s").c_str());
+    else {
+      fprintf(stderr, "unknown flag %s\n", a.c_str());
+      return 2;
+    }
+  }
+  signal(SIGINT, on_signal);
+  signal(SIGTERM, on_signal);
+  signal(SIGPIPE, SIG_IGN);
+  StateService svc(port, host, data_dir, hb_timeout_ms, snapshot_interval_s);
+  return svc.Run(port_file);
+}

@@ -1,0 +1,399 @@
+"""RPC substrate: length-prefixed protobuf frames over TCP.
+
+The L1 layer (the reference's ``src/ray/rpc/`` gRPC wrappers, redesigned):
+one socket per client→server direction carries multiplexed request/reply
+frames matched by ``seq``, plus unsolicited server pushes (``seq=0``) for
+pubsub. Long-running requests (task pushes) keep their seq open until the
+work finishes — the reply IS the completion notification, so there is no
+separate polling or callback channel (the reference needs PushTask +
+reply + pubsub for the same round trip).
+
+Wire format: ``4-byte big-endian length | Envelope protobuf`` — see
+``ray_tpu/protocol/raytpu.proto``.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, Optional, Tuple
+
+from ray_tpu.protocol import pb
+
+logger = logging.getLogger("ray_tpu")
+
+MAX_FRAME = 1 << 31  # 2 GiB hard cap per frame
+_LEN = struct.Struct(">I")
+
+
+class RpcConnectionError(ConnectionError):
+    pass
+
+
+class RpcRemoteError(RuntimeError):
+    """The peer's handler raised; message carries the remote error string."""
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            raise RpcConnectionError("connection closed by peer")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def read_frame(sock: socket.socket) -> pb.Envelope:
+    (length,) = _LEN.unpack(_read_exact(sock, 4))
+    if length > MAX_FRAME:
+        raise RpcConnectionError(f"frame too large: {length}")
+    env = pb.Envelope()
+    env.ParseFromString(_read_exact(sock, length))
+    return env
+
+
+def frame_bytes(env: pb.Envelope) -> bytes:
+    payload = env.SerializeToString()
+    return _LEN.pack(len(payload)) + payload
+
+
+class _Pending:
+    __slots__ = ("event", "env")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.env: Optional[pb.Envelope] = None
+
+
+class RpcClient:
+    """One outgoing connection; thread-safe calls multiplexed by seq."""
+
+    def __init__(self, address: str, connect_timeout: float = 10.0,
+                 on_push: Optional[Callable[[pb.Envelope], None]] = None,
+                 on_close: Optional[Callable[[Exception], None]] = None):
+        host, port = address.rsplit(":", 1)
+        self.address = address
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=connect_timeout)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._wlock = threading.Lock()
+        self._plock = threading.Lock()
+        self._pending: Dict[int, _Pending] = {}
+        self._seq = 0
+        self._on_push = on_push
+        self._on_close = on_close
+        self._closed = False
+        self._close_exc: Optional[Exception] = None
+        self._reader = threading.Thread(target=self._read_loop, daemon=True,
+                                        name=f"rpc-client-{address}")
+        self._reader.start()
+
+    # -- public ---------------------------------------------------------------
+
+    def call(self, method: int, body: bytes = b"",
+             timeout: Optional[float] = None) -> pb.Envelope:
+        """Send a request, block for its reply. Raises RpcRemoteError on a
+        handler error, RpcConnectionError if the connection dies first."""
+        pending = _Pending()
+        with self._plock:
+            if self._closed:
+                raise RpcConnectionError(
+                    f"connection to {self.address} is closed: {self._close_exc}")
+            self._seq += 1
+            seq = self._seq
+            self._pending[seq] = pending
+        env = pb.Envelope(seq=seq, method=method, body=body)
+        try:
+            self._send(env)
+            if not pending.event.wait(timeout):
+                raise TimeoutError(
+                    f"rpc {pb.Method.Name(method)} to {self.address} timed out")
+        finally:
+            with self._plock:
+                self._pending.pop(seq, None)
+        reply = pending.env
+        if reply is None:
+            raise RpcConnectionError(
+                f"connection to {self.address} lost mid-call: {self._close_exc}")
+        if reply.error:
+            raise RpcRemoteError(reply.error)
+        return reply
+
+    def call_async(self, method: int, body: bytes,
+                   callback: Callable[[Optional[pb.Envelope],
+                                       Optional[Exception]], None]) -> None:
+        """Fire a request; invoke ``callback(reply, None)`` or
+        ``callback(None, error)`` from the reader thread when done."""
+        pending = _Pending()
+        pending.callback = callback  # type: ignore[attr-defined]
+        with self._plock:
+            if self._closed:
+                callback(None, RpcConnectionError(
+                    f"connection to {self.address} is closed"))
+                return
+            self._seq += 1
+            seq = self._seq
+            self._pending[seq] = pending
+        try:
+            self._send(pb.Envelope(seq=seq, method=method, body=body))
+        except Exception as e:
+            with self._plock:
+                self._pending.pop(seq, None)
+            callback(None, e)
+
+    def send_oneway(self, method: int, body: bytes = b"") -> None:
+        self._send(pb.Envelope(seq=0, method=method, body=body))
+
+    def close(self):
+        self._shutdown(RpcConnectionError("closed locally"))
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    # -- internals ------------------------------------------------------------
+
+    def _send(self, env: pb.Envelope):
+        data = frame_bytes(env)
+        with self._wlock:
+            try:
+                self._sock.sendall(data)
+            except OSError as e:
+                raise RpcConnectionError(str(e)) from e
+
+    def _read_loop(self):
+        try:
+            while True:
+                env = read_frame(self._sock)
+                if env.seq == 0 and not env.reply:
+                    if self._on_push is not None:
+                        try:
+                            self._on_push(env)
+                        except Exception:
+                            logger.exception("push handler failed")
+                    continue
+                with self._plock:
+                    pending = self._pending.get(env.seq)
+                if pending is None:
+                    continue
+                pending.env = env
+                cb = getattr(pending, "callback", None)
+                if cb is not None:
+                    with self._plock:
+                        self._pending.pop(env.seq, None)
+                    err = RpcRemoteError(env.error) if env.error else None
+                    try:
+                        cb(None if err else env, err)
+                    except Exception:
+                        logger.exception("rpc callback failed")
+                else:
+                    pending.event.set()
+        except Exception as e:  # noqa: BLE001 — connection teardown
+            self._shutdown(e)
+
+    def _shutdown(self, exc: Exception):
+        with self._plock:
+            if self._closed:
+                return
+            self._closed = True
+            self._close_exc = exc
+            pending, self._pending = dict(self._pending), {}
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for p in pending.values():
+            cb = getattr(p, "callback", None)
+            if cb is not None:
+                try:
+                    cb(None, RpcConnectionError(str(exc)))
+                except Exception:
+                    logger.exception("rpc callback failed on close")
+            else:
+                p.event.set()  # p.env stays None -> caller raises
+        if self._on_close is not None:
+            try:
+                self._on_close(exc)
+            except Exception:
+                logger.exception("on_close handler failed")
+
+
+class RpcContext:
+    """Handed to server handlers; reply now or later (from any thread)."""
+
+    def __init__(self, server: "RpcServer", sock: socket.socket,
+                 wlock: threading.Lock, env: pb.Envelope):
+        self._sock = sock
+        self._wlock = wlock
+        self.method = env.method
+        self.seq = env.seq
+        self.body = env.body
+        self.peer = None  # set by server
+        self._done = False
+
+    def reply(self, body: bytes = b""):
+        self._reply(pb.Envelope(seq=self.seq, method=self.method,
+                                reply=True, body=body))
+
+    def reply_error(self, message: str):
+        self._reply(pb.Envelope(seq=self.seq, method=self.method,
+                                reply=True, error=message))
+
+    def push(self, method: int, body: bytes):
+        """Unsolicited push to this connection (pubsub delivery)."""
+        with self._wlock:
+            self._sock.sendall(frame_bytes(
+                pb.Envelope(seq=0, method=method, body=body)))
+
+    def _reply(self, env: pb.Envelope):
+        if self._done:
+            return
+        self._done = True
+        data = frame_bytes(env)
+        try:
+            with self._wlock:
+                self._sock.sendall(data)
+        except OSError:
+            pass  # caller vanished; nothing to do
+
+
+Handler = Callable[[RpcContext], None]
+
+
+class RpcServer:
+    """Threaded frame server. The handler receives an RpcContext and MUST
+    eventually call ctx.reply()/ctx.reply_error() (possibly from another
+    thread — that is how task pushes defer their reply to completion)."""
+
+    def __init__(self, handler: Handler, host: str = "127.0.0.1",
+                 port: int = 0, max_workers: int = 64):
+        self._handler = handler
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, port))
+        self._lsock.listen(128)
+        self.host, self.port = self._lsock.getsockname()
+        self.address = f"{self.host}:{self.port}"
+        self._pool = ThreadPoolExecutor(max_workers=max_workers,
+                                        thread_name_prefix="rpc-srv")
+        self._conns: Dict[int, Tuple[socket.socket, threading.Lock]] = {}
+        self._conn_lock = threading.Lock()
+        self._closed = False
+        self._on_disconnect: Optional[Callable[[int], None]] = None
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"rpc-accept-{self.port}")
+        self._accept_thread.start()
+
+    def set_on_disconnect(self, cb: Callable[[int], None]):
+        self._on_disconnect = cb
+
+    def close(self):
+        self._closed = True
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        with self._conn_lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for sock, _ in conns:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+    def _accept_loop(self):
+        conn_id = 0
+        while not self._closed:
+            try:
+                sock, _addr = self._lsock.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn_id += 1
+            wlock = threading.Lock()
+            with self._conn_lock:
+                self._conns[conn_id] = (sock, wlock)
+            t = threading.Thread(target=self._conn_loop,
+                                 args=(conn_id, sock, wlock), daemon=True,
+                                 name=f"rpc-conn-{self.port}-{conn_id}")
+            t.start()
+
+    def _conn_loop(self, conn_id: int, sock: socket.socket,
+                   wlock: threading.Lock):
+        try:
+            while True:
+                env = read_frame(sock)
+                ctx = RpcContext(self, sock, wlock, env)
+                ctx.conn_id = conn_id
+                self._pool.submit(self._run_handler, ctx)
+        except Exception:  # noqa: BLE001 — normal disconnect path
+            pass
+        finally:
+            with self._conn_lock:
+                self._conns.pop(conn_id, None)
+            try:
+                sock.close()
+            except OSError:
+                pass
+            if self._on_disconnect is not None:
+                try:
+                    self._on_disconnect(conn_id)
+                except Exception:
+                    logger.exception("on_disconnect failed")
+
+    def _run_handler(self, ctx: RpcContext):
+        try:
+            self._handler(ctx)
+        except Exception as e:  # noqa: BLE001 — report to caller
+            logger.exception("rpc handler error for %s",
+                             pb.Method.Name(ctx.method)
+                             if ctx.method in pb.Method.values() else ctx.method)
+            ctx.reply_error(f"{type(e).__name__}: {e}")
+
+
+class ConnectionPool:
+    """Shared per-process outgoing connections, keyed by address."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._clients: Dict[str, RpcClient] = {}
+
+    def get(self, address: str,
+            on_close: Optional[Callable[[str, Exception], None]] = None
+            ) -> RpcClient:
+        with self._lock:
+            client = self._clients.get(address)
+            if client is not None and not client.closed:
+                return client
+
+            def _closed(exc: Exception, _addr=address):
+                with self._lock:
+                    cur = self._clients.get(_addr)
+                    if cur is not None and cur.closed:
+                        del self._clients[_addr]
+                if on_close is not None:
+                    on_close(_addr, exc)
+
+            client = RpcClient(address, on_close=_closed)
+            self._clients[address] = client
+            return client
+
+    def drop(self, address: str):
+        with self._lock:
+            client = self._clients.pop(address, None)
+        if client is not None:
+            client.close()
+
+    def close_all(self):
+        with self._lock:
+            clients, self._clients = list(self._clients.values()), {}
+        for c in clients:
+            c.close()

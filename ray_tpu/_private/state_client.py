@@ -1,0 +1,239 @@
+"""Client for the C++ state service (the GcsClient role,
+``src/ray/gcs/gcs_client/accessor.h`` + ``python/ray/_private/gcs_utils.py:226``).
+
+Wraps one RpcClient connection with typed accessors for the node table,
+internal KV, object directory, actor/PG/job tables, and pubsub. A second
+dedicated connection carries subscriptions so pushed events never contend
+with request/reply traffic.
+"""
+
+from __future__ import annotations
+
+import logging
+import subprocess
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ray_tpu._private.rpc import RpcClient, RpcConnectionError
+from ray_tpu.protocol import pb
+
+logger = logging.getLogger("ray_tpu")
+
+
+def start_state_service(port: int = 0, host: str = "127.0.0.1",
+                        data_dir: str = "", heartbeat_timeout_ms: float = 10000,
+                        snapshot_interval_s: float = 30
+                        ) -> Tuple[subprocess.Popen, str]:
+    """Spawn the state-service daemon; returns (process, address)."""
+    import os
+    import tempfile
+    from ray_tpu._native.build import build_state_service
+    exe = build_state_service()
+    port_file = tempfile.mktemp(prefix="raytpu_state_port_")
+    cmd = [exe, "--port", str(port), "--host", host,
+           "--port-file", port_file,
+           "--heartbeat-timeout-ms", str(heartbeat_timeout_ms),
+           "--snapshot-interval-s", str(snapshot_interval_s)]
+    if data_dir:
+        cmd += ["--data-dir", data_dir]
+    proc = subprocess.Popen(cmd)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if os.path.exists(port_file):
+            with open(port_file) as f:
+                text = f.read().strip()
+            if text:
+                os.unlink(port_file)
+                return proc, f"{host}:{text}"
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"state service exited rc={proc.returncode} before listening")
+        time.sleep(0.01)
+    proc.kill()
+    raise TimeoutError("state service did not start listening in time")
+
+
+class StateClient:
+    def __init__(self, address: str):
+        self.address = address
+        self._client = RpcClient(address)
+        self._sub_client: Optional[RpcClient] = None
+        self._sub_lock = threading.Lock()
+        self._handlers: Dict[str, List[Callable[[pb.Event], None]]] = {}
+
+    # ------------------------------------------------------------------ core
+
+    def _call(self, method: int, msg=None, timeout: float = 30.0) -> bytes:
+        body = msg.SerializeToString() if msg is not None else b""
+        return self._client.call(method, body, timeout=timeout).body
+
+    def close(self):
+        self._client.close()
+        if self._sub_client is not None:
+            self._sub_client.close()
+
+    def ping(self) -> float:
+        rep = pb.PingReply()
+        rep.ParseFromString(self._call(pb.PING))
+        return rep.time_ms
+
+    def stats(self) -> Dict[str, int]:
+        rep = pb.StatsReply()
+        rep.ParseFromString(self._call(pb.STATE_STATS))
+        return dict(rep.counters)
+
+    def checkpoint(self):
+        self._call(pb.CHECKPOINT)
+
+    # ----------------------------------------------------------------- nodes
+
+    def register_node(self, info: pb.NodeInfo) -> pb.RegisterNodeReply:
+        rep = pb.RegisterNodeReply()
+        rep.ParseFromString(self._call(
+            pb.REGISTER_NODE, pb.RegisterNodeRequest(info=info)))
+        return rep
+
+    def heartbeat(self, node_id: bytes,
+                  available: Optional[Dict[str, float]] = None) -> bool:
+        req = pb.HeartbeatRequest(node_id=node_id)
+        if available is not None:
+            req.available.amounts.update(available)
+        rep = pb.HeartbeatReply()
+        rep.ParseFromString(self._call(pb.HEARTBEAT, req, timeout=10.0))
+        return rep.recognized
+
+    def list_nodes(self) -> List[pb.NodeInfo]:
+        rep = pb.ListNodesReply()
+        rep.ParseFromString(self._call(pb.LIST_NODES))
+        return list(rep.nodes)
+
+    def mark_node_dead(self, node_id: bytes, reason: str = ""):
+        self._call(pb.MARK_NODE_DEAD,
+                   pb.MarkNodeDeadRequest(node_id=node_id, reason=reason))
+
+    # -------------------------------------------------------------------- kv
+
+    def kv_put(self, key: bytes, value: bytes, overwrite: bool = True,
+               namespace: bytes = b"") -> bool:
+        rep = pb.KvPutReply()
+        rep.ParseFromString(self._call(pb.KV_PUT, pb.KvPutRequest(
+            ns=namespace, key=key, value=value, overwrite=overwrite)))
+        return rep.added
+
+    def kv_get(self, key: bytes, namespace: bytes = b"") -> Optional[bytes]:
+        rep = pb.KvGetReply()
+        rep.ParseFromString(self._call(
+            pb.KV_GET, pb.KvGetRequest(ns=namespace, key=key)))
+        return rep.value if rep.found else None
+
+    def kv_del(self, key: bytes, namespace: bytes = b"") -> bool:
+        rep = pb.KvDelReply()
+        rep.ParseFromString(self._call(
+            pb.KV_DEL, pb.KvDelRequest(ns=namespace, key=key)))
+        return rep.deleted
+
+    def kv_keys(self, prefix: bytes = b"", namespace: bytes = b"") -> List[bytes]:
+        rep = pb.KvKeysReply()
+        rep.ParseFromString(self._call(
+            pb.KV_KEYS, pb.KvKeysRequest(ns=namespace, prefix=prefix)))
+        return list(rep.keys)
+
+    # ---------------------------------------------------------------- pubsub
+
+    def subscribe(self, channels: List[str],
+                  handler: Callable[[pb.Event], None]):
+        """Register a handler for pushed events on the given channels."""
+        with self._sub_lock:
+            for ch in channels:
+                self._handlers.setdefault(ch, []).append(handler)
+            if self._sub_client is None:
+                self._sub_client = RpcClient(
+                    self.address, on_push=self._on_push)
+            self._sub_client.call(
+                pb.SUBSCRIBE,
+                pb.SubscribeRequest(channels=channels).SerializeToString(),
+                timeout=10.0)
+
+    def _on_push(self, env: pb.Envelope):
+        if env.method != pb.PUBLISH:
+            return
+        ev = pb.Event()
+        ev.ParseFromString(env.body)
+        with self._sub_lock:
+            handlers = list(self._handlers.get(ev.channel, []))
+        for h in handlers:
+            try:
+                h(ev)
+            except Exception:
+                logger.exception("pubsub handler failed for %s", ev.channel)
+
+    def publish(self, channel: str, kind: str, payload: bytes = b""):
+        self._call(pb.PUBLISH, pb.PublishRequest(
+            event=pb.Event(channel=channel, kind=kind, payload=payload)))
+
+    # ------------------------------------------------------ object directory
+
+    def add_location(self, object_id: bytes, node_id: bytes, size: int = 0):
+        self._call(pb.ADD_LOCATION, pb.ObjectLocRequest(
+            object_id=object_id, node_id=node_id, size=size))
+
+    def remove_location(self, object_id: bytes, node_id: bytes):
+        self._call(pb.REMOVE_LOCATION, pb.ObjectLocRequest(
+            object_id=object_id, node_id=node_id))
+
+    def get_locations(self, object_id: bytes) -> pb.GetLocationsReply:
+        rep = pb.GetLocationsReply()
+        rep.ParseFromString(self._call(
+            pb.GET_LOCATIONS, pb.GetLocationsRequest(object_id=object_id)))
+        return rep
+
+    # ---------------------------------------------------------------- actors
+
+    def register_actor(self, info: pb.ActorInfo):
+        self._call(pb.REGISTER_ACTOR, pb.RegisterActorRequest(info=info))
+
+    def update_actor(self, info: pb.ActorInfo):
+        self._call(pb.UPDATE_ACTOR, pb.RegisterActorRequest(info=info))
+
+    def get_actor(self, actor_id: bytes) -> Optional[pb.ActorInfo]:
+        rep = pb.ActorReply()
+        rep.ParseFromString(self._call(
+            pb.GET_ACTOR, pb.GetActorRequest(actor_id=actor_id)))
+        return rep.info if rep.found else None
+
+    def get_named_actor(self, name: str,
+                        namespace: str = "default") -> Optional[pb.ActorInfo]:
+        rep = pb.ActorReply()
+        rep.ParseFromString(self._call(pb.GET_NAMED_ACTOR, pb.GetNamedActorRequest(
+            name=name, namespace=namespace)))
+        return rep.info if rep.found else None
+
+    def list_actors(self) -> List[pb.ActorInfo]:
+        rep = pb.ListActorsReply()
+        rep.ParseFromString(self._call(pb.LIST_ACTORS))
+        return list(rep.actors)
+
+    # ------------------------------------------------------------- pgs, jobs
+
+    def register_pg(self, info: pb.PgInfo):
+        self._call(pb.REGISTER_PG, pb.RegisterPgRequest(info=info))
+
+    def update_pg(self, info: pb.PgInfo):
+        self._call(pb.UPDATE_PG, pb.RegisterPgRequest(info=info))
+
+    def remove_pg(self, pg_id: bytes):
+        self._call(pb.REMOVE_PG, pb.RemovePgRequest(pg_id=pg_id))
+
+    def list_pgs(self) -> List[pb.PgInfo]:
+        rep = pb.ListPgsReply()
+        rep.ParseFromString(self._call(pb.LIST_PGS))
+        return list(rep.pgs)
+
+    def register_job(self, info: pb.JobInfo):
+        self._call(pb.REGISTER_JOB, pb.RegisterJobRequest(info=info))
+
+    def list_jobs(self) -> List[pb.JobInfo]:
+        rep = pb.ListJobsReply()
+        rep.ParseFromString(self._call(pb.LIST_JOBS))
+        return list(rep.jobs)
