@@ -1,0 +1,1586 @@
+"""The multi-process distributed runtime.
+
+Every process in a ray_tpu cluster — drivers and host daemons alike — runs
+one ``DistributedRuntime``: a ``Runtime`` (the local execution engine:
+thread-pool workers, object store, actor mailboxes) extended with the
+cross-process layer the reference spreads over core_worker + raylet +
+object_manager:
+
+- **Submitter** (``CoreWorkerDirectTaskSubmitter`` role,
+  ``src/ray/core_worker/transport/direct_task_transport.cc:365-534``):
+  scheduling policies run submitter-side over a heartbeat-refreshed view of
+  the cluster; the chosen daemon admits or answers SPILLBACK with its live
+  availability, which updates the view and reschedules — the reference's
+  spillback semantics without a central lease bottleneck.
+- **Executor** (raylet + worker roles): a PUSH_TASK/ACTOR_CALL handler that
+  admits against local resources, runs the task in the local engine, and
+  replies on completion — the reply IS the completion notification, with
+  small results inlined (the reference's in-band small returns,
+  ``_raylet.pyx`` SealReturnObject) and large ones kept in the executing
+  store with their location published to the object directory.
+- **Object plane** (``object_manager.h:114``, ``pull_manager.h:47``):
+  ``get_object`` resolves local store → in-flight future → owner address →
+  object directory, then pulls the value in chunks over FETCH_OBJECT.
+- **Borrowing refcount** (``reference_count.h:61``): serializing a ref emits
+  a marker carrying (object, owner address, sender address); deserializing
+  registers a borrow with the owner synchronously and releases the sender's
+  serialize-time pin; the owner frees only when local refs + pins + borrows
+  all reach zero, and drops borrows from processes that die.
+- **Failure handling**: state-service heartbeats detect dead nodes
+  (``gcs_heartbeat_manager.h:36``); in-flight pushes to a dead daemon fail
+  over to resubmission (tasks retry per ``max_retries``, actors restart per
+  ``max_restarts`` on surviving nodes), and lost objects reconstruct from
+  lineage at their submitter.
+
+TPU stance: the daemon is the device-owner process (libtpu is single-owner),
+so "worker pool" remains threads inside it; the tensor plane between daemons
+is ``jax.distributed`` + compiled collectives (see collective/), NOT this
+object plane — only control messages and host data ride these sockets.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import logging
+import os
+import pickle
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private.config import _config
+from ray_tpu._private.ids import (ActorID, JobID, NodeID, ObjectID,
+                                  PlacementGroupID, TaskID)
+from ray_tpu._private.resources import NodeResources, ResourceSet
+from ray_tpu._private.rpc import (ConnectionPool, RpcClient,
+                                  RpcConnectionError, RpcContext,
+                                  RpcRemoteError, RpcServer)
+from ray_tpu._private.runtime import (ActorState, Node, Runtime,
+                                      task_context, _ref_ids_in)
+from ray_tpu._private.scheduler import Infeasible, NodeState
+from ray_tpu._private.state_client import StateClient
+from ray_tpu._private.task_spec import TaskOptions, TaskSpec
+from ray_tpu.protocol import pb
+
+logger = logging.getLogger("ray_tpu")
+
+INLINE_RESULT_MAX = 256 * 1024  # results below this ride in the reply
+FETCH_CHUNK = 8 * 1024 * 1024
+FN_NS = b"fun"  # KV namespace of the function table
+
+
+def _fn_key(payload: bytes) -> bytes:
+    return hashlib.sha256(payload).digest()
+
+
+class _PgBundleKey:
+    """Duck-typed stand-in for a PlacementGroup handle on the daemon side:
+    `_allocation_target` only needs `.id`."""
+
+    def __init__(self, pg_id: PlacementGroupID):
+        self.id = pg_id
+
+
+class _RemoteActorRecord:
+    """Driver-side record of an actor hosted on another daemon. Duck-types
+    the pieces of ActorState that ActorHandle paths touch."""
+
+    def __init__(self, actor_id: ActorID, cls_name: str, address: str,
+                 node_id: bytes, options, name: str, namespace: str,
+                 spec_msg: Optional[pb.ActorSpecMsg] = None):
+        self.actor_id = actor_id
+        self.cls_name = cls_name
+        self.address = address
+        self.node_id = node_id
+        self.options = options
+        self.name = name
+        self.namespace = namespace
+        self.spec_msg = spec_msg  # for restarts (creator only)
+        self.status = "ALIVE"
+        self.restart_count = 0
+        self.death_cause: Optional[BaseException] = None
+        self.lock = threading.Lock()
+
+    @property
+    def cls(self):
+        return type(self.cls_name, (), {"__name__": self.cls_name})
+
+
+def _deserialize_dist_ref(id_bytes: bytes, owner_addr: str,
+                          sender_addr: str):
+    """Unpickle hook for cross-process refs: register a borrow with the
+    owner, release the sender's serialize-time pin, bind locally."""
+    from ray_tpu._private import worker as _worker
+    from ray_tpu.object_ref import ObjectRef
+    oid = ObjectID(id_bytes)
+    runtime = _worker.try_global_runtime()
+    if isinstance(runtime, DistributedRuntime):
+        runtime.register_incoming_ref(oid, owner_addr, sender_addr)
+        return ObjectRef(oid, owner=runtime)
+    return ObjectRef(oid, owner=runtime)
+
+
+class DistributedRuntime(Runtime):
+    def __init__(self, state_addr: str, resources: ResourceSet,
+                 job_id: Optional[JobID] = None, is_driver: bool = True,
+                 listen_host: str = "127.0.0.1",
+                 labels: Optional[dict] = None,
+                 heartbeat_interval_s: float = 1.0,
+                 view_refresh_s: float = 0.5,
+                 namespace: str = "default"):
+        super().__init__(job_id=job_id)
+        self.is_driver = is_driver
+        self.namespace = namespace
+        self.state = StateClient(state_addr)
+        self.state_addr = state_addr
+        self.pool = ConnectionPool()
+        self._hb_interval = heartbeat_interval_s
+        self._view_refresh = view_refresh_s
+
+        # Local execution node.
+        self.local_node: Node = self.add_node(resources, labels=labels)
+
+        # RPC server for peers. Enqueue-style methods run inline on the
+        # reader thread so per-caller ordering holds (actor calls must hit
+        # the mailbox in submission order).
+        self.server = RpcServer(
+            self._handle_rpc, host=listen_host, max_workers=256,
+            inline_methods={pb.PUSH_TASK, pb.ACTOR_CALL, pb.ADD_BORROW,
+                            pb.REMOVE_BORROW, pb.RELEASE_PIN, pb.PING,
+                            pb.CANCEL_TASK, pb.RESERVE_BUNDLE,
+                            pb.FREE_BUNDLE, pb.FREE_OBJECT})
+        self.address = self.server.address
+
+        # Cluster view: node_id bytes -> (pb.NodeInfo, NodeResources view).
+        self._view_lock = threading.Lock()
+        self._view: Dict[bytes, pb.NodeInfo] = {}
+        self._view_avail: Dict[bytes, NodeResources] = {}
+        self._addr_by_node: Dict[bytes, str] = {}
+
+        # Ownership / borrow bookkeeping.
+        self._owner_addr: Dict[ObjectID, str] = {}  # oid -> owner address
+        self._location_hints: Dict[ObjectID, str] = {}  # oid -> fetch addr
+
+        # Remote submission bookkeeping.
+        self._exported_fns: Dict[bytes, bytes] = {}  # hash -> payload
+        self._fn_cache: Dict[bytes, Any] = {}  # hash -> callable/class
+        self._inflight_lock = threading.Lock()
+        self._inflight_remote: Dict[TaskID, dict] = {}
+        self._completed_returns: set = set()  # return oids known done
+
+        # Remote actors this process created or uses.
+        self.remote_actors: Dict[ActorID, _RemoteActorRecord] = {}
+        self._dir_probe_at: Dict[ObjectID, float] = {}
+        self._fetch_cache: Dict[ObjectID, bytes] = {}
+        self._fetch_cache_lock = threading.Lock()
+        # Addresses with recent connection failures are excluded from
+        # selection until the deadline passes or the heartbeat sweep
+        # settles their fate (the submitter-side analogue of the lease
+        # policy avoiding known-bad raylets).
+        self._suspect_addrs: Dict[str, float] = {}
+
+        # Register with the state service.
+        info = pb.NodeInfo(node_id=self.local_node.node_id.binary(),
+                           address=self.address, is_head=is_driver)
+        for k, v in self.local_node.resources.total.to_dict().items():
+            info.total.amounts[k] = v
+            info.available.amounts[k] = v
+        for k, v in (labels or {}).items():
+            info.labels[k] = str(v)
+        self.state.register_node(info)
+        if is_driver:
+            self.state.register_job(pb.JobInfo(
+                job_id=self.job_id.binary(), driver_address=self.address,
+                state="RUNNING", start_ms=time.time() * 1e3))
+
+        # Pubsub: node lifecycle.
+        self.state.subscribe(["nodes"], self._on_node_event)
+        self._refresh_view()
+
+        self._hb_stop = threading.Event()
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop,
+                                           daemon=True, name="dist-heartbeat")
+        self._hb_thread.start()
+        self._view_thread = threading.Thread(target=self._view_loop,
+                                             daemon=True, name="dist-view")
+        self._view_thread.start()
+
+    # ------------------------------------------------------------- lifecycle
+
+    def _heartbeat_loop(self):
+        while not self._hb_stop.wait(self._hb_interval):
+            try:
+                avail = self.local_node.resources.available.to_dict()
+                recognized = self.state.heartbeat(
+                    self.local_node.node_id.binary(), avail)
+                if not recognized:
+                    # State service restarted: re-register + re-publish our
+                    # object locations (raylet-notify-GCS-restart analogue).
+                    info = pb.NodeInfo(
+                        node_id=self.local_node.node_id.binary(),
+                        address=self.address, is_head=self.is_driver)
+                    for k, v in self.local_node.resources.total.to_dict().items():
+                        info.total.amounts[k] = v
+                    for k, v in avail.items():
+                        info.available.amounts[k] = v
+                    self.state.register_node(info)
+                    for oid in list(self.local_node.store.object_ids()):
+                        try:
+                            self.state.add_location(
+                                oid.binary(), self.local_node.node_id.binary())
+                        except Exception:
+                            break
+            except Exception:
+                if self._hb_stop.is_set():
+                    return
+                logger.warning("heartbeat to state service failed",
+                               exc_info=True)
+
+    def _view_loop(self):
+        while not self._hb_stop.wait(self._view_refresh):
+            try:
+                self._refresh_view()
+            except Exception:
+                if self._hb_stop.is_set():
+                    return
+
+    def _refresh_view(self):
+        nodes = self.state.list_nodes()
+        my_id = self.local_node.node_id.binary()
+        with self._view_lock:
+            seen = set()
+            for info in nodes:
+                if info.node_id == my_id:
+                    continue
+                seen.add(info.node_id)
+                self._view[info.node_id] = info
+                self._addr_by_node[info.node_id] = info.address
+                nr = NodeResources(ResourceSet(dict(info.total.amounts)))
+                nr.available = ResourceSet(dict(info.available.amounts))
+                self._view_avail[info.node_id] = nr
+            for nid in list(self._view):
+                if nid not in seen:
+                    del self._view[nid]
+                    self._view_avail.pop(nid, None)
+        self._kick()
+
+    def _on_node_event(self, ev: pb.Event):
+        info = pb.NodeInfo()
+        info.ParseFromString(ev.payload)
+        if ev.kind == "NODE_DEAD":
+            self._handle_remote_node_death(info)
+        elif ev.kind == "NODE_ADDED":
+            if info.node_id != self.local_node.node_id.binary():
+                with self._view_lock:
+                    self._view[info.node_id] = info
+                    self._addr_by_node[info.node_id] = info.address
+                    nr = NodeResources(ResourceSet(dict(info.total.amounts)))
+                    self._view_avail[info.node_id] = nr
+            self._kick()
+
+    def _handle_remote_node_death(self, info: pb.NodeInfo):
+        nid = info.node_id
+        addr = info.address or self._addr_by_node.get(nid, "")
+        with self._view_lock:
+            entry = self._view.get(nid)
+            if entry is not None:
+                entry.alive = False
+            self._view_avail.pop(nid, None)
+        if addr:
+            self.pool.drop(addr)
+            # Drop borrows held by the dead process.
+            self.reference_counter.remove_borrower(addr)
+            # Fail in-flight pushes to it (connection close usually beats
+            # this, but the pubsub path covers half-open connections).
+            self._fail_inflight_to(addr, f"node {info.node_id.hex()[:8]} died")
+            # Restart/kill actors we own that lived there.
+            for rec in list(self.remote_actors.values()):
+                if rec.address == addr and rec.status == "ALIVE":
+                    self._handle_remote_actor_death(
+                        rec, exc.NodeDiedError(
+                            f"node hosting actor died ({addr})"))
+        # Drop location hints pointing at the dead node.
+        for oid, hint in list(self._location_hints.items()):
+            if hint == addr:
+                del self._location_hints[oid]
+        self.emit_event("NODE_DEAD", node_id=info.node_id.hex())
+        self._kick()
+
+    def shutdown(self):
+        self._hb_stop.set()
+        if self.is_driver:
+            try:
+                self.state.register_job(pb.JobInfo(
+                    job_id=self.job_id.binary(), driver_address=self.address,
+                    state="FINISHED"))
+            except Exception:
+                pass
+        try:
+            self.state.mark_node_dead(self.local_node.node_id.binary(),
+                                      "graceful shutdown")
+        except Exception:
+            pass
+        super().shutdown()
+        self.server.close()
+        self.pool.close_all()
+        try:
+            self.state.close()
+        except Exception:
+            pass
+
+    # --------------------------------------------------------- borrow plane
+
+    def reduce_ref(self, oid: ObjectID):
+        """Cross-process ref reduction: pin locally (released by the
+        deserializer via RELEASE_PIN), embed owner + sender addresses."""
+        self.reference_counter.pin_for_task(oid)
+        owner = self._owner_addr.get(oid, self.address)
+        return (_deserialize_dist_ref,
+                (oid.binary(), owner, self.address))
+
+    def register_incoming_ref(self, oid: ObjectID, owner_addr: str,
+                              sender_addr: str):
+        if owner_addr != self.address:
+            self._owner_addr[oid] = owner_addr
+            self._location_hints.setdefault(oid, owner_addr)
+            try:
+                client = self.pool.get(owner_addr)
+                client.call(pb.ADD_BORROW, pb.BorrowRequest(
+                    object_id=oid.binary(),
+                    borrower=self.address).SerializeToString(), timeout=30)
+            except Exception:
+                logger.debug("ADD_BORROW to %s failed", owner_addr,
+                             exc_info=True)
+        # Release the sender's serialize-time pin (async, best effort).
+        if sender_addr == self.address:
+            self.reference_counter.unpin_for_task(oid)
+        else:
+            def _release():
+                try:
+                    self.pool.get(sender_addr).call(
+                        pb.RELEASE_PIN, pb.FreeObjectRequest(
+                            object_id=oid.binary()).SerializeToString(),
+                        timeout=30)
+                except Exception:
+                    pass
+            self.offload(_release)
+
+    def _on_ref_zero(self, oid: ObjectID):
+        owner = self._owner_addr.pop(oid, None) if hasattr(
+            self, "_owner_addr") else None
+        if owner is not None and owner != getattr(self, "address", None):
+            # We were a borrower: tell the owner, drop local cache.
+            def _notify():
+                try:
+                    self.pool.get(owner).call(
+                        pb.REMOVE_BORROW, pb.BorrowRequest(
+                            object_id=oid.binary(),
+                            borrower=self.address).SerializeToString(),
+                        timeout=30)
+                except Exception:
+                    pass
+            self.offload(_notify)
+        super()._on_ref_zero(oid)
+        if hasattr(self, "_location_hints"):
+            self._location_hints.pop(oid, None)
+            self._completed_returns.discard(oid)
+            self._dir_probe_at.pop(oid, None)
+            with self._fetch_cache_lock:
+                self._fetch_cache.pop(oid, None)
+
+    # --------------------------------------------------------- object plane
+
+    def put_object(self, value: Any, owner_node: Optional[Node] = None) -> ObjectID:
+        oid = super().put_object(value, owner_node=self.local_node)
+        self._owner_addr[oid] = self.address
+        return oid
+
+    def get_object(self, oid: ObjectID, timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        backoff = 0.002
+        while True:
+            # 1. Local store.
+            if self.local_node.store.contains(oid):
+                try:
+                    return self.local_node.store.get(oid, timeout=0)
+                except exc.RayTpuError:
+                    raise
+                except Exception:
+                    pass
+            # 2. A task we pushed remotely may complete into local seal.
+            info = self._inflight_for_return(oid)
+            if info is not None:
+                remaining = None if deadline is None else max(
+                    0.0, deadline - time.monotonic())
+                if not info["event"].wait(
+                        timeout=min(0.2, remaining)
+                        if remaining is not None else 0.2):
+                    if deadline is not None and time.monotonic() > deadline:
+                        raise exc.GetTimeoutError(f"get({oid}) timed out")
+                    continue
+                continue  # sealed now (value or error) -> loop re-checks
+            # 3. Remote fetch: hint, then directory.
+            value, found = self._try_remote_fetch(oid)
+            if found:
+                return value
+            # 4. Local-mode semantics (lineage reconstruction etc).
+            with self.lock:
+                spec = self.lineage.get(oid)
+                state = (self.task_states.get(spec.task_id)
+                         if spec is not None else None)
+            if spec is not None and state in ("FINISHED", "FAILED", None):
+                if not self._try_reconstruct(oid):
+                    raise exc.ObjectLostError(
+                        f"object {oid} lost and not reconstructable")
+            elif spec is None and not self._owner_addr.get(oid):
+                # Unknown object: maybe producer hasn't sealed yet; poll
+                # directory with backoff until timeout.
+                pass
+            if deadline is not None and time.monotonic() > deadline:
+                raise exc.GetTimeoutError(f"get({oid}) timed out")
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 0.1)
+
+    def _inflight_for_return(self, oid: ObjectID) -> Optional[dict]:
+        with self._inflight_lock:
+            for info in self._inflight_remote.values():
+                if oid in info["returns"]:
+                    return info
+        return None
+
+    def _try_remote_fetch(self, oid: ObjectID) -> Tuple[Any, bool]:
+        addrs: List[str] = []
+        hint = self._location_hints.get(oid)
+        if hint and hint != self.address:
+            addrs.append(hint)
+        owner = self._owner_addr.get(oid)
+        if owner and owner != self.address and owner not in addrs:
+            addrs.append(owner)
+        try:
+            rep = self.state.get_locations(oid.binary())
+            for a in rep.addresses:
+                if a and a != self.address and a not in addrs:
+                    addrs.append(a)
+        except Exception:
+            pass
+        for addr in addrs:
+            try:
+                value, err = self._fetch_from(addr, oid)
+            except (RpcConnectionError, RpcRemoteError, TimeoutError):
+                continue
+            if err is not None:
+                raise err
+            if value is not _FETCH_MISS:
+                # Cache locally + advertise (pull-through caching like the
+                # reference's local plasma copy after a pull).
+                self.local_node.store.put(oid, value)
+                with self.lock:
+                    self.object_locations[oid] = self.local_node.node_id
+                self._location_hints[oid] = addr
+                try:
+                    self.state.add_location(
+                        oid.binary(), self.local_node.node_id.binary())
+                except Exception:
+                    pass
+                return value, True
+        return None, False
+
+    def _fetch_from(self, addr: str, oid: ObjectID):
+        """Chunked pull of a pickled object. Returns (value | _FETCH_MISS,
+        error_or_none)."""
+        client = self.pool.get(addr)
+        buf = io.BytesIO()
+        offset = 0
+        while True:
+            rep = pb.FetchObjectReply()
+            rep.ParseFromString(client.call(
+                pb.FETCH_OBJECT, pb.FetchObjectRequest(
+                    object_id=oid.binary(), offset=offset,
+                    max_bytes=FETCH_CHUNK).SerializeToString(),
+                timeout=120).body)
+            if not rep.found:
+                return _FETCH_MISS, None
+            if rep.error_pickle:
+                return _FETCH_MISS, pickle.loads(rep.error_pickle)
+            buf.write(rep.data)
+            offset += len(rep.data)
+            if rep.eof or not rep.data:
+                break
+        return pickle.loads(buf.getvalue()), None
+
+    def object_ready(self, oid: ObjectID) -> bool:
+        if self.local_node.store.contains(oid):
+            return True
+        if oid in self._completed_returns:
+            return True
+        node = self._locate(oid)
+        if node is not None and node.store.contains(oid):
+            return True
+        # Remote? Throttled directory probe.
+        now = time.monotonic()
+        last = self._dir_probe_at.get(oid, 0.0)
+        if now - last < 0.05:
+            return False
+        self._dir_probe_at[oid] = now
+        if self._location_hints.get(oid):
+            return True
+        try:
+            rep = self.state.get_locations(oid.binary())
+            if rep.addresses:
+                self._location_hints[oid] = next(
+                    (a for a in rep.addresses if a), "")
+                return True
+        except Exception:
+            pass
+        return False
+
+    # ------------------------------------------------------------ scheduling
+
+    def node_states(self) -> List[NodeState]:
+        """Worker-facing cluster view (drives ``ray_tpu.nodes()`` etc.)."""
+        return self._cluster_states() + [
+            NodeState(NodeID(nid), NodeResources(
+                ResourceSet(dict(info.total.amounts))), False)
+            for nid, info in self._view.items() if not info.alive]
+
+    def _cluster_states(self, include_suspects: bool = False
+                        ) -> List[NodeState]:
+        states = [self.local_node.state()]
+        now = time.monotonic()
+        with self._view_lock:
+            for nid, info in self._view.items():
+                if not info.alive:
+                    continue
+                if (not include_suspects
+                        and self._suspect_addrs.get(info.address, 0) > now):
+                    continue
+                nr = self._view_avail.get(nid)
+                if nr is None:
+                    nr = NodeResources(ResourceSet(dict(info.total.amounts)))
+                    self._view_avail[nid] = nr
+                states.append(NodeState(NodeID(nid), nr, True))
+        return states
+
+    def _select_node(self, spec: TaskSpec) -> Optional[NodeID]:
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy, PlacementGroupSchedulingStrategy)
+        strategy = spec.options.scheduling_strategy
+        request = spec.options.resources
+        pg = spec.options.placement_group
+        if isinstance(strategy, PlacementGroupSchedulingStrategy):
+            pg = strategy.placement_group
+            spec.options.placement_group = pg
+            spec.options.placement_group_bundle_index = (
+                strategy.placement_group_bundle_index)
+        states = self._cluster_states()
+        if pg is not None:
+            pg_state = self.placement_groups.get(pg.id)
+            if pg_state is None or not pg_state.ready.is_set():
+                return None
+            if pg_state.bundle_nodes is None:
+                return None
+            idx = spec.options.placement_group_bundle_index
+            candidates = (pg_state.bundle_nodes if idx < 0
+                          else [pg_state.bundle_nodes[idx]])
+            alive = {s.node_id for s in states if s.alive}
+            for nid in candidates:
+                if nid in alive:
+                    return nid
+            return None
+        if isinstance(strategy, NodeAffinitySchedulingStrategy):
+            from ray_tpu._private.scheduler import NodeAffinityPolicy
+            return NodeAffinityPolicy().select(states, request,
+                                               strategy.node_id, strategy.soft)
+        if strategy == "SPREAD":
+            chosen = self.spread_policy.select(states, request)
+        else:
+            preferred = task_context.node_id or self.local_node.node_id
+            chosen = self.hybrid_policy.select(states, request, preferred)
+        if chosen is None and not any(
+                n.alive and n.resources.could_ever_fit(request)
+                for n in self._cluster_states(include_suspects=True)):
+            raise Infeasible(
+                f"request {request} cannot be satisfied by any node in the "
+                f"cluster")
+        return chosen
+
+    def _try_dispatch(self, item: dict) -> str:
+        spec: TaskSpec = item["spec"]
+        cancel = item["cancel"]
+        if cancel.is_set():
+            for rid in spec.return_ids:
+                self.seal_error(rid, exc.TaskCancelledError(spec.task_id),
+                                self.local_node)
+            self._unpin_args(spec)
+            with self.lock:
+                self.task_states[spec.task_id] = "CANCELLED"
+            self._fire_completion(spec)
+            return "done"
+        if not self._deps_ready_dist(spec):
+            return "wait"
+        err = self._first_dep_error(spec)
+        if err is not None:
+            for rid in spec.return_ids:
+                self.seal_error(rid, err, self.local_node)
+            self._unpin_args(spec)
+            with self.lock:
+                self.task_states[spec.task_id] = "FAILED"
+            self._fire_completion(spec)
+            return "done"
+        node_id = self._select_node(spec)
+        if node_id is None:
+            return "wait"
+        if node_id == self.local_node.node_id:
+            node = self.local_node
+            request = self._effective_request(spec)
+            alloc_target = self._allocation_target(spec, node)
+            if not alloc_target.can_fit(request):
+                return "wait"
+            alloc_target.allocate(request)
+            with self.lock:
+                self.task_states[spec.task_id] = "RUNNING"
+            node.submit(self._execute_task, spec, node, request,
+                        alloc_target, cancel)
+            return "done"
+        # Remote push.
+        nid = node_id.binary()
+        with self._view_lock:
+            addr = self._addr_by_node.get(nid)
+            nr = self._view_avail.get(nid)
+        if addr is None:
+            return "wait"
+        request = self._effective_request(spec)
+        if nr is not None and nr.can_fit(request):
+            nr.allocate(request)  # optimistic; corrected by next refresh
+        self._push_task_remote(spec, addr, cancel)
+        with self.lock:
+            self.task_states[spec.task_id] = "RUNNING"
+        return "done"
+
+    def _deps_ready_dist(self, spec: TaskSpec) -> bool:
+        """A dep is ready if it exists anywhere reachable (it will be pulled
+        at execution time); only truly-lost deps trigger reconstruction."""
+        for oid in _ref_ids_in(spec.args, spec.kwargs):
+            if self.object_ready(oid):
+                continue
+            if self._inflight_for_return(oid) is not None:
+                return False  # still being produced remotely
+            with self.lock:
+                known = oid in self.object_locations
+                dep_spec = self.lineage.get(oid)
+                state = (self.task_states.get(dep_spec.task_id)
+                         if dep_spec is not None else None)
+            if (not known and dep_spec is not None
+                    and state in ("FINISHED", "FAILED")):
+                self._try_reconstruct(oid)
+            return False
+        return True
+
+    def _allocation_target(self, spec: TaskSpec, node: Node):
+        key = getattr(spec, "_dist_pg", None)
+        if key is not None:
+            pg_id, idx = key
+            if idx >= 0:
+                target = node.bundles.get((pg_id, idx))
+                if target is not None:
+                    return target
+            for (pgid, i), br in node.bundles.items():
+                if pgid == pg_id and br.can_fit(spec.options.resources):
+                    return br
+            for (pgid, i), br in node.bundles.items():
+                if pgid == pg_id:
+                    return br
+            raise Infeasible("no bundle of placement group on this node")
+        return super()._allocation_target(spec, node)
+
+    # ---------------------------------------------------- remote submission
+
+    def _export_callable(self, fn) -> bytes:
+        payload = cloudpickle.dumps(fn)
+        key = _fn_key(payload)
+        if key not in self._exported_fns:
+            self.state.kv_put(key, payload, overwrite=False, namespace=FN_NS)
+            self._exported_fns[key] = payload
+        return key
+
+    def _load_callable(self, key: bytes):
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            payload = self.state.kv_get(key, namespace=FN_NS)
+            if payload is None:
+                raise exc.RayTpuError(
+                    f"function {key.hex()[:12]} not in function table")
+            fn = cloudpickle.loads(payload)
+            self._fn_cache[key] = fn
+        return fn
+
+    def _spec_to_msg(self, spec: TaskSpec) -> pb.TaskSpecMsg:
+        msg = pb.TaskSpecMsg(
+            task_id=spec.task_id.binary(),
+            job_id=spec.job_id.binary(),
+            function_name=spec.function_name,
+            num_returns=spec.options.num_returns,
+            return_ids=[r.binary() for r in spec.return_ids],
+            attempt=spec.attempt,
+            max_retries=spec.options.max_retries,
+            caller_address=self.address,
+            name=spec.options.name or "",
+        )
+        if spec.is_actor_task():
+            msg.actor_id = spec.actor_id.binary()
+            msg.method_name = spec.method_name or ""
+        else:
+            msg.fn_hash = self._export_callable(spec.function)
+        msg.args_pickle = cloudpickle.dumps((spec.args, spec.kwargs))
+        for k, v in spec.options.resources.to_dict().items():
+            msg.resources.amounts[k] = v
+        if spec.options.runtime_env:
+            msg.runtime_env_json = json.dumps(
+                spec.options.runtime_env).encode()
+        re = spec.options.retry_exceptions
+        if re not in (False, None):
+            msg.retry_exceptions_pickle = cloudpickle.dumps(re)
+        pg = spec.options.placement_group
+        if pg is not None:
+            msg.pg_id = pg.id.binary()
+            msg.pg_bundle_index = spec.options.placement_group_bundle_index
+        return msg
+
+    def _push_task_remote(self, spec: TaskSpec, addr: str, cancel,
+                          method: int = pb.PUSH_TASK):
+        msg = self._spec_to_msg(spec)
+        info = {
+            "spec": spec, "addr": addr, "cancel": cancel,
+            "returns": set(spec.return_ids), "event": threading.Event(),
+        }
+        with self._inflight_lock:
+            self._inflight_remote[spec.task_id] = info
+
+        def _done(env, error):
+            self._on_remote_reply(spec, addr, cancel, env, error)
+
+        try:
+            client = self.pool.get(
+                addr, on_close=self._on_peer_conn_close)
+            client.call_async(method, msg.SerializeToString(), _done)
+        except Exception as e:  # connection refused etc.
+            self._on_remote_reply(spec, addr, cancel, None, e)
+
+    def _on_remote_reply(self, spec: TaskSpec, addr: str, cancel,
+                         env, error):
+        with self._inflight_lock:
+            info = self._inflight_remote.pop(spec.task_id, None)
+        try:
+            if error is not None:
+                self._handle_push_failure(spec, addr, cancel, error)
+                return
+            self._suspect_addrs.pop(addr, None)  # proven alive
+            rep = pb.PushTaskReply()
+            rep.ParseFromString(env.body)
+            if rep.status == "spillback":
+                # Correct the stale view and reschedule.
+                with self._view_lock:
+                    nrs = [nr for nid, nr in self._view_avail.items()
+                           if self._addr_by_node.get(nid) == addr]
+                    for nr in nrs:
+                        nr.available = ResourceSet(dict(rep.available.amounts))
+                with self._pending_cv:
+                    self._pending.append({"spec": spec, "cancel": cancel})
+                    self._pending_cv.notify_all()
+                return
+            if rep.error_pickle:
+                err = pickle.loads(rep.error_pickle)
+                for rid in spec.return_ids:
+                    self.seal_error(rid, err, self.local_node)
+                with self.lock:
+                    self.task_states[spec.task_id] = "FAILED"
+            else:
+                for i, rid in enumerate(spec.return_ids):
+                    if i < len(rep.inline) and rep.inline[i]:
+                        value = pickle.loads(rep.inline_results[i])
+                        self.local_node.store.put(rid, value)
+                        with self.lock:
+                            self.object_locations[rid] = self.local_node.node_id
+                        self._owner_addr.setdefault(rid, self.address)
+                    else:
+                        self._location_hints[rid] = addr
+                        self._owner_addr.setdefault(rid, addr)
+                    self._completed_returns.add(rid)
+                with self.lock:
+                    self.task_states[spec.task_id] = "FINISHED"
+            self._unpin_args(spec)
+            self._fire_completion(spec)
+        finally:
+            if info is not None:
+                info["event"].set()
+            self._kick()
+
+    def _handle_push_failure(self, spec: TaskSpec, addr: str, cancel,
+                             error: Exception):
+        """The daemon died mid-task (connection error): retry elsewhere."""
+        # Mark the address suspect so resubmissions avoid it until the
+        # heartbeat sweep settles its fate (view refresh keeps listing it
+        # alive until then).
+        with self._view_lock:
+            self._suspect_addrs[addr] = time.monotonic() + 10.0
+        cause = exc.NodeDiedError(
+            f"task {spec.function_name} lost to node failure at {addr}: "
+            f"{error}")
+        if spec.is_actor_task():
+            # Actor-call semantics: replay onto the (restarting) actor only
+            # within max_task_retries, else surface ActorDiedError
+            # (gcs_actor_manager.h:66 + max_task_retries replay).
+            if spec.should_retry(cause) and not cancel.is_set():
+                spec.attempt += 1
+                self.offload(lambda: self.submit_actor_task(
+                    spec.actor_id, spec))
+                return
+            died = exc.ActorDiedError(
+                f"actor call {spec.function_name} lost: {cause}")
+            for rid in spec.return_ids:
+                self.seal_error(rid, died, self.local_node)
+            with self.lock:
+                self.task_states[spec.task_id] = "FAILED"
+            self._unpin_args(spec)
+            self._fire_completion(spec)
+            return
+        if spec.should_retry(cause) and not cancel.is_set():
+            spec.attempt += 1
+            self.emit_event("TASK_RETRY", task=spec.function_name,
+                            attempt=spec.attempt, reason="node_died")
+            with self._pending_cv:
+                self._pending.append({"spec": spec, "cancel": cancel})
+                self._pending_cv.notify_all()
+            return
+        for rid in spec.return_ids:
+            self.seal_error(rid, cause, self.local_node)
+        with self.lock:
+            self.task_states[spec.task_id] = "FAILED"
+        self._unpin_args(spec)
+        self._fire_completion(spec)
+
+    def _on_peer_conn_close(self, addr: str, error: Exception):
+        # call_async callbacks fire individually; nothing global needed here.
+        logger.debug("peer connection to %s closed: %s", addr, error)
+
+    def _fail_inflight_to(self, addr: str, reason: str):
+        with self._inflight_lock:
+            items = [(tid, info) for tid, info in self._inflight_remote.items()
+                     if info["addr"] == addr]
+        for tid, info in items:
+            with self._inflight_lock:
+                self._inflight_remote.pop(tid, None)
+            self._handle_push_failure(info["spec"], addr, info["cancel"],
+                                      RpcConnectionError(reason))
+            info["event"].set()
+
+    # -------------------------------------------------------------- actors
+
+    def create_actor(self, state: ActorState) -> None:
+        # Register in the global actor table first (name collision check).
+        info = pb.ActorInfo(
+            actor_id=state.actor_id.binary(), name=state.name or "",
+            namespace=state.namespace, class_name=state.cls.__name__,
+            state="PENDING", owner_job=self.job_id.binary())
+        try:
+            self.state.register_actor(info)
+        except RpcRemoteError as e:
+            raise ValueError(str(e)) from e
+        with self.lock:
+            self.actors[state.actor_id] = state
+            if state.name:
+                self.named_actors[(state.namespace, state.name)] = state.actor_id
+        self._util_pool.submit(self._place_actor_dist, state)
+
+    def _place_actor_dist(self, state: ActorState, restart: bool = False):
+        deadline = time.monotonic() + _config.get("worker_lease_timeout_s")
+        request = state.options.resources
+        spec_like = TaskSpec(
+            task_id=TaskID.for_actor_task(self.job_id, state.actor_id),
+            job_id=self.job_id, function=lambda: None,
+            function_name=f"{state.cls.__name__}.__init__", args=state.args,
+            kwargs=state.kwargs, options=state.options)
+        while True:
+            try:
+                node_id = self._select_node(spec_like)
+            except Infeasible as e:
+                self._mark_actor_dead(state, exc.ActorDiedError(str(e)))
+                self._sync_actor_info(state)
+                return
+            if node_id == self.local_node.node_id:
+                node = self.local_node
+                target = self._allocation_target(spec_like, node)
+                if target.can_fit(request):
+                    target.allocate(request)
+                    state.node_id = node_id
+                    state.devices = self._assign_devices(request, node)
+                    self._start_actor_on_node(state, node, request)
+                    self._sync_actor_info(state, address=self.address,
+                                          wait_ready=True)
+                    return
+            elif node_id is not None:
+                if self._create_actor_remote(state, node_id.binary()):
+                    return
+            if time.monotonic() > deadline:
+                self._mark_actor_dead(state, exc.ActorDiedError(
+                    f"could not place actor {state.cls.__name__} "
+                    f"(resources {request})"))
+                self._sync_actor_info(state)
+                return
+            time.sleep(0.05)
+
+    def _create_actor_remote(self, state: ActorState, nid: bytes) -> bool:
+        with self._view_lock:
+            addr = self._addr_by_node.get(nid)
+        if addr is None:
+            return False
+        msg = pb.ActorSpecMsg(
+            actor_id=state.actor_id.binary(), job_id=self.job_id.binary(),
+            class_name=state.cls.__name__,
+            cls_hash=self._export_callable(state.cls),
+            args_pickle=cloudpickle.dumps((state.args, state.kwargs)),
+            options_pickle=cloudpickle.dumps(state.options),
+            name=state.name or "", namespace=state.namespace,
+            caller_address=self.address,
+            restart_count=state.restart_count)
+        try:
+            env = self.pool.get(addr).call(
+                pb.CREATE_ACTOR, msg.SerializeToString(), timeout=None)
+        except (RpcConnectionError, TimeoutError):
+            return False
+        rep = pb.CreateActorReply()
+        rep.ParseFromString(env.body)
+        if rep.status == "spillback":
+            return False
+        if rep.status == "error":
+            err = pickle.loads(rep.error_pickle)
+            self._mark_actor_dead(state, err if isinstance(
+                err, exc.ActorDiedError) else exc.ActorDiedError(str(err)))
+            self._sync_actor_info(state)
+            return True
+        # Remote actor is alive. Track it, then hand any calls that were
+        # queued locally while placement was in flight over to the daemon
+        # (in mailbox order).
+        rec = _RemoteActorRecord(
+            state.actor_id, state.cls.__name__, addr, nid, state.options,
+            state.name or "", state.namespace, spec_msg=msg)
+        rec.restart_count = state.restart_count
+        self.remote_actors[state.actor_id] = rec
+        with state.lock:
+            state.status = ActorState.ALIVE
+            state.node_id = NodeID(nid)
+            state.ready.set()
+        self._forward_mailbox(state, rec)
+        self._sync_actor_info(state, address=addr)
+        return True
+
+    def _forward_mailbox(self, state: ActorState, rec: _RemoteActorRecord):
+        """Re-route calls enqueued in the local mailbox to the remote host
+        (single drainer at a time preserves per-caller order)."""
+        import queue as _q
+        with rec.lock:
+            while True:
+                try:
+                    item = state.mailbox.get_nowait()
+                except _q.Empty:
+                    return
+                if item is None:
+                    continue
+                spec, cancel = item
+                with self.lock:
+                    self.task_states[spec.task_id] = "RUNNING"
+                self._push_task_remote(spec, rec.address, cancel,
+                                       method=pb.ACTOR_CALL)
+
+    def _sync_actor_info(self, state: ActorState, address: str = "",
+                         wait_ready: bool = False):
+        def _do():
+            if wait_ready:
+                state.ready.wait(timeout=60)
+            info = pb.ActorInfo(
+                actor_id=state.actor_id.binary(), name=state.name or "",
+                namespace=state.namespace, class_name=state.cls.__name__,
+                state=state.status, address=address,
+                restart_count=state.restart_count,
+                owner_job=self.job_id.binary(),
+                death_cause=str(state.death_cause or ""))
+            if state.node_id is not None:
+                info.node_id = (state.node_id.binary()
+                                if hasattr(state.node_id, "binary")
+                                else state.node_id)
+            try:
+                self.state.update_actor(info)
+            except Exception:
+                pass
+        self.offload(_do)
+
+    def _handle_remote_actor_death(self, rec: _RemoteActorRecord,
+                                   cause: BaseException):
+        state = self.actors.get(rec.actor_id)
+        rec.status = "DEAD"
+        self.remote_actors.pop(rec.actor_id, None)
+        if state is None:
+            return
+        max_restarts = getattr(state.options, "max_restarts", 0)
+        if max_restarts != -1 and state.restart_count >= max_restarts:
+            self._mark_actor_dead(state, cause)
+            self._sync_actor_info(state)
+            return
+        with state.lock:
+            state.restart_count += 1
+            state.status = ActorState.RESTARTING
+            state.ready.clear()
+        self.emit_event("ACTOR_RESTART", actor=state.cls.__name__,
+                        attempt=state.restart_count)
+        self._util_pool.submit(self._place_actor_dist, state, True)
+
+    def _place_and_start_actor(self, state: ActorState, restart: bool = False):
+        """Daemon-side / restart placement is local-only: cluster-wide actor
+        placement always goes through the creator's ``_place_actor_dist``."""
+        request = state.options.resources
+        node = self.local_node
+        deadline = time.monotonic() + _config.get("worker_lease_timeout_s")
+        while True:
+            with self.lock:
+                if node.resources.can_fit(request):
+                    node.resources.allocate(request)
+                    break
+            if time.monotonic() > deadline:
+                self._mark_actor_dead(state, exc.ActorDiedError(
+                    f"could not re-place actor {state.cls.__name__} locally"))
+                return
+            time.sleep(0.02)
+        state.node_id = node.node_id
+        state.devices = self._assign_devices(request, node)
+        self._start_actor_on_node(state, node, request)
+
+    def submit_actor_task(self, actor_id: ActorID, spec: TaskSpec):
+        rec = self.remote_actors.get(actor_id)
+        state = self.actors.get(actor_id)
+        if rec is None and state is None:
+            # Maybe a named/foreign actor we learned about from the table.
+            info = self.state.get_actor(actor_id.binary())
+            if info is not None and info.address and \
+                    info.address != self.address and info.state != "DEAD":
+                rec = _RemoteActorRecord(
+                    actor_id, info.class_name, info.address,
+                    info.node_id, None, info.name, info.namespace)
+                self.remote_actors[actor_id] = rec
+        if rec is not None and rec.address != self.address:
+            return self._submit_actor_remote(rec, actor_id, spec)
+        ids = super().submit_actor_task(actor_id, spec)
+        # Placement may have resolved to a remote node between our rec check
+        # and the local enqueue: hand the mailbox over.
+        rec = self.remote_actors.get(actor_id)
+        if rec is not None and rec.address != self.address and state is not None:
+            self._forward_mailbox(state, rec)
+        return ids
+
+    def _submit_actor_remote(self, rec: _RemoteActorRecord,
+                             actor_id: ActorID, spec: TaskSpec):
+        if not spec.return_ids:
+            spec.return_ids = tuple(
+                ObjectID.for_return(spec.task_id, i)
+                for i in range(spec.options.num_returns))
+        cancel = threading.Event()
+        with self.lock:
+            self.cancel_flags[spec.task_id] = cancel
+            for rid in spec.return_ids:
+                self.lineage[rid] = spec
+            self.task_states[spec.task_id] = "RUNNING"
+        for oid in _ref_ids_in(spec.args, spec.kwargs):
+            self.reference_counter.pin_for_task(oid)
+        spec.actor_id = actor_id
+        with rec.lock:  # order with any in-flight mailbox handoff
+            self._push_task_remote(spec, rec.address, cancel,
+                                   method=pb.ACTOR_CALL)
+        return list(spec.return_ids)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        rec = self.remote_actors.get(actor_id)
+        if rec is not None and rec.address != self.address:
+            # The daemon always tears its instance down; restart semantics
+            # live with the creator (us), so a single restart happens.
+            try:
+                self.pool.get(rec.address).call(
+                    pb.KILL_ACTOR, pb.KillActorRequest(
+                        actor_id=actor_id.binary(),
+                        no_restart=True).SerializeToString(),
+                    timeout=30)
+            except (RpcConnectionError, TimeoutError, RpcRemoteError):
+                pass
+            rec.status = "DEAD"
+            self.remote_actors.pop(actor_id, None)
+            state = self.actors.get(actor_id)
+            if state is not None:
+                if no_restart:
+                    self._mark_actor_dead(state, exc.ActorDiedError(
+                        "actor was killed via ray_tpu.kill"))
+                    self._sync_actor_info(state)
+                else:
+                    self._handle_remote_actor_death(
+                        rec, exc.ActorDiedError("killed"))
+            return
+        super().kill_actor(actor_id, no_restart=no_restart)
+        state = self.actors.get(actor_id)
+        if state is not None:
+            self._sync_actor_info(state)
+
+    def get_named_actor(self, name: str, namespace: str = "default"):
+        with self.lock:
+            actor_id = self.named_actors.get((namespace, name))
+            if actor_id is not None:
+                state = self.actors.get(actor_id)
+                if state is not None and state.status != ActorState.DEAD:
+                    return state
+        info = self.state.get_named_actor(name, namespace)
+        if info is None or info.state == "DEAD":
+            raise ValueError(
+                f"no actor named {name!r} in namespace {namespace!r}")
+        actor_id = ActorID(info.actor_id)
+        rec = self.remote_actors.get(actor_id)
+        if rec is None:
+            rec = _RemoteActorRecord(actor_id, info.class_name, info.address,
+                                     info.node_id, None, info.name,
+                                     info.namespace)
+            if info.address != self.address:
+                self.remote_actors[actor_id] = rec
+        return rec
+
+    # ---------------------------------------------------- placement groups
+
+    def _place_pg(self, pg):
+        from ray_tpu._private.scheduler import schedule_bundles
+        deadline = time.monotonic() + _config.get("worker_lease_timeout_s")
+        while time.monotonic() < deadline:
+            states = self._cluster_states()
+            assignment = schedule_bundles(states, pg.bundles, pg.strategy)
+            if assignment is not None and self._reserve_bundles(pg, assignment):
+                pg.bundle_nodes = assignment
+                pg.state = "CREATED"
+                pg.ready.set()
+                self._register_pg_info(pg)
+                self._kick()
+                return
+            time.sleep(0.05)
+        pg.state = "INFEASIBLE"
+        pg.ready.set()
+
+    def _reserve_bundles(self, pg, assignment: List[NodeID]) -> bool:
+        reserved: List[Tuple[int, NodeID]] = []
+        ok = True
+        for i, nid in enumerate(assignment):
+            if nid == self.local_node.node_id:
+                node = self.local_node
+                with self.lock:
+                    if node.resources.can_fit(pg.bundles[i]):
+                        node.resources.allocate(pg.bundles[i])
+                        node.bundles[(pg.pg_id, i)] = NodeResources(
+                            pg.bundles[i])
+                        reserved.append((i, nid))
+                    else:
+                        ok = False
+                        break
+            else:
+                with self._view_lock:
+                    addr = self._addr_by_node.get(nid.binary())
+                if addr is None:
+                    ok = False
+                    break
+                req = pb.BundleRequest(pg_id=pg.pg_id.binary(),
+                                       bundle_index=i)
+                for k, v in pg.bundles[i].to_dict().items():
+                    req.resources.amounts[k] = v
+                try:
+                    env = self.pool.get(addr).call(
+                        pb.RESERVE_BUNDLE, req.SerializeToString(), timeout=30)
+                    rep = pb.BundleReply()
+                    rep.ParseFromString(env.body)
+                    if rep.ok:
+                        reserved.append((i, nid))
+                    else:
+                        ok = False
+                        break
+                except (RpcConnectionError, TimeoutError, RpcRemoteError):
+                    ok = False
+                    break
+        if ok:
+            return True
+        # Rollback.
+        for i, nid in reserved:
+            self._free_bundle(pg, i, nid)
+        return False
+
+    def _free_bundle(self, pg, index: int, nid: NodeID):
+        if nid == self.local_node.node_id:
+            node = self.local_node
+            if node.bundles.pop((pg.pg_id, index), None) is not None:
+                node.resources.release(pg.bundles[index])
+            return
+        with self._view_lock:
+            addr = self._addr_by_node.get(nid.binary())
+        if addr is None:
+            return
+        try:
+            self.pool.get(addr).call(
+                pb.FREE_BUNDLE, pb.BundleRequest(
+                    pg_id=pg.pg_id.binary(),
+                    bundle_index=index).SerializeToString(), timeout=30)
+        except (RpcConnectionError, TimeoutError, RpcRemoteError):
+            pass
+
+    def remove_placement_group(self, pg_id: PlacementGroupID):
+        with self.lock:
+            pg = self.placement_groups.pop(pg_id, None)
+        if pg is None:
+            return
+        if pg.bundle_nodes:
+            for i, nid in enumerate(pg.bundle_nodes):
+                self._free_bundle(pg, i, nid)
+        try:
+            self.state.remove_pg(pg_id.binary())
+        except Exception:
+            pass
+        self._kick()
+
+    def _register_pg_info(self, pg):
+        info = pb.PgInfo(pg_id=pg.pg_id.binary(), name=pg.name or "",
+                         strategy=pg.strategy, state=pg.state,
+                         creator_job=self.job_id.binary())
+        for b in pg.bundles:
+            rb = info.bundles.add()
+            for k, v in b.to_dict().items():
+                rb.amounts[k] = v
+        for nid in (pg.bundle_nodes or []):
+            info.bundle_nodes.append(nid.binary())
+        try:
+            self.state.register_pg(info)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------ inbound handler
+
+    def _handle_rpc(self, ctx: RpcContext):
+        method = ctx.method
+        if method == pb.PING:
+            ctx.reply(pb.PingReply(
+                node_id=self.local_node.node_id.binary(),
+                time_ms=time.time() * 1e3).SerializeToString())
+        elif method == pb.PUSH_TASK:
+            self._handle_push_task(ctx)
+        elif method == pb.ACTOR_CALL:
+            self._handle_actor_call(ctx)
+        elif method == pb.CREATE_ACTOR:
+            self._handle_create_actor(ctx)
+        elif method == pb.KILL_ACTOR:
+            req = pb.KillActorRequest()
+            req.ParseFromString(ctx.body)
+            super().kill_actor(ActorID(req.actor_id),
+                               no_restart=req.no_restart)
+            ctx.reply()
+        elif method == pb.CANCEL_TASK:
+            req = pb.CancelTaskRequest()
+            req.ParseFromString(ctx.body)
+            self.cancel_task(TaskID(req.task_id), force=req.force)
+            ctx.reply()
+        elif method == pb.FETCH_OBJECT:
+            self._handle_fetch_object(ctx)
+        elif method == pb.RESERVE_BUNDLE:
+            req = pb.BundleRequest()
+            req.ParseFromString(ctx.body)
+            resources = ResourceSet(dict(req.resources.amounts))
+            pg_id = PlacementGroupID(req.pg_id)
+            with self.lock:
+                node = self.local_node
+                if node.resources.can_fit(resources):
+                    node.resources.allocate(resources)
+                    node.bundles[(pg_id, req.bundle_index)] = NodeResources(
+                        resources)
+                    ok = True
+                else:
+                    ok = False
+            ctx.reply(pb.BundleReply(ok=ok).SerializeToString())
+            self._kick()
+        elif method == pb.FREE_BUNDLE:
+            req = pb.BundleRequest()
+            req.ParseFromString(ctx.body)
+            pg_id = PlacementGroupID(req.pg_id)
+            with self.lock:
+                node = self.local_node
+                target = node.bundles.pop((pg_id, req.bundle_index), None)
+                if target is not None:
+                    node.resources.release(target.total)
+            ctx.reply(pb.BundleReply(ok=True).SerializeToString())
+            self._kick()
+        elif method == pb.ADD_BORROW:
+            req = pb.BorrowRequest()
+            req.ParseFromString(ctx.body)
+            self.reference_counter.add_borrow(ObjectID(req.object_id),
+                                              req.borrower)
+            ctx.reply()
+        elif method == pb.REMOVE_BORROW:
+            req = pb.BorrowRequest()
+            req.ParseFromString(ctx.body)
+            self.reference_counter.remove_borrow(ObjectID(req.object_id),
+                                                 req.borrower)
+            ctx.reply()
+        elif method == pb.RELEASE_PIN:
+            req = pb.FreeObjectRequest()
+            req.ParseFromString(ctx.body)
+            self.reference_counter.unpin_for_task(ObjectID(req.object_id))
+            ctx.reply()
+        elif method == pb.FREE_OBJECT:
+            req = pb.FreeObjectRequest()
+            req.ParseFromString(ctx.body)
+            oid = ObjectID(req.object_id)
+            self.local_node.store.free(oid)
+            with self.lock:
+                self.object_locations.pop(oid, None)
+            ctx.reply()
+        elif method == pb.WAIT_OBJECT:
+            req = pb.WaitObjectRequest()
+            req.ParseFromString(ctx.body)
+            oid = ObjectID(req.object_id)
+            deadline = time.monotonic() + req.timeout_ms / 1e3
+            ready = False
+            while time.monotonic() < deadline:
+                if self.local_node.store.contains(oid):
+                    ready = True
+                    break
+                time.sleep(0.005)
+            ctx.reply(pb.WaitObjectReply(ready=ready).SerializeToString())
+        elif method == pb.DRAIN:
+            ctx.reply()
+            threading.Thread(target=self.shutdown, daemon=True).start()
+        else:
+            ctx.reply_error(f"unhandled method {method}")
+
+    def _msg_to_spec(self, msg: pb.TaskSpecMsg) -> TaskSpec:
+        args, kwargs = cloudpickle.loads(msg.args_pickle)
+        retry_exceptions: Any = False
+        if msg.retry_exceptions_pickle:
+            retry_exceptions = cloudpickle.loads(msg.retry_exceptions_pickle)
+        runtime_env = (json.loads(msg.runtime_env_json.decode())
+                       if msg.runtime_env_json else None)
+        options = TaskOptions(
+            num_returns=msg.num_returns,
+            resources=ResourceSet(dict(msg.resources.amounts)),
+            max_retries=msg.max_retries,
+            retry_exceptions=retry_exceptions,
+            runtime_env=runtime_env,
+            name=msg.name or None,
+        )
+        spec = TaskSpec(
+            task_id=TaskID(msg.task_id), job_id=JobID(msg.job_id),
+            function=None, function_name=msg.function_name,
+            args=args, kwargs=kwargs, options=options,
+            return_ids=tuple(ObjectID(r) for r in msg.return_ids),
+            attempt=msg.attempt)
+        if msg.actor_id:
+            spec.actor_id = ActorID(msg.actor_id)
+            spec.method_name = msg.method_name
+        else:
+            spec.function = self._load_callable(bytes(msg.fn_hash))
+        if msg.pg_id:
+            spec._dist_pg = (PlacementGroupID(msg.pg_id), msg.pg_bundle_index)
+        return spec
+
+    def _admission_check(self, resources: ResourceSet) -> bool:
+        """Could this request EVER fit here (totals, not availability)?"""
+        return resources.is_subset_of(self.local_node.resources.total)
+
+    def _spillback_reply(self, ctx: RpcContext):
+        rep = pb.PushTaskReply(status="spillback")
+        for k, v in self.local_node.resources.available.to_dict().items():
+            rep.available.amounts[k] = v
+        ctx.reply(rep.SerializeToString())
+
+    def _handle_push_task(self, ctx: RpcContext):
+        msg = pb.TaskSpecMsg()
+        msg.ParseFromString(ctx.body)
+        try:
+            spec = self._msg_to_spec(msg)
+        except Exception as e:  # noqa: BLE001 — deserialization failure
+            rep = pb.PushTaskReply(status="ok",
+                                   error_pickle=pickle.dumps(
+                                       exc.RayTpuError(
+                                           f"task deserialization failed: "
+                                           f"{type(e).__name__}: {e}")))
+            ctx.reply(rep.SerializeToString())
+            return
+        if not self._admission_check(spec.options.resources):
+            self._spillback_reply(ctx)
+            return
+        self.completion_hooks[spec.task_id] = (
+            lambda s: self._reply_task_outcome(ctx, s))
+        # Force local execution (the caller placed it here).
+        spec.options.scheduling_strategy = "DEFAULT"
+        spec.options.placement_group = None
+        self.submit_task(spec)
+
+    def _handle_actor_call(self, ctx: RpcContext):
+        msg = pb.TaskSpecMsg()
+        msg.ParseFromString(ctx.body)
+        try:
+            spec = self._msg_to_spec(msg)
+        except Exception as e:  # noqa: BLE001
+            rep = pb.PushTaskReply(status="ok", error_pickle=pickle.dumps(
+                exc.RayTpuError(f"actor call deserialization failed: {e}")))
+            ctx.reply(rep.SerializeToString())
+            return
+        self.completion_hooks[spec.task_id] = (
+            lambda s: self._reply_task_outcome(ctx, s))
+        Runtime.submit_actor_task(self, spec.actor_id, spec)
+
+    def _reply_task_outcome(self, ctx: RpcContext, spec: TaskSpec):
+        """Completion hook: turn sealed local results into a PushTaskReply."""
+        rep = pb.PushTaskReply(status="ok")
+        store = self.local_node.store
+        err: Optional[BaseException] = None
+        for rid in spec.return_ids:
+            e = store.peek_error(rid)
+            if e is not None:
+                err = e
+                break
+        if err is not None:
+            try:
+                rep.error_pickle = cloudpickle.dumps(err)
+            except Exception:
+                rep.error_pickle = cloudpickle.dumps(
+                    exc.RayTpuError(f"unpicklable error: {err!r}"))
+            # Error consumed by the caller; free local copies.
+            for rid in spec.return_ids:
+                store.free(rid)
+        else:
+            for rid in spec.return_ids:
+                payload: Optional[bytes] = None
+                try:
+                    value = store.get(rid, timeout=0)
+                    payload = cloudpickle.dumps(value)
+                except Exception:
+                    payload = None
+                if payload is not None and len(payload) <= INLINE_RESULT_MAX:
+                    rep.inline.append(True)
+                    rep.inline_results.append(payload)
+                    store.free(rid)
+                    with self.lock:
+                        self.object_locations.pop(rid, None)
+                else:
+                    rep.inline.append(False)
+                    rep.inline_results.append(b"")
+                    # Keep + advertise for remote fetch; the caller owns the
+                    # ref lifetime, we hold the primary copy.
+                    try:
+                        self.state.add_location(
+                            rid.binary(), self.local_node.node_id.binary())
+                    except Exception:
+                        pass
+        ctx.reply(rep.SerializeToString())
+
+    def _handle_create_actor(self, ctx: RpcContext):
+        msg = pb.ActorSpecMsg()
+        msg.ParseFromString(ctx.body)
+        try:
+            cls = self._load_callable(bytes(msg.cls_hash))
+            args, kwargs = cloudpickle.loads(msg.args_pickle)
+            options = cloudpickle.loads(msg.options_pickle)
+        except Exception as e:  # noqa: BLE001
+            ctx.reply(pb.CreateActorReply(
+                status="error", error_pickle=pickle.dumps(
+                    exc.ActorDiedError(
+                        f"actor deserialization failed: {e}"))
+            ).SerializeToString())
+            return
+        request = options.resources
+        if not request.is_subset_of(self.local_node.resources.total):
+            rep = pb.CreateActorReply(status="spillback")
+            for k, v in self.local_node.resources.available.to_dict().items():
+                rep.available.amounts[k] = v
+            ctx.reply(rep.SerializeToString())
+            return
+        state = ActorState(ActorID(msg.actor_id), cls, args, kwargs, options,
+                           None, msg.namespace)  # name registered by creator
+        state.restart_count = msg.restart_count
+        with self.lock:
+            self.actors[state.actor_id] = state
+        node = self.local_node
+        deadline = time.monotonic() + _config.get("worker_lease_timeout_s")
+        while True:
+            with self.lock:
+                if node.resources.can_fit(request):
+                    node.resources.allocate(request)
+                    break
+            if time.monotonic() > deadline:
+                rep = pb.CreateActorReply(status="spillback")
+                for k, v in node.resources.available.to_dict().items():
+                    rep.available.amounts[k] = v
+                ctx.reply(rep.SerializeToString())
+                return
+            time.sleep(0.02)
+        state.node_id = node.node_id
+        state.devices = self._assign_devices(request, node)
+        self._start_actor_on_node(state, node, request)
+        state.ready.wait(timeout=_config.get("worker_lease_timeout_s"))
+        if state.status == ActorState.DEAD:
+            ctx.reply(pb.CreateActorReply(
+                status="error", error_pickle=pickle.dumps(
+                    state.death_cause or exc.ActorDiedError("init failed"))
+            ).SerializeToString())
+            return
+        ctx.reply(pb.CreateActorReply(status="ok").SerializeToString())
+
+    def _serialized_for_fetch(self, oid: ObjectID) -> Optional[bytes]:
+        """Serialize once per object for chunked pulls (small MRU cache so a
+        multi-chunk fetch doesn't re-pickle per chunk)."""
+        with self._fetch_cache_lock:
+            hit = self._fetch_cache.get(oid)
+            if hit is not None:
+                return hit
+        value = self.local_node.store.get(oid, timeout=0)
+        payload = cloudpickle.dumps(value)
+        with self._fetch_cache_lock:
+            self._fetch_cache[oid] = payload
+            while len(self._fetch_cache) > 8:
+                self._fetch_cache.pop(next(iter(self._fetch_cache)))
+        return payload
+
+    def _handle_fetch_object(self, ctx: RpcContext):
+        req = pb.FetchObjectRequest()
+        req.ParseFromString(ctx.body)
+        oid = ObjectID(req.object_id)
+        store = self.local_node.store
+        rep = pb.FetchObjectReply()
+        if not store.contains(oid):
+            rep.found = False
+            ctx.reply(rep.SerializeToString())
+            return
+        err = store.peek_error(oid)
+        if err is not None:
+            rep.found = True
+            try:
+                rep.error_pickle = cloudpickle.dumps(err)
+            except Exception:
+                rep.error_pickle = cloudpickle.dumps(
+                    exc.RayTpuError(f"unpicklable error: {err!r}"))
+            ctx.reply(rep.SerializeToString())
+            return
+        try:
+            payload = self._serialized_for_fetch(oid)
+        except Exception:  # noqa: BLE001 — freed underneath us
+            rep.found = False
+            ctx.reply(rep.SerializeToString())
+            return
+        rep.found = True
+        rep.total_size = len(payload)
+        end = min(len(payload), req.offset + (req.max_bytes or FETCH_CHUNK))
+        rep.data = payload[req.offset:end]
+        rep.eof = end >= len(payload)
+        ctx.reply(rep.SerializeToString())
+
+
+_FETCH_MISS = object()

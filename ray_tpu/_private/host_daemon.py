@@ -1,0 +1,95 @@
+"""Per-host runtime daemon: ``python -m ray_tpu._private.host_daemon``.
+
+The raylet-equivalent process (``src/ray/raylet/main.cc:309``), except the
+worker pool is threads inside this same process because a TPU host's
+devices are owned by exactly one process (libtpu single-owner): this daemon
+IS the device owner, the executor, and the per-host object store in one.
+It registers with the state service, heartbeats, admits pushed tasks, and
+serves object fetches until drained or its state-service connection is
+irrecoverably lost.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import signal
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="ray_tpu host daemon")
+    parser.add_argument("--state-addr", required=True,
+                        help="host:port of the state service")
+    parser.add_argument("--num-cpus", type=float, default=None)
+    parser.add_argument("--num-tpus", type=float, default=None)
+    parser.add_argument("--resources", type=str, default="{}",
+                        help="JSON dict of custom resources")
+    parser.add_argument("--labels", type=str, default="{}")
+    parser.add_argument("--listen-host", type=str, default="127.0.0.1")
+    parser.add_argument("--heartbeat-interval-s", type=float, default=1.0)
+    parser.add_argument("--ready-file", type=str, default="",
+                        help="write our RPC address here once serving")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
+        format="[daemon %(asctime)s] %(levelname)s %(message)s")
+
+    from ray_tpu._private import worker as _worker
+    from ray_tpu._private.distributed import DistributedRuntime
+    from ray_tpu._private.resources import CPU, TPU, ResourceSet
+    from ray_tpu._private.worker import _detect_num_tpus
+
+    amounts = {CPU: args.num_cpus if args.num_cpus is not None
+               else float(os.cpu_count() or 1)}
+    n_tpus = (args.num_tpus if args.num_tpus is not None
+              else _detect_num_tpus())
+    if n_tpus:
+        amounts[TPU] = n_tpus
+    amounts.update(json.loads(args.resources))
+
+    runtime = DistributedRuntime(
+        state_addr=args.state_addr, resources=ResourceSet(amounts),
+        is_driver=False, listen_host=args.listen_host,
+        labels=json.loads(args.labels),
+        heartbeat_interval_s=args.heartbeat_interval_s)
+
+    # Install as the process-global worker so tasks executing here can call
+    # ray_tpu.get/put/remote/etc. (the driver-API-inside-worker contract).
+    with _worker._global_lock:
+        _worker._global = _worker.Worker(runtime, "default")
+
+    stop = {"flag": False}
+
+    def _on_signal(signum, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+
+    if args.ready_file:
+        tmp = args.ready_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(runtime.address + "\n")
+        os.replace(tmp, args.ready_file)
+    logging.info("host daemon %s serving at %s (resources %s)",
+                 runtime.local_node.node_id.hex()[:8], runtime.address,
+                 amounts)
+
+    try:
+        while not stop["flag"] and not runtime._hb_stop.is_set():
+            time.sleep(0.2)
+    finally:
+        try:
+            runtime.shutdown()
+        except Exception:
+            logging.exception("daemon shutdown error")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

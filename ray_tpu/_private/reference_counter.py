@@ -23,6 +23,10 @@ class ReferenceCounter:
         self._lock = threading.Lock()
         self._local_refs: Dict[ObjectID, int] = {}
         self._pins: Dict[ObjectID, int] = {}  # in-flight task arg pins
+        # Cross-process borrows: oid -> {borrower address -> count}. The
+        # owner holds the value while any borrower process retains a
+        # deserialized handle (reference_count.h:61 borrower bookkeeping).
+        self._borrows: Dict[ObjectID, Dict[str, int]] = {}
         self._on_zero = on_zero
 
     def set_on_zero(self, cb: Callable[[ObjectID], None]):
@@ -62,14 +66,56 @@ class ReferenceCounter:
         if cb is not None:
             cb(oid)
 
+    def add_borrow(self, oid: ObjectID, borrower: str):
+        with self._lock:
+            per = self._borrows.setdefault(oid, {})
+            per[borrower] = per.get(borrower, 0) + 1
+
+    def remove_borrow(self, oid: ObjectID, borrower: str):
+        cb = None
+        with self._lock:
+            per = self._borrows.get(oid)
+            if per is not None:
+                n = per.get(borrower, 0) - 1
+                if n > 0:
+                    per[borrower] = n
+                else:
+                    per.pop(borrower, None)
+                if not per:
+                    self._borrows.pop(oid, None)
+            if (self._borrows.get(oid) is None
+                    and self._local_refs.get(oid, 0) == 0
+                    and self._pins.get(oid, 0) == 0):
+                cb = self._on_zero
+        if cb is not None:
+            cb(oid)
+
+    def remove_borrower(self, borrower: str):
+        """A borrower process died: drop every borrow it held."""
+        zeroed = []
+        with self._lock:
+            for oid in list(self._borrows):
+                per = self._borrows[oid]
+                if per.pop(borrower, None) is not None and not per:
+                    self._borrows.pop(oid, None)
+                    if (self._local_refs.get(oid, 0) == 0
+                            and self._pins.get(oid, 0) == 0):
+                        zeroed.append(oid)
+        if self._on_zero is not None:
+            for oid in zeroed:
+                self._on_zero(oid)
+
     def has_refs(self, oid: ObjectID) -> bool:
         with self._lock:
-            return self._local_refs.get(oid, 0) > 0 or self._pins.get(oid, 0) > 0
+            return (self._local_refs.get(oid, 0) > 0
+                    or self._pins.get(oid, 0) > 0
+                    or bool(self._borrows.get(oid)))
 
     def count(self, oid: ObjectID) -> int:
         with self._lock:
-            return self._local_refs.get(oid, 0) + self._pins.get(oid, 0)
+            return (self._local_refs.get(oid, 0) + self._pins.get(oid, 0)
+                    + sum(self._borrows.get(oid, {}).values()))
 
     def live_objects(self) -> Set[ObjectID]:
         with self._lock:
-            return set(self._local_refs) | set(self._pins)
+            return set(self._local_refs) | set(self._pins) | set(self._borrows)

@@ -62,11 +62,12 @@ def frame_bytes(env: pb.Envelope) -> bytes:
 
 
 class _Pending:
-    __slots__ = ("event", "env")
+    __slots__ = ("event", "env", "callback")
 
     def __init__(self):
         self.event = threading.Event()
         self.env: Optional[pb.Envelope] = None
+        self.callback = None
 
 
 class RpcClient:
@@ -271,8 +272,15 @@ class RpcServer:
     thread — that is how task pushes defer their reply to completion)."""
 
     def __init__(self, handler: Handler, host: str = "127.0.0.1",
-                 port: int = 0, max_workers: int = 64):
+                 port: int = 0, max_workers: int = 64,
+                 inline_methods: Optional[set] = None):
         self._handler = handler
+        # Methods handled synchronously on the connection's reader thread:
+        # cheap enqueue-style handlers that need per-connection ordering
+        # (actor mailbox inserts — the reference's actor sequencing queues,
+        # transport/actor_scheduling_queue.cc). Everything else runs in the
+        # worker pool.
+        self._inline = inline_methods or set()
         self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._lsock.bind((host, port))
@@ -333,7 +341,10 @@ class RpcServer:
                 env = read_frame(sock)
                 ctx = RpcContext(self, sock, wlock, env)
                 ctx.conn_id = conn_id
-                self._pool.submit(self._run_handler, ctx)
+                if env.method in self._inline:
+                    self._run_handler(ctx)
+                else:
+                    self._pool.submit(self._run_handler, ctx)
         except Exception:  # noqa: BLE001 — normal disconnect path
             pass
         finally:

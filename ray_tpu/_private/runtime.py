@@ -218,6 +218,10 @@ class Runtime:
         self._pending: List[dict] = []
         self._pending_cv = threading.Condition()
         self._dispatch_dirty = False  # kick arrived while loop was busy
+        # Per-task completion hooks, fired once when a task reaches a final
+        # state (FINISHED/FAILED/CANCELLED, not retries). The host daemon
+        # uses these to turn local completions into RPC replies.
+        self.completion_hooks: Dict[TaskID, Callable[[TaskSpec], None]] = {}
         self.autoscaling_enabled = False  # set by StandardAutoscaler
         self._util_pool = ThreadPoolExecutor(max_workers=32,
                                              thread_name_prefix="rt-util")
@@ -434,6 +438,7 @@ class Runtime:
                     self._unpin_args(spec)
                     with self.lock:
                         self.task_states[spec.task_id] = "FAILED"
+                    self._fire_completion(spec)
                     continue
                 except Exception as e:  # defensive: never kill the dispatcher
                     spec = item["spec"]
@@ -444,6 +449,7 @@ class Runtime:
                     self._unpin_args(spec)
                     with self.lock:
                         self.task_states[spec.task_id] = "FAILED"
+                    self._fire_completion(spec)
                     continue
                 if action == "wait":
                     still_waiting.append(item)
@@ -493,6 +499,9 @@ class Runtime:
                 self.seal_error(rid, exc.TaskCancelledError(spec.task_id),
                                 self.head_node)
             self._unpin_args(spec)
+            with self.lock:
+                self.task_states[spec.task_id] = "CANCELLED"
+            self._fire_completion(spec)
             return "done"
         if not self._deps_ready(spec):
             return "wait"
@@ -502,6 +511,9 @@ class Runtime:
             for rid in spec.return_ids:
                 self.seal_error(rid, err, self.head_node)
             self._unpin_args(spec)
+            with self.lock:
+                self.task_states[spec.task_id] = "FAILED"
+            self._fire_completion(spec)
             return "done"
         node_id = self._select_node(spec)
         if node_id is None:
@@ -650,6 +662,7 @@ class Runtime:
                            args={"task_id": spec.task_id.hex()})
             (ctx.node_id, ctx.task_id, ctx.job_id, ctx.put_counter,
              ctx.devices, ctx.cancel_flag, ctx.placement_group) = prev
+            self._fire_completion(spec)
             self._kick()
 
     def _seal_results(self, spec: TaskSpec, node: Node, result: Any):
@@ -693,6 +706,29 @@ class Runtime:
     def _unpin_args(self, spec: TaskSpec):
         for oid in _ref_ids_in(spec.args, spec.kwargs):
             self.reference_counter.unpin_for_task(oid)
+
+    def _fire_completion(self, spec: TaskSpec):
+        """Invoke the task's completion hook iff it reached a final state."""
+        with self.lock:
+            state = self.task_states.get(spec.task_id)
+            if state not in ("FINISHED", "FAILED", "CANCELLED"):
+                return
+            hook = self.completion_hooks.pop(spec.task_id, None)
+        if hook is not None:
+            try:
+                hook(spec)
+            except Exception:
+                logger.exception("completion hook failed for %s",
+                                 spec.function_name)
+
+    def reduce_ref(self, oid: ObjectID):
+        """Pickle-reduction for an ObjectRef owned by this runtime.
+        In-process semantics: pin until the deserializer re-binds
+        (see ObjectRef.__reduce__); the distributed runtime overrides this
+        with the cross-process borrowing protocol."""
+        from ray_tpu.object_ref import _deserialize_borrowed_ref
+        self.reference_counter.pin_for_task(oid)
+        return (_deserialize_borrowed_ref, (oid.binary(),))
 
     def _current_or_head_node(self) -> Node:
         nid = task_context.node_id
@@ -858,6 +894,7 @@ class Runtime:
                     "actor_task", pid=f"node:{node.node_id.hex()[:8]}",
                     start_s=time.time() - dur, dur_s=dur,
                     args={"actor_id": state.actor_id.hex()})
+                self._fire_completion(spec)
                 self._kick()
 
     def _run_async_actor_loop(self, state: ActorState, max_concurrency: int):
@@ -895,6 +932,7 @@ class Runtime:
                         self.task_states[spec.task_id] = "FAILED"
                 finally:
                     self._unpin_args(spec)
+                    self._fire_completion(spec)
                     self._kick()
 
         async def _pump():
@@ -927,6 +965,9 @@ class Runtime:
             err = exc.ActorDiedError(f"actor {actor_id} is dead: {cause}")
             for rid in spec.return_ids:
                 self.seal_error(rid, err, self._current_or_head_node())
+            with self.lock:
+                self.task_states[spec.task_id] = "FAILED"
+            self._fire_completion(spec)
             return list(spec.return_ids)
         for oid in _ref_ids_in(spec.args, spec.kwargs):
             self.reference_counter.pin_for_task(oid)
@@ -968,6 +1009,9 @@ class Runtime:
             for rid in spec.return_ids:
                 self.seal_error(rid, exc.ActorDiedError(str(cause)), node)
             self._unpin_args(spec)
+            with self.lock:
+                self.task_states[spec.task_id] = "FAILED"
+            self._fire_completion(spec)
         state.mailbox.put(None)  # wake consumers so threads exit
         self._release_actor_allocation(state)
         with self.lock:

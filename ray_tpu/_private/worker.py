@@ -46,10 +46,19 @@ def init(num_cpus: Optional[float] = None,
          ignore_reinit_error: bool = False,
          include_dashboard: bool = False,
          dashboard_port: int = 0,
+         address: Optional[str] = None,
          _system_config: Optional[dict] = None,
          _create_default_node: bool = True,
          **kwargs) -> "Worker":
-    """Start the runtime (one device-owner process per host)."""
+    """Start the runtime (one device-owner process per host).
+
+    ``address="host:port"`` connects this process as a driver to an
+    existing cluster's state service (the reference's
+    ``ray.init(address=...)`` path, ``worker.py:1003``): tasks and actors
+    are then scheduled across the cluster's host daemons. The driver's own
+    node contributes no resources unless ``num_cpus``/``num_tpus`` are
+    passed explicitly.
+    """
     global _global
     with _global_lock:
         if _global is not None:
@@ -58,6 +67,20 @@ def init(num_cpus: Optional[float] = None,
             raise RuntimeError("ray_tpu.init() called twice; pass "
                                "ignore_reinit_error=True to ignore")
         _config.apply_system_config(_system_config)
+        if address is not None:
+            from ray_tpu._private.distributed import DistributedRuntime
+            amounts: Dict[str, float] = {}
+            if num_cpus:
+                amounts[CPU] = num_cpus
+            if num_tpus:
+                amounts[TPU] = num_tpus
+            if resources:
+                amounts.update(resources)
+            runtime = DistributedRuntime(
+                state_addr=address, resources=ResourceSet(amounts),
+                is_driver=True, namespace=namespace or "default")
+            _global = Worker(runtime, namespace or "default")
+            return _global
         runtime = Runtime()
         if _create_default_node:
             amounts: Dict[str, float] = {

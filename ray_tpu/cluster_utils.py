@@ -47,3 +47,102 @@ class Cluster:
 
     def shutdown(self):
         _worker.shutdown()
+
+
+class ProcessCluster:
+    """Real multi-process cluster for tests: one C++ state-service process
+    plus N host-daemon processes, each a separate OS process speaking the
+    wire protocol — the process-level analogue the reference gets from
+    ``Cluster`` starting real raylets (``python/ray/cluster_utils.py:99``).
+
+    Usage::
+
+        cluster = ProcessCluster(num_daemons=2, num_cpus=2)
+        ray_tpu.init(address=cluster.address)
+        ...
+        cluster.kill_daemon(0)      # chaos: SIGKILL a host
+        cluster.shutdown()
+    """
+
+    def __init__(self, num_daemons: int = 2, num_cpus: float = 2,
+                 resources: Optional[Dict[str, float]] = None,
+                 data_dir: str = "", heartbeat_timeout_ms: float = 3000,
+                 daemon_heartbeat_s: float = 0.5):
+        import subprocess
+        import sys
+        import tempfile
+        import time as _time
+        from ray_tpu._private.state_client import start_state_service
+        self._subprocess = subprocess
+        self.state_proc, self.address = start_state_service(
+            data_dir=data_dir, heartbeat_timeout_ms=heartbeat_timeout_ms)
+        self.daemons = []
+        self._daemon_args = dict(num_cpus=num_cpus,
+                                 resources=resources or {},
+                                 heartbeat_s=daemon_heartbeat_s)
+        for _ in range(num_daemons):
+            self.add_daemon()
+
+    def add_daemon(self, num_cpus: Optional[float] = None,
+                   resources: Optional[Dict[str, float]] = None,
+                   num_tpus: float = 0):
+        import json
+        import subprocess
+        import sys
+        import tempfile
+        import time as _time
+        ready = tempfile.mktemp(prefix="raytpu_daemon_ready_")
+        cmd = [sys.executable, "-m", "ray_tpu._private.host_daemon",
+               "--state-addr", self.address,
+               "--num-cpus", str(num_cpus if num_cpus is not None
+                                 else self._daemon_args["num_cpus"]),
+               "--num-tpus", str(num_tpus),
+               "--resources", json.dumps(
+                   resources or self._daemon_args["resources"]),
+               "--heartbeat-interval-s",
+               str(self._daemon_args["heartbeat_s"]),
+               "--ready-file", ready]
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")  # daemons in tests stay CPU
+        proc = subprocess.Popen(cmd, env=env)
+        deadline = _time.monotonic() + 60
+        addr = None
+        while _time.monotonic() < deadline:
+            if os.path.exists(ready):
+                with open(ready) as f:
+                    addr = f.read().strip()
+                os.unlink(ready)
+                break
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"daemon exited rc={proc.returncode} during startup")
+            _time.sleep(0.02)
+        if addr is None:
+            proc.kill()
+            raise TimeoutError("daemon did not become ready")
+        self.daemons.append({"proc": proc, "address": addr})
+        return addr
+
+    def kill_daemon(self, index: int):
+        """SIGKILL a host daemon (chaos testing — no graceful teardown)."""
+        import signal as _signal
+        d = self.daemons[index]
+        if d["proc"].poll() is None:
+            d["proc"].send_signal(_signal.SIGKILL)
+            d["proc"].wait(timeout=10)
+
+    def shutdown(self):
+        for d in self.daemons:
+            if d["proc"].poll() is None:
+                d["proc"].terminate()
+        for d in self.daemons:
+            try:
+                d["proc"].wait(timeout=10)
+            except Exception:
+                d["proc"].kill()
+        if self.state_proc.poll() is None:
+            self.state_proc.terminate()
+            try:
+                self.state_proc.wait(timeout=10)
+            except Exception:
+                self.state_proc.kill()

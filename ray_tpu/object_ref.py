@@ -65,13 +65,13 @@ class ObjectRef:
         return f"ObjectRef({self._id.hex()[:16]})"
 
     def __reduce__(self):
-        # Borrowing protocol (reference_count.h:61), host-granular reduction:
-        # serializing a ref pins the object until the deserializer re-binds
-        # and takes its own local ref, so a value can't be freed while a
-        # serialized handle to it is in flight.
+        # Borrowing protocol (reference_count.h:61): the owning runtime
+        # decides the reduction. In-process it pins until the deserializer
+        # re-binds; the distributed runtime instead emits a marker carrying
+        # owner/sender addresses so the deserializer can register a borrow
+        # with the owner (see DistributedRuntime.reduce_ref).
         if self._owner is not None:
-            self._owner.reference_counter.pin_for_task(self._id)
-            return (_deserialize_borrowed_ref, (self._id.binary(),))
+            return self._owner.reduce_ref(self._id)
         return (_deserialize_ref, (self._id.binary(),))
 
     def __del__(self):
