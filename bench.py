@@ -1,14 +1,27 @@
-"""Headline benchmark: ResNet-18 training throughput per chip.
+"""Headline benchmark: ResNet-50 training throughput per chip, with MFU.
 
-Mirrors the reference's GPU image-training benchmark
-(``doc/source/ray-air/benchmarks.rst:163-174``: torchvision ResNet-18,
-746.29 images/sec across 16 T4 workers = 46.64 images/sec/chip) on one TPU
-chip. Synthetic 224x224 data (the reference benchmark is also
-data-loader-free compute measurement at this granularity), bfloat16, full
-fwd+bwd+SGD step, steps chained inside one jit scan so dispatch overhead is
-amortized (required under the axon relay).
+North-star image benchmark against the reference's GPU image-training
+numbers (``doc/source/ray-air/benchmarks.rst:163-174``: torchvision
+ResNet-18, 746.29 images/sec across 16 T4 workers = 46.64 images/sec/chip).
+We run the *bigger* ResNet-50 (~2.4x the FLOPs of ResNet-18) and still
+compare per-chip against that number, so ``vs_baseline`` is conservative.
 
-Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Model FLOP utilization (``mfu_pct``) is computed from XLA's own cost
+analysis of the compiled step (falling back to analytic FLOP counts) over
+the detected chip's peak bf16 throughput — the "is it actually fast"
+number the reference never reports.
+
+Extras carried in the same JSON line:
+- ``transformer_tokens_per_sec`` (+ its MFU): decoder LM train step on the
+  flagship transformer (the ``__graft_entry__`` model family).
+- ``resnet18_images_per_sec``: continuity with rounds 1-3.
+
+Synthetic data (the reference benchmark is also data-loader-free at this
+granularity), bfloat16 compute, full fwd+bwd+optimizer step, steps chained
+inside one jit scan so dispatch overhead is amortized (required under the
+axon relay).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 import json
@@ -18,25 +31,76 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from ray_tpu.models import resnet
-
 BASELINE_IMAGES_PER_SEC_PER_CHIP = 746.29 / 16  # T4, benchmarks.rst:171-174
 
-BATCH = 256
-IMAGE = 224
 MEASURE_STEPS = 20
 
+# Peak dense bf16 FLOP/s per chip by device kind (public specs; the
+# jax-ml scaling-book hardware table).
+_PEAK_BF16 = (
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
 
-def main():
-    cfg = resnet.resnet18(num_classes=1000)
+
+def _chip_peak_flops():
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "") or ""
+    low = kind.lower()
+    if dev.platform == "tpu":
+        for tag, peak in _PEAK_BF16:
+            if tag in low:
+                return kind, peak
+    return kind, None
+
+
+def _compiled_flops(jitted, *args):
+    """Per-invocation FLOPs from XLA's cost analysis (None if unavailable)."""
+    try:
+        cost = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        return None
+
+
+def _timed_scan(step_fn, state, n_steps):
+    """jit a lax.scan of ``n_steps`` steps; returns (state, elapsed_s, flops).
+
+    Warmup runs the SAME step count so the measured call hits the compile
+    cache (a different scan length is a different program).
+    """
+    @jax.jit
+    def run(state, xs):
+        return jax.lax.scan(step_fn, state, xs)
+
+    xs = jnp.arange(n_steps)
+    flops = _compiled_flops(run, state, xs)
+    state, out = run(state, xs)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    state, out = run(state, xs)
+    jax.block_until_ready(out)
+    return state, time.perf_counter() - t0, flops
+
+
+def bench_resnet(cfg_name: str, batch: int):
+    from ray_tpu.models import resnet
+    cfg = getattr(resnet, cfg_name)(num_classes=1000)
     params = resnet.init_params(jax.random.PRNGKey(0), cfg)
     opt = optax.sgd(0.1, momentum=0.9)
     opt_state = opt.init(params)
-
-    key = jax.random.PRNGKey(1)
-    images = jax.random.normal(key, (BATCH, IMAGE, IMAGE, 3),
+    images = jax.random.normal(jax.random.PRNGKey(1), (batch, 224, 224, 3),
                                dtype=jnp.bfloat16)
-    labels = jax.random.randint(jax.random.PRNGKey(2), (BATCH,), 0, 1000)
+    labels = jax.random.randint(jax.random.PRNGKey(2), (batch,), 0, 1000)
 
     def one_step(state, _):
         params, opt_state = state
@@ -46,28 +110,78 @@ def main():
         params = optax.apply_updates(params, updates)
         return (params, opt_state), loss
 
-    @jax.jit
-    def run_steps(state, n_steps_arr):
-        return jax.lax.scan(one_step, state, n_steps_arr)
+    _, elapsed, flops = _timed_scan(one_step, (params, opt_state),
+                                    MEASURE_STEPS)
+    images_per_sec = batch * MEASURE_STEPS / elapsed
+    # Analytic fallback: ResNet-50 fwd ~= 4.09 GFLOP / image @224,
+    # ResNet-18 ~= 1.82; bwd ~= 2x fwd.
+    if flops is None:
+        per_image = {"resnet50": 4.09e9, "resnet18": 1.82e9}[cfg_name] * 3
+        flops = per_image * batch * MEASURE_STEPS
+    achieved = flops / elapsed
+    return images_per_sec, achieved
 
-    state = (params, opt_state)
-    # Warmup with the SAME step count so the measured call hits the compile
-    # cache (a different scan length is a different program).
-    state, losses = run_steps(state, jnp.arange(MEASURE_STEPS))
-    jax.block_until_ready(losses)
 
-    t0 = time.perf_counter()
-    state, losses = run_steps(state, jnp.arange(MEASURE_STEPS))
-    jax.block_until_ready(losses)
-    elapsed = time.perf_counter() - t0
+def bench_transformer():
+    """Decoder-LM train step on the flagship transformer: tokens/sec."""
+    from ray_tpu.models import transformer
+    from ray_tpu.models.transformer import TransformerConfig
 
-    images_per_sec = BATCH * MEASURE_STEPS / elapsed
+    batch, seq = 8, 1024
+    cfg = TransformerConfig(
+        vocab_size=32000, d_model=1024, n_layers=12, n_heads=16,
+        max_seq_len=seq, dtype=jnp.bfloat16,
+        use_flash=jax.default_backend() == "tpu")
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    n_params = transformer.num_params(params)
+    opt = optax.adamw(1e-3)
+    opt_state = opt.init(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1), 0,
+                                cfg.vocab_size)
+
+    def one_step(state, _):
+        params, opt_state = state
+        loss, grads = jax.value_and_grad(
+            lambda p: transformer.loss_fn(p, tokens, cfg))(params)
+        updates, opt_state = opt.update(grads, opt_state, params=params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state), loss
+
+    steps = 10
+    _, elapsed, flops = _timed_scan(one_step, (params, opt_state), steps)
+    tokens_per_sec = batch * seq * steps / elapsed
+    if flops is None:
+        flops = 6.0 * n_params * batch * seq * steps  # 2 fwd + 4 bwd
+    achieved = flops / elapsed
+    return tokens_per_sec, achieved, n_params
+
+
+def main():
+    kind, peak = _chip_peak_flops()
+
+    r50_ips, r50_flops = bench_resnet("resnet50", batch=128)
+    r18_ips, _ = bench_resnet("resnet18", batch=256)
+    lm_tps, lm_flops, lm_params = bench_transformer()
+
+    def mfu(achieved):
+        if peak is None or achieved is None:
+            return None
+        return round(100.0 * achieved / peak, 2)
+
     print(json.dumps({
-        "metric": "resnet18_train_images_per_sec_per_chip",
-        "value": round(images_per_sec, 2),
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(r50_ips, 2),
         "unit": "images/sec",
-        "vs_baseline": round(images_per_sec / BASELINE_IMAGES_PER_SEC_PER_CHIP,
-                             2),
+        "vs_baseline": round(r50_ips / BASELINE_IMAGES_PER_SEC_PER_CHIP, 2),
+        "mfu_pct": mfu(r50_flops),
+        "device_kind": kind,
+        "peak_bf16_tflops": None if peak is None else round(peak / 1e12, 1),
+        "extras": {
+            "resnet18_images_per_sec": round(r18_ips, 2),
+            "transformer_tokens_per_sec": round(lm_tps, 2),
+            "transformer_mfu_pct": mfu(lm_flops),
+            "transformer_params_m": round(lm_params / 1e6, 1),
+        },
     }))
 
 

@@ -437,8 +437,10 @@ class StateService {
       return ReplyError(fd, env, "bad RegisterNodeRequest");
     ApplyRegisterNode(req);
     Journal(raytpu::REGISTER_NODE, env.body());
+    // Publish the applied copy (alive=true, heartbeat stamped), not the
+    // raw request — subscribers cache this NodeInfo in their views.
     std::string info_bytes;
-    req.info().SerializeToString(&info_bytes);
+    nodes_[req.info().node_id()].SerializeToString(&info_bytes);
     Publish("nodes", "NODE_ADDED", info_bytes);
     raytpu::RegisterNodeReply rep;
     rep.set_server_time_ms(now_ms());
@@ -496,7 +498,21 @@ class StateService {
     std::string body;
     req.SerializeToString(&body);
     Journal(raytpu::MARK_NODE_DEAD, body);
-    Publish("nodes", "NODE_DEAD", body);
+    // Subscribers parse the event payload as NodeInfo (same shape as
+    // NODE_ADDED) so they get the dead node's address for addr-keyed
+    // cleanup, not just its id.
+    std::string info_bytes;
+    auto it = nodes_.find(node_id);
+    if (it != nodes_.end()) {
+      it->second.SerializeToString(&info_bytes);
+    } else {
+      raytpu::NodeInfo info;
+      info.set_node_id(node_id);
+      info.set_alive(false);
+      info.set_death_reason(reason);
+      info.SerializeToString(&info_bytes);
+    }
+    Publish("nodes", "NODE_DEAD", info_bytes);
     counters_["nodes_dead"]++;
   }
 

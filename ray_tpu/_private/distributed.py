@@ -46,6 +46,7 @@ import json
 import logging
 import os
 import pickle
+import queue
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -104,7 +105,9 @@ class _RemoteActorRecord:
         self.status = "ALIVE"
         self.restart_count = 0
         self.death_cause: Optional[BaseException] = None
-        self.lock = threading.Lock()
+        # RLock: a connection failure during a push made under this lock
+        # settles synchronously and re-enters via _handle_remote_actor_death.
+        self.lock = threading.RLock()
 
     @property
     def cls(self):
@@ -112,16 +115,18 @@ class _RemoteActorRecord:
 
 
 def _deserialize_dist_ref(id_bytes: bytes, owner_addr: str,
-                          sender_addr: str):
+                          sender_addr: str, managed: bool = False):
     """Unpickle hook for cross-process refs: register a borrow with the
-    owner, release the sender's serialize-time pin, bind locally."""
+    owner, bind locally. ``managed`` markers were produced by a task push;
+    their serialize-time pin is released by the PUSHER when the attempt
+    settles (so a receiver dying mid-deserialize cannot leak the pin);
+    unmanaged markers release it here via RELEASE_PIN."""
     from ray_tpu._private import worker as _worker
     from ray_tpu.object_ref import ObjectRef
     oid = ObjectID(id_bytes)
     runtime = _worker.try_global_runtime()
     if isinstance(runtime, DistributedRuntime):
-        runtime.register_incoming_ref(oid, owner_addr, sender_addr)
-        return ObjectRef(oid, owner=runtime)
+        runtime.register_incoming_ref(oid, owner_addr, sender_addr, managed)
     return ObjectRef(oid, owner=runtime)
 
 
@@ -166,12 +171,33 @@ class DistributedRuntime(Runtime):
         self._owner_addr: Dict[ObjectID, str] = {}  # oid -> owner address
         self._location_hints: Dict[ObjectID, str] = {}  # oid -> fetch addr
 
-        # Remote submission bookkeeping.
+        # Remote submission bookkeeping. In-flight pushes are keyed by
+        # (task_id, attempt) so a late reply or failure signal for a
+        # superseded attempt can never be confused with the current one
+        # (the reference keys TaskManager bookkeeping by attempt_number,
+        # task_manager.h:152).
         self._exported_fns: Dict[bytes, bytes] = {}  # hash -> payload
         self._fn_cache: Dict[bytes, Any] = {}  # hash -> callable/class
         self._inflight_lock = threading.Lock()
-        self._inflight_remote: Dict[TaskID, dict] = {}
+        self._inflight_remote: Dict[Tuple[TaskID, int], dict] = {}
         self._completed_returns: set = set()  # return oids known done
+        # Nodes whose death we already processed (signals arrive from both
+        # the pubsub push and the view refresh; handling must be idempotent).
+        self._dead_handled: set = set()
+        self._infeasible_grace_s = 10.0  # view may trail a joining node
+        # Serialize-time pins created while building a task-push message are
+        # collected here (thread-local) and released when the push attempt
+        # settles — never left to the receiving process, whose death must
+        # not leak them.
+        self._pin_collect = threading.local()
+        import itertools
+        self._pin_seq = itertools.count()
+        self._pin_heap: list = []
+        self._pin_reaper = None
+        self._pin_reaper_cv = threading.Condition()
+        # One reply per task completion, shared by duplicate-push hooks
+        # (rebuilding would race the first build's inline store.free).
+        self._reply_bytes_cache: Dict[TaskID, bytes] = {}
 
         # Remote actors this process created or uses.
         self.remote_actors: Dict[ActorID, _RemoteActorRecord] = {}
@@ -197,6 +223,15 @@ class DistributedRuntime(Runtime):
             self.state.register_job(pb.JobInfo(
                 job_id=self.job_id.binary(), driver_address=self.address,
                 state="RUNNING", start_ms=time.time() * 1e3))
+
+        # Borrow-protocol messages (ADD_BORROW / RELEASE_PIN /
+        # REMOVE_BORROW) run on one FIFO worker PER PEER so registration
+        # never blocks the unpickle path, a REMOVE can never overtake its
+        # ADD (both target the owner), and one slow peer cannot
+        # head-of-line-block traffic to the others.
+        self._borrow_qs: Dict[str, "queue.Queue"] = {}
+        self._borrow_q_lock = threading.Lock()
+        self._borrow_registered: set = set()
 
         # Pubsub: node lifecycle.
         self.state.subscribe(["nodes"], self._on_node_event)
@@ -252,21 +287,34 @@ class DistributedRuntime(Runtime):
     def _refresh_view(self):
         nodes = self.state.list_nodes()
         my_id = self.local_node.node_id.binary()
+        died: List[pb.NodeInfo] = []
         with self._view_lock:
             seen = set()
             for info in nodes:
                 if info.node_id == my_id:
                     continue
                 seen.add(info.node_id)
+                prev = self._view.get(info.node_id)
+                if info.alive:
+                    self._dead_handled.discard(info.node_id)  # re-registered
+                elif (info.node_id not in self._dead_handled
+                        and (prev is None or prev.alive)):
+                    died.append(info)  # missed/raced pubsub: reconcile here
                 self._view[info.node_id] = info
-                self._addr_by_node[info.node_id] = info.address
-                nr = NodeResources(ResourceSet(dict(info.total.amounts)))
-                nr.available = ResourceSet(dict(info.available.amounts))
-                self._view_avail[info.node_id] = nr
+                if info.address:
+                    self._addr_by_node[info.node_id] = info.address
+                if info.alive:
+                    nr = NodeResources(ResourceSet(dict(info.total.amounts)))
+                    nr.available = ResourceSet(dict(info.available.amounts))
+                    self._view_avail[info.node_id] = nr
+                else:
+                    self._view_avail.pop(info.node_id, None)
             for nid in list(self._view):
                 if nid not in seen:
                     del self._view[nid]
                     self._view_avail.pop(nid, None)
+        for info in died:
+            self._handle_remote_node_death(info)
         self._kick()
 
     def _on_node_event(self, ev: pb.Event):
@@ -281,12 +329,25 @@ class DistributedRuntime(Runtime):
                     self._addr_by_node[info.node_id] = info.address
                     nr = NodeResources(ResourceSet(dict(info.total.amounts)))
                     self._view_avail[info.node_id] = nr
+                    # A once-dead node that re-registered (state-service
+                    # restart sweep) must be eligible for death handling
+                    # again.
+                    self._dead_handled.discard(info.node_id)
             self._kick()
 
     def _handle_remote_node_death(self, info: pb.NodeInfo):
+        """The single authority for a peer's death: fail its in-flight
+        pushes, restart its actors, drop its borrows and object locations.
+        Reached from the NODE_DEAD pubsub push AND the periodic view
+        reconciliation; runs exactly once per node."""
         nid = info.node_id
-        addr = info.address or self._addr_by_node.get(nid, "")
+        # The registration-time address is authoritative; event payloads on
+        # a restarted state service may lack it.
+        addr = self._addr_by_node.get(nid, "") or info.address
         with self._view_lock:
+            if nid in self._dead_handled:
+                return
+            self._dead_handled.add(nid)
             entry = self._view.get(nid)
             if entry is not None:
                 entry.alive = False
@@ -313,6 +374,9 @@ class DistributedRuntime(Runtime):
 
     def shutdown(self):
         self._hb_stop.set()
+        with self._borrow_q_lock:
+            for q in self._borrow_qs.values():
+                q.put(None)
         if self.is_driver:
             try:
                 self.state.register_job(pb.JobInfo(
@@ -336,55 +400,125 @@ class DistributedRuntime(Runtime):
     # --------------------------------------------------------- borrow plane
 
     def reduce_ref(self, oid: ObjectID):
-        """Cross-process ref reduction: pin locally (released by the
-        deserializer via RELEASE_PIN), embed owner + sender addresses."""
+        """Cross-process ref reduction: pin locally, embed owner + sender
+        addresses. When serialization happens inside a task push
+        (_spec_to_msg installs a collector), the pin's lifetime belongs to
+        the push attempt — released at settle — and the marker says so;
+        otherwise the deserializer releases it via RELEASE_PIN."""
         self.reference_counter.pin_for_task(oid)
+        collector = getattr(self._pin_collect, "pins", None)
+        managed = collector is not None
+        if managed:
+            collector.append(oid)
         owner = self._owner_addr.get(oid, self.address)
         return (_deserialize_dist_ref,
-                (oid.binary(), owner, self.address))
+                (oid.binary(), owner, self.address, managed))
 
     def register_incoming_ref(self, oid: ObjectID, owner_addr: str,
-                              sender_addr: str):
+                              sender_addr: str, managed: bool = False):
+        """Called from the unpickle hook: record ownership synchronously,
+        move the wire traffic (ADD_BORROW to the owner, RELEASE_PIN to the
+        sender) onto the borrow worker so deserialization never blocks on a
+        peer. FIFO ordering guarantees the owner sees our ADD_BORROW before
+        any REMOVE_BORROW we might emit later. ``managed`` pins are
+        released by the pusher at attempt settle, not by us."""
         if owner_addr != self.address:
             self._owner_addr[oid] = owner_addr
             self._location_hints.setdefault(oid, owner_addr)
-            try:
-                client = self.pool.get(owner_addr)
-                client.call(pb.ADD_BORROW, pb.BorrowRequest(
-                    object_id=oid.binary(),
-                    borrower=self.address).SerializeToString(), timeout=30)
-            except Exception:
-                logger.debug("ADD_BORROW to %s failed", owner_addr,
-                             exc_info=True)
-        # Release the sender's serialize-time pin (async, best effort).
+            self._borrow_enqueue("add", oid, owner_addr)
+        if managed:
+            return
+        # Release the sender's serialize-time pin.
         if sender_addr == self.address:
             self.reference_counter.unpin_for_task(oid)
         else:
-            def _release():
-                try:
-                    self.pool.get(sender_addr).call(
-                        pb.RELEASE_PIN, pb.FreeObjectRequest(
-                            object_id=oid.binary()).SerializeToString(),
-                        timeout=30)
-                except Exception:
-                    pass
-            self.offload(_release)
+            self._borrow_enqueue("release", oid, sender_addr)
+
+    def _peer_presumed_dead(self, addr: str) -> bool:
+        """True only when the view knows the address and NO entry for it is
+        alive (a restarted daemon can reuse a dead predecessor's host:port;
+        any alive match wins)."""
+        matched = False
+        with self._view_lock:
+            for nid, info in self._view.items():
+                if self._addr_by_node.get(nid) == addr:
+                    if info.alive:
+                        return False
+                    matched = True
+        return matched
+
+    def _borrow_call(self, kind: str, oid: ObjectID, peer: str,
+                     method: int, body: bytes) -> bool:
+        """One borrow-protocol RPC with inline retries. A dropped
+        REMOVE_BORROW would pin the object at the owner forever (borrows
+        gate _on_zero), a dropped ADD_BORROW lets the owner free an object
+        we hold — neither may be lost to a transient failure. Gives up only
+        when the peer is (presumed) dead: node-death cleanup reclaims the
+        state on both sides then."""
+        for pause in (0.0, 0.2, 0.5, 1.0, 2.0):
+            if pause:
+                time.sleep(pause)
+            if self._hb_stop.is_set() or self._peer_presumed_dead(peer):
+                return False
+            try:
+                self.pool.get(peer).call(method, body, timeout=10)
+                return True
+            except Exception:
+                logger.debug("borrow %s for %s to %s failed", kind, oid,
+                             peer, exc_info=True)
+        logger.warning("borrow %s for %s to live peer %s kept failing",
+                       kind, oid, peer)
+        return False
+
+    def _borrow_enqueue(self, kind: str, oid: ObjectID, peer: str):
+        with self._borrow_q_lock:
+            q = self._borrow_qs.get(peer)
+            if q is None:
+                q = queue.Queue()
+                self._borrow_qs[peer] = q
+                threading.Thread(target=self._borrow_loop, args=(q,),
+                                 daemon=True,
+                                 name=f"dist-borrow-{peer}").start()
+        q.put((kind, oid, peer))
+
+    def _borrow_loop(self, q: "queue.Queue"):
+        while not self._hb_stop.is_set():
+            try:
+                item = q.get(timeout=1.0)
+            except queue.Empty:
+                continue
+            if item is None:
+                return
+            kind, oid, peer = item
+            if kind == "add":
+                # Idempotent per borrower: the owner tracks presence, our
+                # own reference counter tracks multiplicity.
+                if oid not in self._borrow_registered:
+                    if self._borrow_call(
+                            kind, oid, peer, pb.ADD_BORROW,
+                            pb.BorrowRequest(
+                                object_id=oid.binary(),
+                                borrower=self.address).SerializeToString()):
+                        self._borrow_registered.add(oid)
+            elif kind == "release":
+                self._borrow_call(
+                    kind, oid, peer, pb.RELEASE_PIN,
+                    pb.FreeObjectRequest(
+                        object_id=oid.binary()).SerializeToString())
+            elif kind == "remove":
+                if oid in self._borrow_registered and self._borrow_call(
+                        kind, oid, peer, pb.REMOVE_BORROW,
+                        pb.BorrowRequest(
+                            object_id=oid.binary(),
+                            borrower=self.address).SerializeToString()):
+                    self._borrow_registered.discard(oid)
 
     def _on_ref_zero(self, oid: ObjectID):
         owner = self._owner_addr.pop(oid, None) if hasattr(
             self, "_owner_addr") else None
         if owner is not None and owner != getattr(self, "address", None):
             # We were a borrower: tell the owner, drop local cache.
-            def _notify():
-                try:
-                    self.pool.get(owner).call(
-                        pb.REMOVE_BORROW, pb.BorrowRequest(
-                            object_id=oid.binary(),
-                            borrower=self.address).SerializeToString(),
-                        timeout=30)
-                except Exception:
-                    pass
-            self.offload(_notify)
+            self._borrow_enqueue("remove", oid, owner)
         super()._on_ref_zero(oid)
         if hasattr(self, "_location_hints"):
             self._location_hints.pop(oid, None)
@@ -404,6 +538,7 @@ class DistributedRuntime(Runtime):
         deadline = None if timeout is None else time.monotonic() + timeout
         backoff = 0.002
         while True:
+            read_failed = False  # local sealed entry was unreadable
             # 1. Local store.
             if self.local_node.store.contains(oid):
                 try:
@@ -411,7 +546,7 @@ class DistributedRuntime(Runtime):
                 except exc.RayTpuError:
                     raise
                 except Exception:
-                    pass
+                    read_failed = True
             # 2. A task we pushed remotely may complete into local seal.
             info = self._inflight_for_return(oid)
             if info is not None:
@@ -434,6 +569,8 @@ class DistributedRuntime(Runtime):
                 state = (self.task_states.get(spec.task_id)
                          if spec is not None else None)
             if spec is not None and state in ("FINISHED", "FAILED", None):
+                if not read_failed and self.local_node.store.contains(oid):
+                    continue  # sealed between steps 1 and 4: re-read
                 if not self._try_reconstruct(oid):
                     raise exc.ObjectLostError(
                         f"object {oid} lost and not reconstructable")
@@ -452,6 +589,11 @@ class DistributedRuntime(Runtime):
                 if oid in info["returns"]:
                     return info
         return None
+
+    def _task_finalized(self, task_id: TaskID) -> bool:
+        with self.lock:
+            return self.task_states.get(task_id) in (
+                "FINISHED", "FAILED", "CANCELLED")
 
     def _try_remote_fetch(self, oid: ObjectID) -> Tuple[Any, bool]:
         addrs: List[str] = []
@@ -632,7 +774,14 @@ class DistributedRuntime(Runtime):
                 self.task_states[spec.task_id] = "FAILED"
             self._fire_completion(spec)
             return "done"
-        node_id = self._select_node(spec)
+        if getattr(spec, "_exec_local", False):
+            # A peer pushed this task here after placing it: execute locally
+            # or queue for local capacity — never re-forward through our own
+            # (possibly stale) cluster view. Re-placement on failure is the
+            # pusher's job (it holds the lineage and the retry budget).
+            node_id = self.local_node.node_id
+        else:
+            node_id = self._select_node(spec)
         if node_id is None:
             return "wait"
         if node_id == self.local_node.node_id:
@@ -719,7 +868,7 @@ class DistributedRuntime(Runtime):
             self._fn_cache[key] = fn
         return fn
 
-    def _spec_to_msg(self, spec: TaskSpec) -> pb.TaskSpecMsg:
+    def _spec_to_msg(self, spec: TaskSpec) -> Tuple[pb.TaskSpecMsg, list]:
         msg = pb.TaskSpecMsg(
             task_id=spec.task_id.binary(),
             job_id=spec.job_id.binary(),
@@ -736,7 +885,17 @@ class DistributedRuntime(Runtime):
             msg.method_name = spec.method_name or ""
         else:
             msg.fn_hash = self._export_callable(spec.function)
-        msg.args_pickle = cloudpickle.dumps((spec.args, spec.kwargs))
+        self._pin_collect.pins = []
+        try:
+            msg.args_pickle = cloudpickle.dumps((spec.args, spec.kwargs))
+            arg_pins = self._pin_collect.pins
+        except BaseException:
+            # Nothing ever reaches a receiver: release what we pinned.
+            for oid in self._pin_collect.pins or []:
+                self.reference_counter.unpin_for_task(oid)
+            raise
+        finally:
+            self._pin_collect.pins = None
         for k, v in spec.options.resources.to_dict().items():
             msg.resources.amounts[k] = v
         if spec.options.runtime_env:
@@ -749,92 +908,228 @@ class DistributedRuntime(Runtime):
         if pg is not None:
             msg.pg_id = pg.id.binary()
             msg.pg_bundle_index = spec.options.placement_group_bundle_index
-        return msg
+        return msg, arg_pins
+
+    def _release_arg_pins(self, pins: list, delay_s: float = 0.0):
+        """Release the serialize-time pins of one settled push attempt.
+
+        A successful attempt defers the release briefly: the executor's
+        ADD_BORROW for any ref it kept travels on a different connection
+        than the task reply, and the pin must outlive that registration.
+        Deferred releases share ONE reaper thread (a timer thread per task
+        completion would not survive high task rates).
+        """
+        if not pins:
+            return
+        if delay_s <= 0:
+            for oid in pins:
+                self.reference_counter.unpin_for_task(oid)
+            return
+        import heapq
+        with self._pin_reaper_cv:
+            heapq.heappush(self._pin_heap,
+                           (time.monotonic() + delay_s, next(self._pin_seq),
+                            pins))
+            if self._pin_reaper is None:
+                self._pin_reaper = threading.Thread(
+                    target=self._pin_reaper_loop, daemon=True,
+                    name="dist-pin-reaper")
+                self._pin_reaper.start()
+            self._pin_reaper_cv.notify()
+
+    def _pin_reaper_loop(self):
+        import heapq
+        while not self._hb_stop.is_set():
+            with self._pin_reaper_cv:
+                while not self._pin_heap and not self._hb_stop.is_set():
+                    self._pin_reaper_cv.wait(timeout=1.0)
+                if self._hb_stop.is_set():
+                    return
+                due_at = self._pin_heap[0][0]
+                delay = due_at - time.monotonic()
+                if delay > 0:
+                    self._pin_reaper_cv.wait(timeout=delay)
+                    continue
+                _, _, pins = heapq.heappop(self._pin_heap)
+            for oid in pins:
+                self.reference_counter.unpin_for_task(oid)
+
+    def _claim_pins(self, info: Optional[dict]) -> list:
+        """Atomically claim an attempt's serialize-time pins: exactly one
+        of the possibly-concurrent settle paths (success reply, connection
+        error, NODE_DEAD sweep) gets them; the rest get []."""
+        if info is None:
+            return []
+        with self._inflight_lock:
+            if info.get("pins_claimed"):
+                return []
+            info["pins_claimed"] = True
+            return info.get("arg_pins") or []
+
+    def _transfer_stale_pins(self, spec: TaskSpec, pins: list):
+        """Hand a settled attempt's pins to the task's NEXT incarnation:
+        released only when the retry re-serializes (re-pinning) or the task
+        reaches a terminal state (_unpin_args flushes) — never on a timer a
+        long pending-queue wait could outlive."""
+        if pins:
+            stale = getattr(spec, "_stale_arg_pins", None) or []
+            spec._stale_arg_pins = stale + pins
+
+    def _unpin_args(self, spec: TaskSpec):
+        stale = getattr(spec, "_stale_arg_pins", None)
+        if stale:
+            spec._stale_arg_pins = None
+            for oid in stale:
+                self.reference_counter.unpin_for_task(oid)
+        super()._unpin_args(spec)
 
     def _push_task_remote(self, spec: TaskSpec, addr: str, cancel,
                           method: int = pb.PUSH_TASK):
-        msg = self._spec_to_msg(spec)
+        msg, arg_pins = self._spec_to_msg(spec)
+        # The re-serialization above re-pinned every arg ref; the previous
+        # attempt's pins (held across the pending-queue wait) can go now.
+        stale = getattr(spec, "_stale_arg_pins", None)
+        if stale:
+            spec._stale_arg_pins = None
+            self._release_arg_pins(stale)
+        attempt = spec.attempt
+        key = (spec.task_id, attempt)
         info = {
             "spec": spec, "addr": addr, "cancel": cancel,
+            "attempt": attempt, "arg_pins": arg_pins,
             "returns": set(spec.return_ids), "event": threading.Event(),
         }
         with self._inflight_lock:
-            self._inflight_remote[spec.task_id] = info
+            self._inflight_remote[key] = info
 
         def _done(env, error):
-            self._on_remote_reply(spec, addr, cancel, env, error)
+            self._on_remote_reply(spec, attempt, addr, cancel, env, error)
 
         try:
             client = self.pool.get(
                 addr, on_close=self._on_peer_conn_close)
             client.call_async(method, msg.SerializeToString(), _done)
         except Exception as e:  # connection refused etc.
-            self._on_remote_reply(spec, addr, cancel, None, e)
+            self._on_remote_reply(spec, attempt, addr, cancel, None, e)
 
-    def _on_remote_reply(self, spec: TaskSpec, addr: str, cancel,
-                         env, error):
-        with self._inflight_lock:
-            info = self._inflight_remote.pop(spec.task_id, None)
+    def _on_remote_reply(self, spec: TaskSpec, attempt: int, addr: str,
+                         cancel, env, error):
+        """Reply/error callback for one push attempt. Failure handling only
+        acts when this callback atomically removed the attempt's in-flight
+        entry — connection-close and NODE_DEAD both funnel into the same
+        pop-then-settle, so exactly one signal wins. Completion replies are
+        accepted from any attempt, first final state wins."""
+        key = (spec.task_id, attempt)
+        if error is not None:
+            # Pop first: the atomic removal IS the claim to be this
+            # attempt's failure authority (NODE_DEAD raced us otherwise).
+            with self._inflight_lock:
+                info = self._inflight_remote.pop(key, None)
+            if info is not None:
+                try:
+                    self._settle_push_failure(spec, attempt, addr, cancel,
+                                              error, self._claim_pins(info))
+                finally:
+                    info["event"].set()
+                    self._kick()
+            return
+        # Success/spillback: settle BEFORE removing the in-flight entry so
+        # concurrent get()s keep blocking on its event rather than racing
+        # the seal (they re-check the store once the event fires).
+        info = self._inflight_remote.get(key)
+        spilled = False
         try:
-            if error is not None:
-                self._handle_push_failure(spec, addr, cancel, error)
-                return
             self._suspect_addrs.pop(addr, None)  # proven alive
             rep = pb.PushTaskReply()
             rep.ParseFromString(env.body)
             if rep.status == "spillback":
+                if self._task_finalized(spec.task_id):
+                    return  # superseded attempt
                 # Correct the stale view and reschedule.
                 with self._view_lock:
                     nrs = [nr for nid, nr in self._view_avail.items()
                            if self._addr_by_node.get(nid) == addr]
                     for nr in nrs:
                         nr.available = ResourceSet(dict(rep.available.amounts))
+                spilled = True
+                # Pins ride to the re-push (which re-serializes).
+                self._transfer_stale_pins(spec, self._claim_pins(info))
                 with self._pending_cv:
                     self._pending.append({"spec": spec, "cancel": cancel})
                     self._pending_cv.notify_all()
                 return
-            if rep.error_pickle:
-                err = pickle.loads(rep.error_pickle)
-                for rid in spec.return_ids:
-                    self.seal_error(rid, err, self.local_node)
-                with self.lock:
+            # Completion (value or application error). Seal under the
+            # runtime lock with a first-writer-wins guard: a superseded
+            # attempt that still ran to completion is a valid completion
+            # (at-least-once execution), but only one outcome lands.
+            with self.lock:
+                if self._task_finalized(spec.task_id):
+                    return
+                if rep.error_pickle:
+                    err = pickle.loads(rep.error_pickle)
+                    for rid in spec.return_ids:
+                        self.seal_error(rid, err, self.local_node)
                     self.task_states[spec.task_id] = "FAILED"
-            else:
-                for i, rid in enumerate(spec.return_ids):
-                    if i < len(rep.inline) and rep.inline[i]:
-                        value = pickle.loads(rep.inline_results[i])
-                        self.local_node.store.put(rid, value)
-                        with self.lock:
+                else:
+                    for i, rid in enumerate(spec.return_ids):
+                        if i < len(rep.inline) and rep.inline[i]:
+                            value = pickle.loads(rep.inline_results[i])
+                            self.local_node.store.put(rid, value)
                             self.object_locations[rid] = self.local_node.node_id
-                        self._owner_addr.setdefault(rid, self.address)
-                    else:
-                        self._location_hints[rid] = addr
-                        self._owner_addr.setdefault(rid, addr)
-                    self._completed_returns.add(rid)
-                with self.lock:
+                            self._owner_addr.setdefault(rid, self.address)
+                        else:
+                            self._location_hints[rid] = addr
+                            self._owner_addr.setdefault(rid, addr)
+                        self._completed_returns.add(rid)
                     self.task_states[spec.task_id] = "FINISHED"
             self._unpin_args(spec)
             self._fire_completion(spec)
         finally:
+            with self._inflight_lock:
+                self._inflight_remote.pop(key, None)
             if info is not None:
+                if not spilled:
+                    # Grace period: the executor's ADD_BORROW for any ref
+                    # it kept rides a different connection than this reply;
+                    # the serialize pin must outlive that registration.
+                    self._release_arg_pins(self._claim_pins(info),
+                                           delay_s=10.0)
                 info["event"].set()
             self._kick()
 
-    def _handle_push_failure(self, spec: TaskSpec, addr: str, cancel,
-                             error: Exception):
-        """The daemon died mid-task (connection error): retry elsewhere."""
+    def _settle_push_failure(self, spec: TaskSpec, attempt: int, addr: str,
+                             cancel, error: Exception, arg_pins: list = ()):
+        """The daemon died mid-task (connection error / NODE_DEAD): retry
+        elsewhere. Caller must have removed the attempt's in-flight entry;
+        stale signals for superseded attempts are dropped here. The
+        attempt's serialize-time arg pins are handed to the retry (released
+        at its re-serialization or terminal seal) — or released after a
+        borrow-registration grace when the attempt is superseded."""
         # Mark the address suspect so resubmissions avoid it until the
         # heartbeat sweep settles its fate (view refresh keeps listing it
         # alive until then).
         with self._view_lock:
             self._suspect_addrs[addr] = time.monotonic() + 10.0
+        with self.lock:
+            if self._task_finalized(spec.task_id) or spec.attempt != attempt:
+                # Superseded: our executor may still have deserialized the
+                # args and be registering borrows — grace before release.
+                self._release_arg_pins(list(arg_pins), delay_s=10.0)
+                return
+            self._transfer_stale_pins(spec, list(arg_pins))
         cause = exc.NodeDiedError(
             f"task {spec.function_name} lost to node failure at {addr}: "
             f"{error}")
         if spec.is_actor_task():
-            # Actor-call semantics: replay onto the (restarting) actor only
-            # within max_task_retries, else surface ActorDiedError
+            # The connection failure is a death signal for the actor's host
+            # — act on it now instead of waiting for the heartbeat sweep:
+            # restart the actor if we own it, drop the stale record if not,
+            # then replay the call within max_retries
             # (gcs_actor_manager.h:66 + max_task_retries replay).
+            rec = self.remote_actors.get(spec.actor_id)
+            if rec is not None and rec.address == addr:
+                self._handle_remote_actor_death(rec, exc.NodeDiedError(
+                    f"node hosting actor died ({addr})"))
             if spec.should_retry(cause) and not cancel.is_set():
                 spec.attempt += 1
                 self.offload(lambda: self.submit_actor_task(
@@ -870,14 +1165,20 @@ class DistributedRuntime(Runtime):
 
     def _fail_inflight_to(self, addr: str, reason: str):
         with self._inflight_lock:
-            items = [(tid, info) for tid, info in self._inflight_remote.items()
+            items = [(key, info) for key, info in self._inflight_remote.items()
                      if info["addr"] == addr]
-        for tid, info in items:
-            with self._inflight_lock:
-                self._inflight_remote.pop(tid, None)
-            self._handle_push_failure(info["spec"], addr, info["cancel"],
-                                      RpcConnectionError(reason))
-            info["event"].set()
+            for key, _ in items:
+                self._inflight_remote.pop(key, None)
+        for (tid, attempt), info in items:
+            try:
+                self._settle_push_failure(info["spec"], attempt, addr,
+                                          info["cancel"],
+                                          RpcConnectionError(reason),
+                                          self._claim_pins(info))
+            except Exception:
+                logger.exception("settle failed for %s", tid)
+            finally:
+                info["event"].set()
 
     # -------------------------------------------------------------- actors
 
@@ -1021,8 +1322,14 @@ class DistributedRuntime(Runtime):
 
     def _handle_remote_actor_death(self, rec: _RemoteActorRecord,
                                    cause: BaseException):
+        """Idempotent: reachable from the NODE_DEAD pubsub push, the view
+        reconciliation, and connection failures on actor calls — the first
+        signal wins, the rest are no-ops."""
+        with rec.lock:
+            if rec.status == "DEAD":
+                return
+            rec.status = "DEAD"
         state = self.actors.get(rec.actor_id)
-        rec.status = "DEAD"
         self.remote_actors.pop(rec.actor_id, None)
         if state is None:
             return
@@ -1400,9 +1707,53 @@ class DistributedRuntime(Runtime):
             rep.available.amounts[k] = v
         ctx.reply(rep.SerializeToString())
 
+    def _dedupe_pushed_task(self, ctx: RpcContext, msg: pb.TaskSpecMsg
+                            ) -> bool:
+        """A caller that saw a spurious failure signal may re-push an
+        attempt we already admitted (the reference raylet drops duplicate
+        leases the same way). Returns True when the push was absorbed:
+        either attached as an extra reply hook to the still-running task or
+        answered immediately from sealed results."""
+        tid = TaskID(msg.task_id)
+        return_ids = tuple(ObjectID(r) for r in msg.return_ids)
+        shim = None
+        cached = None
+        with self.lock:
+            st = self.task_states.get(tid)
+            if st in ("PENDING", "RUNNING", "RESUBMITTED"):
+                self.completion_hooks.setdefault(tid, []).append(
+                    lambda s: self._reply_task_outcome(ctx, s))
+                return True
+            if st in ("FINISHED", "FAILED", "CANCELLED"):
+                cached = self._reply_bytes_cache.get(tid)
+                if cached is not None:
+                    # Inline results were freed when the first reply was
+                    # built — replay those exact bytes, never re-execute.
+                    pass
+                elif return_ids and all(self.local_node.store.contains(r)
+                                        for r in return_ids):
+                    shim = TaskSpec(
+                        task_id=tid, job_id=JobID(msg.job_id), function=None,
+                        function_name=msg.function_name, args=(), kwargs={},
+                        options=TaskOptions(num_returns=msg.num_returns),
+                        return_ids=return_ids)
+                else:
+                    # Results gone AND no cached reply (evicted):
+                    # re-execute fresh.
+                    self.task_states.pop(tid, None)
+        if cached is not None:
+            ctx.reply(cached)
+            return True
+        if shim is not None:
+            self._reply_task_outcome(ctx, shim)
+            return True
+        return False
+
     def _handle_push_task(self, ctx: RpcContext):
         msg = pb.TaskSpecMsg()
         msg.ParseFromString(ctx.body)
+        if self._dedupe_pushed_task(ctx, msg):
+            return
         try:
             spec = self._msg_to_spec(msg)
         except Exception as e:  # noqa: BLE001 — deserialization failure
@@ -1416,16 +1767,20 @@ class DistributedRuntime(Runtime):
         if not self._admission_check(spec.options.resources):
             self._spillback_reply(ctx)
             return
-        self.completion_hooks[spec.task_id] = (
-            lambda s: self._reply_task_outcome(ctx, s))
-        # Force local execution (the caller placed it here).
+        with self.lock:
+            self.completion_hooks.setdefault(spec.task_id, []).append(
+                lambda s: self._reply_task_outcome(ctx, s))
+        # Execute here (the caller placed it) — never re-forward through
+        # our own view; _exec_local pins dispatch to this node.
+        spec._exec_local = True
         spec.options.scheduling_strategy = "DEFAULT"
-        spec.options.placement_group = None
         self.submit_task(spec)
 
     def _handle_actor_call(self, ctx: RpcContext):
         msg = pb.TaskSpecMsg()
         msg.ParseFromString(ctx.body)
+        if self._dedupe_pushed_task(ctx, msg):
+            return
         try:
             spec = self._msg_to_spec(msg)
         except Exception as e:  # noqa: BLE001
@@ -1433,12 +1788,22 @@ class DistributedRuntime(Runtime):
                 exc.RayTpuError(f"actor call deserialization failed: {e}")))
             ctx.reply(rep.SerializeToString())
             return
-        self.completion_hooks[spec.task_id] = (
-            lambda s: self._reply_task_outcome(ctx, s))
+        with self.lock:
+            self.completion_hooks.setdefault(spec.task_id, []).append(
+                lambda s: self._reply_task_outcome(ctx, s))
         Runtime.submit_actor_task(self, spec.actor_id, spec)
 
     def _reply_task_outcome(self, ctx: RpcContext, spec: TaskSpec):
-        """Completion hook: turn sealed local results into a PushTaskReply."""
+        """Completion hook: turn sealed local results into a PushTaskReply.
+
+        The reply bytes are built ONCE per task and cached: a duplicate
+        push attaches a second hook, and rebuilding would race the first
+        build's store.free (inline results are freed on consumption) —
+        the second reply would otherwise advertise a freed object."""
+        cached = self._reply_bytes_cache.get(spec.task_id)
+        if cached is not None:
+            ctx.reply(cached)
+            return
         rep = pb.PushTaskReply(status="ok")
         store = self.local_node.store
         err: Optional[BaseException] = None
@@ -1480,7 +1845,14 @@ class DistributedRuntime(Runtime):
                             rid.binary(), self.local_node.node_id.binary())
                     except Exception:
                         pass
-        ctx.reply(rep.SerializeToString())
+        data = rep.SerializeToString()
+        self._reply_bytes_cache[spec.task_id] = data
+        while len(self._reply_bytes_cache) > 512:
+            stale_key = next(iter(self._reply_bytes_cache), None)
+            if stale_key is None:
+                break
+            self._reply_bytes_cache.pop(stale_key, None)
+        ctx.reply(data)
 
     def _handle_create_actor(self, ctx: RpcContext):
         msg = pb.ActorSpecMsg()

@@ -36,6 +36,12 @@ class ReferenceCounter:
         with self._lock:
             self._local_refs[oid] = self._local_refs.get(oid, 0) + 1
 
+    def _zero_locked(self, oid: ObjectID) -> bool:
+        """All three holds — local refs, task pins, remote borrows — gone."""
+        return (self._local_refs.get(oid, 0) == 0
+                and self._pins.get(oid, 0) == 0
+                and not self._borrows.get(oid))
+
     def remove_local_ref(self, oid: ObjectID):
         cb = None
         with self._lock:
@@ -44,7 +50,7 @@ class ReferenceCounter:
                 self._local_refs[oid] = n
             else:
                 self._local_refs.pop(oid, None)
-                if self._pins.get(oid, 0) == 0:
+                if self._zero_locked(oid):
                     cb = self._on_zero
         if cb is not None:
             cb(oid)
@@ -61,31 +67,31 @@ class ReferenceCounter:
                 self._pins[oid] = n
             else:
                 self._pins.pop(oid, None)
-                if self._local_refs.get(oid, 0) == 0:
+                if self._zero_locked(oid):
                     cb = self._on_zero
         if cb is not None:
             cb(oid)
 
     def add_borrow(self, oid: ObjectID, borrower: str):
+        """Record that ``borrower`` (a process address) holds the object.
+        Idempotent per borrower: the borrower's own reference counter tracks
+        how many handles it holds and sends exactly one REMOVE_BORROW when
+        its count hits zero, so the owner only needs presence — counting
+        each ADD_BORROW would leak when N deserializations pair with one
+        removal (reference_count.h:61 tracks borrower worker identity the
+        same way)."""
         with self._lock:
-            per = self._borrows.setdefault(oid, {})
-            per[borrower] = per.get(borrower, 0) + 1
+            self._borrows.setdefault(oid, {})[borrower] = 1
 
     def remove_borrow(self, oid: ObjectID, borrower: str):
         cb = None
         with self._lock:
             per = self._borrows.get(oid)
             if per is not None:
-                n = per.get(borrower, 0) - 1
-                if n > 0:
-                    per[borrower] = n
-                else:
-                    per.pop(borrower, None)
+                per.pop(borrower, None)
                 if not per:
                     self._borrows.pop(oid, None)
-            if (self._borrows.get(oid) is None
-                    and self._local_refs.get(oid, 0) == 0
-                    and self._pins.get(oid, 0) == 0):
+            if self._zero_locked(oid):
                 cb = self._on_zero
         if cb is not None:
             cb(oid)
@@ -98,8 +104,7 @@ class ReferenceCounter:
                 per = self._borrows[oid]
                 if per.pop(borrower, None) is not None and not per:
                     self._borrows.pop(oid, None)
-                    if (self._local_refs.get(oid, 0) == 0
-                            and self._pins.get(oid, 0) == 0):
+                    if self._zero_locked(oid):
                         zeroed.append(oid)
         if self._on_zero is not None:
             for oid in zeroed:

@@ -78,8 +78,12 @@ class RpcClient:
                  on_close: Optional[Callable[[Exception], None]] = None):
         host, port = address.rsplit(":", 1)
         self.address = address
-        self._sock = socket.create_connection((host, int(port)),
-                                              timeout=connect_timeout)
+        try:
+            self._sock = socket.create_connection((host, int(port)),
+                                                  timeout=connect_timeout)
+        except OSError as e:
+            raise RpcConnectionError(
+                f"connect to {address} failed: {e}") from e
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._wlock = threading.Lock()

@@ -220,8 +220,15 @@ class Runtime:
         self._dispatch_dirty = False  # kick arrived while loop was busy
         # Per-task completion hooks, fired once when a task reaches a final
         # state (FINISHED/FAILED/CANCELLED, not retries). The host daemon
-        # uses these to turn local completions into RPC replies.
-        self.completion_hooks: Dict[TaskID, Callable[[TaskSpec], None]] = {}
+        # uses these to turn local completions into RPC replies; a task can
+        # carry several hooks when a caller re-pushed an attempt it already
+        # admitted (duplicate pushes attach instead of re-executing).
+        self.completion_hooks: Dict[TaskID, List[Callable[[TaskSpec], None]]] = {}
+        # Infeasible requests get this long for the cluster view to change
+        # (a node joining) before the error is sealed. 0 = fail fast; the
+        # distributed runtime raises it because its view is refreshed
+        # asynchronously and may trail reality by a refresh interval.
+        self._infeasible_grace_s = 0.0
         self.autoscaling_enabled = False  # set by StandardAutoscaler
         self._util_pool = ThreadPoolExecutor(max_workers=32,
                                              thread_name_prefix="rt-util")
@@ -296,6 +303,7 @@ class Runtime:
     def get_object(self, oid: ObjectID, timeout: Optional[float] = None) -> Any:
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
+            read_failed = False  # located copy was unreadable this pass
             node = self._locate(oid)
             if node is not None:
                 try:
@@ -310,6 +318,7 @@ class Runtime:
                     from ray_tpu._private.object_store import ObjectLostError
                     if not isinstance(e, ObjectLostError):
                         raise
+                    read_failed = True
             # No live copy. Producing task may still be in flight (just wait),
             # or it finished and the copy was lost (reconstruct from lineage).
             with self.lock:
@@ -320,6 +329,8 @@ class Runtime:
                 raise exc.ObjectLostError(
                     f"object {oid} is lost and has no lineage to reconstruct")
             if state in ("FINISHED", "FAILED", None):
+                if not read_failed and self._locate(oid) is not None:
+                    continue  # sealed between the locate above and here
                 # The value (or error) existed and was lost with its node.
                 if not self._try_reconstruct(oid):
                     raise exc.ObjectLostError(
@@ -429,6 +440,12 @@ class Runtime:
                         # infeasible tasks feed resource_demand_scheduler).
                         still_waiting.append(item)
                         continue
+                    if self._infeasible_grace_s > 0:
+                        since = item.setdefault("infeasible_since",
+                                                time.monotonic())
+                        if time.monotonic() - since < self._infeasible_grace_s:
+                            still_waiting.append(item)
+                            continue
                     spec = item["spec"]
                     err_cls = (exc.PlacementGroupSchedulingError
                                if spec.options.placement_group is not None
@@ -708,13 +725,13 @@ class Runtime:
             self.reference_counter.unpin_for_task(oid)
 
     def _fire_completion(self, spec: TaskSpec):
-        """Invoke the task's completion hook iff it reached a final state."""
+        """Invoke the task's completion hooks iff it reached a final state."""
         with self.lock:
             state = self.task_states.get(spec.task_id)
             if state not in ("FINISHED", "FAILED", "CANCELLED"):
                 return
-            hook = self.completion_hooks.pop(spec.task_id, None)
-        if hook is not None:
+            hooks = self.completion_hooks.pop(spec.task_id, None) or []
+        for hook in hooks:
             try:
                 hook(spec)
             except Exception:

@@ -1,0 +1,161 @@
+"""Multi-host tensor plane: state-KV-brokered ``jax.distributed`` rendezvous.
+
+This is the piece that makes compiled collectives span daemon *processes*
+(and, on real hardware, TPU hosts). The reference bootstraps its NCCL
+communicators by parking an ``NCCLUniqueID`` in a named store actor that
+every rank reads
+(``python/ray/util/collective/collective_group/nccl_collective_group.py:54-95``)
+and its torch trainers run ``dist.init_process_group`` with a rank-0
+address (``python/ray/train/torch/config.py:54-96``). The TPU-native
+equivalent is JAX's multi-controller runtime: rank 0 opens the coordination
+service, every process calls ``jax.distributed.initialize``, and from then
+on ``jax.devices()`` is the GLOBAL device set — collectives are compiled
+into programs and ride ICI/DCN, not this control plane.
+
+What the state-service KV brokers here, keyed by (group, epoch):
+- the coordinator address (rank 0 binds a free port and publishes it),
+- the world size (so mismatched joins fail loudly),
+- a liveness epoch: after a failure the group re-forms under epoch+1, and
+  stale processes shut their old runtime down before rejoining.
+
+On CPU test clusters the same path runs over Gloo
+(``jax_cpu_collectives_implementation``) with ``jax_num_cpu_devices``
+virtual devices per process — the driver-validated dryrun analogue of a
+multi-host TPU slice.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+from typing import Optional
+
+logger = logging.getLogger("ray_tpu")
+
+KV_NS = b"tplane"
+
+_lock = threading.Lock()
+_active_plane: Optional[dict] = None  # {"group", "epoch", "world", "rank"}
+
+
+def _kv(runtime=None):
+    """The state-service KV of the current (or given) distributed runtime."""
+    if runtime is None:
+        from ray_tpu._private import worker as _worker
+        runtime = _worker.try_global_runtime()
+    state = getattr(runtime, "state", None)
+    if state is None:
+        raise RuntimeError(
+            "tensor plane needs a cluster (ray_tpu.init(address=...) or a "
+            "host daemon); no state service in this process")
+    return state
+
+
+def _free_port(host: str = "127.0.0.1") -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def current_plane() -> Optional[dict]:
+    with _lock:
+        return dict(_active_plane) if _active_plane else None
+
+
+def init_tensor_plane(group_name: str, world_size: int, rank: int,
+                      *, epoch: int = 0, num_cpu_devices: Optional[int] = None,
+                      timeout_s: float = 60.0, runtime=None) -> dict:
+    """Join the process-spanning tensor plane for ``group_name``/``epoch``.
+
+    Must be called at most once per (group, epoch) per process; one process
+    is one rank (the device-owner stance: libtpu is single-owner, so a TPU
+    host contributes exactly one process). Re-joining under a newer epoch
+    tears the previous JAX distributed runtime down first — that is how a
+    group re-forms after a member died.
+    """
+    import jax
+
+    state = _kv(runtime)
+    key = f"{group_name}/{epoch}".encode()
+
+    with _lock:
+        global _active_plane
+        if _active_plane is not None:
+            if (_active_plane["group"] == group_name
+                    and _active_plane["epoch"] == epoch):
+                if _active_plane["rank"] != rank:
+                    raise RuntimeError(
+                        f"process already joined {group_name}@{epoch} as "
+                        f"rank {_active_plane['rank']}, not {rank}")
+                return dict(_active_plane)
+            # Older (or different) plane: leave it before rejoining.
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                logger.debug("jax.distributed.shutdown failed", exc_info=True)
+            _active_plane = None
+
+    # CPU test clusters: virtual devices + gloo collectives. Must land
+    # before the backend initializes; harmless no-ops otherwise.
+    if num_cpu_devices is not None:
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            jax.config.update("jax_num_cpu_devices", int(num_cpu_devices))
+        except Exception:
+            logger.warning("could not configure cpu collectives",
+                           exc_info=True)
+
+    if rank == 0:
+        host = "127.0.0.1"
+        coord = f"{host}:{_free_port(host)}"
+        state.kv_put(key, f"{coord}|{world_size}".encode(),
+                     overwrite=True, namespace=KV_NS)
+    else:
+        deadline = time.monotonic() + timeout_s
+        coord = None
+        while time.monotonic() < deadline:
+            raw = state.kv_get(key, namespace=KV_NS)
+            if raw:
+                coord_s, world_s = raw.decode().split("|")
+                if int(world_s) != world_size:
+                    raise ValueError(
+                        f"group {group_name}@{epoch} exists with world_size "
+                        f"{world_s}, joined with {world_size}")
+                coord = coord_s
+                break
+            time.sleep(0.02)
+        if coord is None:
+            raise TimeoutError(
+                f"rank {rank}: no coordinator for {group_name}@{epoch} "
+                f"within {timeout_s}s")
+
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=world_size, process_id=rank,
+                               initialization_timeout=int(timeout_s))
+    plane = {"group": group_name, "epoch": epoch, "world": world_size,
+             "rank": rank, "coordinator": coord,
+             "local_devices": len(jax.local_devices()),
+             "global_devices": len(jax.devices())}
+    with _lock:
+        _active_plane = plane
+    logger.info("tensor plane %s@%d up: rank %d/%d, %d global devices",
+                group_name, epoch, rank, world_size,
+                plane["global_devices"])
+    return dict(plane)
+
+
+def shutdown_tensor_plane():
+    import jax
+    with _lock:
+        global _active_plane
+        if _active_plane is None:
+            return
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            logger.debug("jax.distributed.shutdown failed", exc_info=True)
+        _active_plane = None

@@ -18,6 +18,7 @@ from ray_tpu.cluster_utils import ProcessCluster
 
 @pytest.fixture()
 def cluster():
+    ray_tpu.shutdown()  # earlier module-scoped runtimes must not leak in
     c = ProcessCluster(num_daemons=2, num_cpus=2)
     ray_tpu.init(address=c.address)
     yield c

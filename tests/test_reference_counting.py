@@ -1,0 +1,190 @@
+"""Borrowing-refcount protocol tests.
+
+Parity with the reference's ``ReferenceCounter`` semantics
+(``src/ray/core_worker/reference_count.h:61``): the owner frees an object
+only when local refs AND task pins AND remote borrows are all gone; a
+borrower's death drops its borrows; N deserializations at one borrower
+pair with exactly one removal (presence, not counting).
+"""
+
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.reference_counter import ReferenceCounter
+
+
+def _oid(i: int = 1) -> ObjectID:
+    return ObjectID(bytes([i]) * ObjectID.size())
+
+
+class TestUnitBorrowAwareZero:
+    def test_local_ref_zero_with_borrow_does_not_free(self):
+        freed = []
+        rc = ReferenceCounter(freed.append)
+        oid = _oid()
+        rc.add_local_ref(oid)
+        rc.add_borrow(oid, "peer:1")
+        rc.remove_local_ref(oid)
+        assert freed == [], "owner freed object a borrower still holds"
+        rc.remove_borrow(oid, "peer:1")
+        assert freed == [oid]
+
+    def test_pin_zero_with_borrow_does_not_free(self):
+        freed = []
+        rc = ReferenceCounter(freed.append)
+        oid = _oid()
+        rc.pin_for_task(oid)
+        rc.add_borrow(oid, "peer:1")
+        rc.unpin_for_task(oid)
+        assert freed == []
+        rc.remove_borrow(oid, "peer:1")
+        assert freed == [oid]
+
+    def test_add_borrow_idempotent_per_borrower(self):
+        """N deserializations at one borrower send N ADD_BORROWs but only
+        one REMOVE_BORROW (when the borrower's own count hits zero): the
+        owner must track presence, not a count."""
+        freed = []
+        rc = ReferenceCounter(freed.append)
+        oid = _oid()
+        rc.add_borrow(oid, "peer:1")
+        rc.add_borrow(oid, "peer:1")
+        rc.add_borrow(oid, "peer:1")
+        rc.remove_borrow(oid, "peer:1")
+        assert freed == [oid], "asymmetric borrow accounting leaked"
+
+    def test_borrower_death_drops_all_its_borrows(self):
+        freed = []
+        rc = ReferenceCounter(freed.append)
+        a, b = _oid(1), _oid(2)
+        rc.add_borrow(a, "peer:1")
+        rc.add_borrow(b, "peer:1")
+        rc.add_borrow(b, "peer:2")
+        rc.remove_borrower("peer:1")
+        assert a in freed and b not in freed
+        rc.remove_borrower("peer:2")
+        assert b in freed
+
+    def test_multiple_borrowers(self):
+        freed = []
+        rc = ReferenceCounter(freed.append)
+        oid = _oid()
+        rc.add_borrow(oid, "peer:1")
+        rc.add_borrow(oid, "peer:2")
+        rc.remove_borrow(oid, "peer:1")
+        assert freed == []
+        rc.remove_borrow(oid, "peer:2")
+        assert freed == [oid]
+
+
+@pytest.fixture()
+def cluster():
+    from ray_tpu.cluster_utils import ProcessCluster
+    ray_tpu.shutdown()
+    c = ProcessCluster(num_daemons=2, num_cpus=2)
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_owner_drop_while_borrower_holds(cluster):
+    """Driver puts an object, hands the ref to a long-lived actor, drops its
+    own handle: the object must survive at the owner until the borrower
+    releases it (reference_count.h:61 owned-by-borrowed-from contract)."""
+    from ray_tpu._private import worker as _worker
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.box = None
+
+        def hold(self, box):
+            self.box = box  # keeps the nested ref alive on the daemon
+            return True
+
+        def read(self):
+            return int(ray_tpu.get(self.box["ref"]).sum())
+
+    data = np.arange(100000)  # ~800KB: too big to inline
+    expected = int(data.sum())
+    ref = ray_tpu.put(data)
+    oid = ref.id()
+    rt = _worker.global_worker().runtime
+
+    h = Holder.remote()
+    assert ray_tpu.get(h.hold.remote({"ref": ref}), timeout=60)
+    # Wait until the daemon's ADD_BORROW lands at the owner (async, FIFO).
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if rt.reference_counter._borrows.get(oid):
+            break
+        time.sleep(0.05)
+    assert rt.reference_counter._borrows.get(oid), "borrow never registered"
+
+    del ref
+    gc.collect()
+    time.sleep(0.5)
+    assert rt.local_node.store.contains(oid), \
+        "owner freed the object while a borrower still holds it"
+    assert ray_tpu.get(h.read.remote(), timeout=60) == expected
+
+
+def test_borrower_death_frees_object(cluster):
+    """When the borrowing daemon dies, its borrows are dropped; once the
+    driver also drops its handle the object is freed."""
+    from ray_tpu._private import worker as _worker
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.box = None
+
+        def hold(self, box):
+            self.box = box
+            return True
+
+    data = np.arange(100000)
+    ref = ray_tpu.put(data)
+    oid = ref.id()
+    rt = _worker.global_worker().runtime
+
+    h = Holder.remote()
+    assert ray_tpu.get(h.hold.remote({"ref": ref}), timeout=60)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if rt.reference_counter._borrows.get(oid):
+            break
+        time.sleep(0.05)
+    assert rt.reference_counter._borrows.get(oid)
+
+    # Find which daemon hosts the actor via its borrow address.
+    borrower_addr = next(iter(rt.reference_counter._borrows[oid]))
+    victim = next(i for i, d in enumerate(cluster.daemons)
+                  if d["address"] == borrower_addr)
+    cluster.kill_daemon(victim)
+
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if not rt.reference_counter._borrows.get(oid):
+            break
+        time.sleep(0.1)
+    assert not rt.reference_counter._borrows.get(oid), \
+        "dead borrower's borrow never dropped"
+
+    del ref
+    gc.collect()
+    # The serialize-time pin of the hold() push is released after a
+    # borrow-registration grace period; allow for it before asserting.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if not rt.local_node.store.contains(oid):
+            break
+        time.sleep(0.1)
+    assert not rt.local_node.store.contains(oid), \
+        "object not freed after all refs and borrows gone"
