@@ -60,18 +60,6 @@ def _chip_peak_flops():
     return kind, None
 
 
-def _compiled_flops(jitted, *args):
-    """Per-invocation FLOPs from XLA's cost analysis (None if unavailable)."""
-    try:
-        cost = jitted.lower(*args).compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else {}
-        flops = float(cost.get("flops", 0.0))
-        return flops if flops > 0 else None
-    except Exception:
-        return None
-
-
 def _timed_scan(step_fn, state, n_steps):
     """jit a lax.scan of ``n_steps`` steps; returns (state, elapsed_s, flops).
 
@@ -83,11 +71,24 @@ def _timed_scan(step_fn, state, n_steps):
         return jax.lax.scan(step_fn, state, xs)
 
     xs = jnp.arange(n_steps)
-    flops = _compiled_flops(run, state, xs)
-    state, out = run(state, xs)
+    # Compile exactly once: execute the SAME Compiled object the cost
+    # analysis came from (re-invoking the jit wrapper would recompile —
+    # .lower().compile() does not seed the dispatch cache).
+    flops = None
+    try:
+        compiled = run.lower(state, xs).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        f = float((cost or {}).get("flops", 0.0))
+        flops = f if f > 0 else None
+        run_fn = compiled
+    except Exception:
+        run_fn = run
+    state, out = run_fn(state, xs)
     jax.block_until_ready(out)
     t0 = time.perf_counter()
-    state, out = run(state, xs)
+    state, out = run_fn(state, xs)
     jax.block_until_ready(out)
     return state, time.perf_counter() - t0, flops
 

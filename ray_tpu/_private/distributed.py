@@ -250,7 +250,13 @@ class DistributedRuntime(Runtime):
     def _heartbeat_loop(self):
         while not self._hb_stop.wait(self._hb_interval):
             try:
-                avail = self.local_node.resources.available.to_dict()
+                # Explicit zeros for exhausted resources: ResourceSet
+                # arithmetic drops zero entries, and an empty availability
+                # map reads as "no update" at the state service — a fully
+                # busy node would advertise full capacity forever.
+                total = self.local_node.resources.total.to_dict()
+                now = self.local_node.resources.available.to_dict()
+                avail = {k: now.get(k, 0.0) for k in total}
                 recognized = self.state.heartbeat(
                     self.local_node.node_id.binary(), avail)
                 if not recognized:
@@ -1854,6 +1860,25 @@ class DistributedRuntime(Runtime):
             self._reply_bytes_cache.pop(stale_key, None)
         ctx.reply(data)
 
+    def _actor_alloc_target(self, options, node):
+        """Allocation source for a remotely-created actor: its placement
+        group's bundle on this node, or the node free pool (mirrors
+        _allocation_target for pushed tasks)."""
+        pg = getattr(options, "placement_group", None)
+        if pg is None:
+            return node.resources
+        idx = getattr(options, "placement_group_bundle_index", -1)
+        if idx is not None and idx >= 0:
+            return node.bundles.get((pg.id, idx))
+        request = options.resources
+        for (pgid, i), br in node.bundles.items():
+            if pgid == pg.id and br.can_fit(request):
+                return br
+        for (pgid, i), br in node.bundles.items():
+            if pgid == pg.id:
+                return br
+        return None
+
     def _handle_create_actor(self, ctx: RpcContext):
         msg = pb.ActorSpecMsg()
         msg.ParseFromString(ctx.body)
@@ -1881,13 +1906,37 @@ class DistributedRuntime(Runtime):
         with self.lock:
             self.actors[state.actor_id] = state
         node = self.local_node
-        deadline = time.monotonic() + _config.get("worker_lease_timeout_s")
+        # Short capacity wait only: a busy node must spill back fast so the
+        # creator can re-place on a peer instead of burning its whole lease
+        # budget blocked on us (raylet-style immediate rejection).
+        deadline = time.monotonic() + min(
+            2.0, _config.get("worker_lease_timeout_s"))
+        first_pass = True
         while True:
             with self.lock:
-                if node.resources.can_fit(request):
-                    node.resources.allocate(request)
+                # Placement-group actors draw from their RESERVED bundle
+                # (the free pool was already debited at RESERVE_BUNDLE).
+                target = self._actor_alloc_target(options, node)
+                if first_pass:
+                    first_pass = False
+                    logger.debug("create %s: target=%r fit=%s", msg.class_name,
+                                 target, target is not None
+                                 and target.can_fit(request))
+                if target is not None and target.can_fit(request):
+                    target.allocate(request)
                     break
             if time.monotonic() > deadline:
+                with self.lock:
+                    self.actors.pop(state.actor_id, None)  # never hosted
+                pg = getattr(options, "placement_group", None)
+                logger.debug(
+                    "spillback CREATE_ACTOR %s: request=%s pg=%s idx=%s "
+                    "bundles=%s free=%s", msg.class_name, request,
+                    pg.id.hex()[:8] if pg is not None else None,
+                    getattr(options, "placement_group_bundle_index", None),
+                    [(k[0].hex()[:8], k[1], str(v.available))
+                     for k, v in node.bundles.items()],
+                    node.resources.available)
                 rep = pb.CreateActorReply(status="spillback")
                 for k, v in node.resources.available.to_dict().items():
                     rep.available.amounts[k] = v

@@ -39,6 +39,18 @@ def main(argv=None) -> int:
         level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
         format="[daemon %(asctime)s] %(levelname)s %(message)s")
 
+    # Honor JAX_PLATFORMS even when a site hook already imported jax and a
+    # device plugin claimed the default platform (the env var alone is read
+    # too early to win) — a CPU test daemon must never initialize the TPU.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            import jax
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            logging.warning("could not pin jax platform to %r", plat,
+                            exc_info=True)
+
     from ray_tpu._private import worker as _worker
     from ray_tpu._private.distributed import DistributedRuntime
     from ray_tpu._private.resources import CPU, TPU, ResourceSet

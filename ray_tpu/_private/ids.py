@@ -54,6 +54,13 @@ class BaseID:
     def __eq__(self, other):
         return type(other) is type(self) and other._bytes == self._bytes
 
+    def __reduce__(self):
+        # Rebuild through __init__ so _hash is recomputed in the receiving
+        # process: Python string hashing is randomized PER PROCESS, and a
+        # verbatim-copied _hash (the __slots__ default pickling) makes
+        # unpickled ids miss dict lookups against locally-built keys.
+        return (type(self), (self._bytes,))
+
     def __repr__(self):
         return f"{type(self).__name__}({self.hex()[:16]})"
 

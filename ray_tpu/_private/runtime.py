@@ -563,8 +563,10 @@ class Runtime:
     def _allocation_target(self, spec: TaskSpec, node: Node):
         pg = spec.options.placement_group
         if pg is not None:
+            # NOTE: resolved via node.bundles only — an executing daemon
+            # holds the reserved bundles but NOT the creator's
+            # placement_groups table, and release paths must work there.
             idx = spec.options.placement_group_bundle_index
-            pg_state = self.placement_groups[pg.id]
             if idx < 0:
                 # Any bundle on this node with room.
                 for (pgid, i), br in node.bundles.items():

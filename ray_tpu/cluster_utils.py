@@ -67,7 +67,12 @@ class ProcessCluster:
     def __init__(self, num_daemons: int = 2, num_cpus: float = 2,
                  resources: Optional[Dict[str, float]] = None,
                  data_dir: str = "", heartbeat_timeout_ms: float = 3000,
-                 daemon_heartbeat_s: float = 0.5):
+                 daemon_heartbeat_s: float = 0.5,
+                 tp_cpu_devices: int = 0):
+        """``tp_cpu_devices`` > 0 gives every daemon that many virtual CPU
+        JAX devices and enables Gloo collectives, so tensor-plane tests can
+        run compiled cross-process collectives without TPUs (see
+        collective/tensor_plane.py)."""
         import subprocess
         import sys
         import tempfile
@@ -79,7 +84,8 @@ class ProcessCluster:
         self.daemons = []
         self._daemon_args = dict(num_cpus=num_cpus,
                                  resources=resources or {},
-                                 heartbeat_s=daemon_heartbeat_s)
+                                 heartbeat_s=daemon_heartbeat_s,
+                                 tp_cpu_devices=tp_cpu_devices)
         for _ in range(num_daemons):
             self.add_daemon()
 
@@ -104,6 +110,15 @@ class ProcessCluster:
                "--ready-file", ready]
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")  # daemons in tests stay CPU
+        tp_n = self._daemon_args.get("tp_cpu_devices") or 0
+        if tp_n:
+            env["RAY_TPU_TP_CPU_DEVICES"] = str(tp_n)
+            # jax_num_cpu_devices (set at tensor-plane join) loses to an
+            # inherited force_host_platform_device_count; strip it so the
+            # daemon gets exactly tp_n devices.
+            flags = [f for f in env.get("XLA_FLAGS", "").split()
+                     if "xla_force_host_platform_device_count" not in f]
+            env["XLA_FLAGS"] = " ".join(flags)
         proc = subprocess.Popen(cmd, env=env)
         deadline = _time.monotonic() + 60
         addr = None

@@ -24,6 +24,21 @@ from ray_tpu.collective.types import Backend, ReduceOp
 _registry_lock = threading.Lock()
 _shared_groups: Dict[str, Any] = {}        # group_name -> Shared state
 _local_groups = threading.local()          # per-caller rank-bound groups
+_process_joined: set = set()               # process-level plane memberships
+
+
+def _spans_processes() -> bool:
+    """True when this caller is one rank of a PROCESS-spanning group: it
+    runs inside a cluster daemon (DistributedRuntime executor), so sibling
+    ranks live in other daemons and the in-process thread rendezvous can
+    never see them. Drivers and single-process runtimes keep the
+    thread-shared groups."""
+    from ray_tpu._private import worker as _worker
+    runtime = _worker.try_global_runtime()
+    if runtime is None:
+        return False
+    from ray_tpu._private.distributed import DistributedRuntime
+    return isinstance(runtime, DistributedRuntime) and not runtime.is_driver
 
 
 class GroupManager:
@@ -39,6 +54,27 @@ class GroupManager:
     def create_group(cls, backend: str, world_size: int, rank: int,
                      group_name: str, devices: Optional[List] = None):
         backend = Backend(backend)
+        if backend == Backend.XLA and devices is None and _spans_processes():
+            # Rank-per-process group: ranks live in different daemon
+            # processes, rendezvous through the state-service KV and the
+            # JAX multi-controller runtime (the reference's NCCL-group
+            # path, nccl_collective_group.py:127). Pass ``devices`` to
+            # bind multiple ranks inside ONE daemon to local devices via
+            # the thread-rendezvous group instead.
+            from ray_tpu.collective.collective_group.xla_process_group import (
+                XLAProcessGroup)
+            with _registry_lock:
+                if group_name in _process_joined:
+                    raise RuntimeError(
+                        f"a rank of group {group_name!r} already joined "
+                        f"from this process; one process is one rank on "
+                        f"the tensor plane (libtpu single-owner). Place "
+                        f"one worker per host daemon, or pass devices= "
+                        f"for an intra-process group.")
+                _process_joined.add(group_name)
+            g = XLAProcessGroup(world_size, rank, group_name)
+            cls._groups()[group_name] = g
+            return g
         with _registry_lock:
             shared = _shared_groups.get(group_name)
             if shared is None:

@@ -1,0 +1,182 @@
+"""Cross-process XLA collective group: ranks are daemon processes.
+
+This is the NCCL-communicator replacement for groups whose ranks live in
+DIFFERENT OS processes (on hardware: different TPU hosts). The reference
+builds per-process NCCL communicators from a rendezvous'd NCCLUniqueID
+(``python/ray/util/collective/collective_group/nccl_collective_group.py:127``);
+the TPU-native equivalent joins the JAX multi-controller runtime through
+the state-service KV (``collective/tensor_plane.py``) and then expresses
+every group op as ONE jitted program over a mesh of one lead device per
+process — XLA lowers the ``psum``/``all_gather``/``psum_scatter`` onto
+ICI/DCN (Gloo on CPU test clusters).
+
+Multi-controller contract: every rank (process) must invoke the same op in
+the same order — true of collectives by definition. ``send``/``recv`` are
+point-to-point and therefore CANNOT ride a compiled program only two
+processes run; they transit the state-service KV (control-plane path,
+meant for small tensors — bulk data belongs to the object plane).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.collective.types import ReduceOp
+
+P2P_NS = b"tplane-p2p"
+
+_REDUCE = {
+    ReduceOp.SUM: lambda a: jnp.sum(a, axis=0),
+    ReduceOp.PRODUCT: lambda a: jnp.prod(a, axis=0),
+    ReduceOp.MAX: lambda a: jnp.max(a, axis=0),
+    ReduceOp.MIN: lambda a: jnp.min(a, axis=0),
+}
+
+
+class XLAProcessGroup:
+    """Rank-per-process collective group over the active tensor plane."""
+
+    def __init__(self, world_size: int, rank: int, group_name: str,
+                 num_cpu_devices: Optional[int] = None, epoch: int = 0,
+                 runtime=None):
+        from ray_tpu.collective.tensor_plane import init_tensor_plane
+        self.world_size = world_size
+        self.rank = rank
+        self.group_name = group_name
+        init_tensor_plane(group_name, world_size, rank, epoch=epoch,
+                          num_cpu_devices=num_cpu_devices, runtime=runtime)
+        by_proc: Dict[int, Any] = {}
+        for d in jax.devices():
+            by_proc.setdefault(d.process_index, d)
+        if len(by_proc) != world_size:
+            raise RuntimeError(
+                f"tensor plane has {len(by_proc)} processes, group wants "
+                f"{world_size}")
+        self._leads = [by_proc[i] for i in sorted(by_proc)]
+        self._local_lead = by_proc[jax.process_index()]
+        self.mesh = Mesh(np.array(self._leads), ("p",))
+        self._p2p_seq: Dict[tuple, int] = {}
+        self._programs: Dict[tuple, Any] = {}  # per-instance, dies with us
+
+    # -- plumbing -------------------------------------------------------------
+
+    def _stacked(self, tensor):
+        """The group-wide (world, *shape) array: this process contributes
+        its slice on its lead device; peers contribute theirs."""
+        x = jnp.asarray(tensor)
+        local = jax.device_put(x[None], self._local_lead)
+        sharding = NamedSharding(self.mesh, P("p", *([None] * x.ndim)))
+        arr = jax.make_array_from_single_device_arrays(
+            (self.world_size,) + x.shape, sharding, [local])
+        return arr
+
+    def _program(self, kind: str, op: Optional[ReduceOp], root: int):
+        """One jitted program per op kind (jit re-specializes per shape).
+        Cached per instance so destroyed groups release their programs."""
+        key = (kind, op, root)
+        fn = self._programs.get(key)
+        if fn is not None:
+            return fn
+        replicated = NamedSharding(self.mesh, P())
+        scattered = NamedSharding(self.mesh, P("p"))
+        if kind in ("allreduce", "reduce"):
+            fn = jax.jit(_REDUCE[op], out_shardings=replicated)
+        elif kind == "broadcast":
+            fn = jax.jit(lambda a: a[root], out_shardings=replicated)
+        elif kind == "allgather":
+            fn = jax.jit(lambda a: a, out_shardings=replicated)
+        elif kind == "reducescatter":
+            # Each rank contributed (world, chunk...); reduce across ranks
+            # then keep the rank'th chunk sharded back onto the lead mesh.
+            fn = jax.jit(lambda a: _REDUCE[op](a), out_shardings=scattered)
+        else:
+            raise ValueError(kind)
+        self._programs[key] = fn
+        return fn
+
+    @staticmethod
+    def _local_value(arr):
+        return jnp.asarray(arr.addressable_data(0))
+
+    # -- ops (every process must call, same order) ---------------------------
+
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        out = self._program("allreduce", op, 0)(self._stacked(tensor))
+        return self._local_value(out)
+
+    def reduce(self, tensor, root_rank: int = 0, op: ReduceOp = ReduceOp.SUM):
+        out = self._local_value(
+            self._program("reduce", op, 0)(self._stacked(tensor)))
+        return out if self.rank == root_rank else jnp.asarray(tensor)
+
+    def broadcast(self, tensor, root_rank: int = 0):
+        out = self._program("broadcast", None, root_rank)(
+            self._stacked(tensor))
+        return self._local_value(out)
+
+    def allgather(self, tensor):
+        out = self._program("allgather", None, 0)(self._stacked(tensor))
+        return self._local_value(out)
+
+    def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        """Each rank contributes a tensor whose leading dim divides into
+        ``world_size`` chunks; rank r receives chunk r of the reduction
+        (same contract as the in-process groups, test_collective.py:78)."""
+        x = jnp.asarray(tensor)
+        if x.shape[0] % self.world_size:
+            raise ValueError(
+                f"reducescatter leading dim {x.shape[0]} not divisible by "
+                f"world size {self.world_size}")
+        chunk = x.shape[0] // self.world_size
+        chunks = x.reshape((self.world_size, chunk) + x.shape[1:])
+        arr = self._stacked(chunks)  # (world, world, chunk...)
+        out = self._program("reducescatter", op, 0)(arr)
+        return self._local_value(out)[0]
+
+    def barrier(self):
+        self.allreduce(jnp.zeros((), jnp.int32))
+
+    # -- p2p over the state KV (control-plane; small tensors) ----------------
+
+    def _kv(self):
+        from ray_tpu._private import worker as _worker
+        runtime = _worker.try_global_runtime()
+        state = getattr(runtime, "state", None)
+        if state is None:
+            raise RuntimeError("p2p needs the cluster state service")
+        return state
+
+    def send(self, tensor, dst_rank: int):
+        import pickle
+        seq = self._p2p_seq.get(("s", dst_rank), 0)
+        self._p2p_seq[("s", dst_rank)] = seq + 1
+        key = f"{self.group_name}/{self.rank}>{dst_rank}/{seq}".encode()
+        self._kv().kv_put(key, pickle.dumps(np.asarray(tensor)),
+                          overwrite=True, namespace=P2P_NS)
+
+    def recv(self, src_rank: int, timeout_s: float = 30.0):
+        import pickle
+        seq = self._p2p_seq.get(("r", src_rank), 0)
+        self._p2p_seq[("r", src_rank)] = seq + 1
+        key = f"{self.group_name}/{src_rank}>{self.rank}/{seq}".encode()
+        kv = self._kv()
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            raw = kv.kv_get(key, namespace=P2P_NS)
+            if raw is not None:
+                kv.kv_del(key, namespace=P2P_NS)
+                return jnp.asarray(pickle.loads(raw))
+            time.sleep(0.005)
+        raise TimeoutError(f"recv from rank {src_rank} timed out")
+
+    def destroy(self):
+        # The tensor plane outlives individual groups (other groups and the
+        # trainer share it); it is torn down by shutdown_tensor_plane() or
+        # superseded when a new epoch re-forms.
+        pass

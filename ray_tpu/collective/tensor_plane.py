@@ -40,8 +40,8 @@ _lock = threading.Lock()
 _active_plane: Optional[dict] = None  # {"group", "epoch", "world", "rank"}
 
 
-def _kv(runtime=None):
-    """The state-service KV of the current (or given) distributed runtime."""
+def _runtime_and_kv(runtime=None):
+    """The distributed runtime + its state-service KV."""
     if runtime is None:
         from ray_tpu._private import worker as _worker
         runtime = _worker.try_global_runtime()
@@ -50,7 +50,7 @@ def _kv(runtime=None):
         raise RuntimeError(
             "tensor plane needs a cluster (ray_tpu.init(address=...) or a "
             "host daemon); no state service in this process")
-    return state
+    return runtime, state
 
 
 def _free_port(host: str = "127.0.0.1") -> int:
@@ -79,7 +79,7 @@ def init_tensor_plane(group_name: str, world_size: int, rank: int,
     """
     import jax
 
-    state = _kv(runtime)
+    runtime, state = _runtime_and_kv(runtime)
     key = f"{group_name}/{epoch}".encode()
 
     with _lock:
@@ -100,17 +100,31 @@ def init_tensor_plane(group_name: str, world_size: int, rank: int,
             _active_plane = None
 
     # CPU test clusters: virtual devices + gloo collectives. Must land
-    # before the backend initializes; harmless no-ops otherwise.
+    # before the backend initializes; harmless no-ops otherwise. Daemons
+    # advertise their device count via RAY_TPU_TP_CPU_DEVICES (set by
+    # ProcessCluster) so worker actors need no explicit argument.
+    import os
+    if num_cpu_devices is None:
+        env_n = os.environ.get("RAY_TPU_TP_CPU_DEVICES")
+        if env_n:
+            num_cpu_devices = int(env_n)
     if num_cpu_devices is not None:
         try:
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
-            jax.config.update("jax_num_cpu_devices", int(num_cpu_devices))
+            if "xla_force_host_platform_device_count" not in os.environ.get(
+                    "XLA_FLAGS", ""):
+                jax.config.update("jax_num_cpu_devices",
+                                  int(num_cpu_devices))
         except Exception:
             logger.warning("could not configure cpu collectives",
                            exc_info=True)
 
     if rank == 0:
-        host = "127.0.0.1"
+        # Advertise the host peers can actually reach: the address this
+        # daemon registered with the cluster (loopback only on
+        # single-machine test clusters).
+        addr = getattr(runtime, "address", "") or "127.0.0.1:0"
+        host = addr.rsplit(":", 1)[0] or "127.0.0.1"
         coord = f"{host}:{_free_port(host)}"
         state.kv_put(key, f"{coord}|{world_size}".encode(),
                      overwrite=True, namespace=KV_NS)

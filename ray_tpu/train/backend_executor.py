@@ -11,6 +11,7 @@ detection surfaced to the trainer for restart-from-checkpoint
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
@@ -20,6 +21,7 @@ from ray_tpu.util.placement_group import placement_group, remove_placement_group
 from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
 
 _FINISHED = "__finished__"
+_GROUP_SEQ = 0
 
 
 @ray_tpu.remote
@@ -38,20 +40,32 @@ class RayTrainWorker:
         mesh = None
         try:
             import jax
-            # Each worker gets a disjoint slice of ITS HOST's devices for its
-            # intra-worker mesh; the data-parallel split ACROSS workers is the
-            # collective group's job. Use local devices + the worker's rank
-            # among co-hosted workers (global rank would misalign slices when
-            # workers span hosts).
-            devs = jax.local_devices()
-            hosts = max(1, jax.process_count())
-            workers_per_host = max(1, -(-self.world_size // hosts))
-            local_rank = self.rank % workers_per_host
-            if len(devs) >= workers_per_host:
-                per = len(devs) // workers_per_host
-                local = devs[local_rank * per:(local_rank + 1) * per]
-                from ray_tpu.parallel import MeshConfig, build_mesh
-                mesh = build_mesh(MeshConfig(data=len(local)), local)
+            from ray_tpu.collective.collective import GroupManager
+            from ray_tpu.collective.collective_group.xla_process_group import (
+                XLAProcessGroup)
+            from ray_tpu.parallel import MeshConfig, build_mesh
+            g = GroupManager.get_group(group_name) if group_name else None
+            if isinstance(g, XLAProcessGroup):
+                # Tensor plane spans worker PROCESSES: the session mesh is
+                # the GLOBAL device mesh, and the DP gradient psum compiles
+                # across hosts (the reference's per-worker process group,
+                # train/torch/config.py:54-96, without the wrapper module).
+                devs = jax.devices()
+                mesh = build_mesh(MeshConfig(data=len(devs)), devs)
+            else:
+                # Each worker gets a disjoint slice of ITS HOST's devices
+                # for its intra-worker mesh; the data-parallel split ACROSS
+                # workers is the collective group's job. Use local devices
+                # + the worker's rank among co-hosted workers (global rank
+                # would misalign slices when workers span hosts).
+                devs = jax.local_devices()
+                hosts = max(1, jax.process_count())
+                workers_per_host = max(1, -(-self.world_size // hosts))
+                local_rank = self.rank % workers_per_host
+                if len(devs) >= workers_per_host:
+                    per = len(devs) // workers_per_host
+                    local = devs[local_rank * per:(local_rank + 1) * per]
+                    mesh = build_mesh(MeshConfig(data=len(local)), local)
         except Exception:
             mesh = None
         self.session = session_mod._init_session(
@@ -149,7 +163,12 @@ class BackendExecutor:
         ray_tpu.get([w.ping.remote() for w in self.workers])
         if self.collective_backend:
             from ray_tpu.collective import create_collective_group
-            self.group_name = f"train_{id(self)}"
+            global _GROUP_SEQ
+            _GROUP_SEQ += 1
+            # Unique per attempt AND per process lifetime: a recycled
+            # id(self) must never alias a previous attempt's tensor-plane
+            # rendezvous keys.
+            self.group_name = f"train_{os.getpid()}_{_GROUP_SEQ}"
             create_collective_group(
                 self.workers, self.num_workers,
                 list(range(self.num_workers)),

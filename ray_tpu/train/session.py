@@ -96,3 +96,25 @@ def get_collective_group_name() -> Optional[str]:
     group (None when the trainer was built with collective_backend=None)."""
     s = _get_session()
     return s.collective_group_name if s else None
+
+
+def shard_batch(array, spec=None):
+    """Place this worker's LOCAL batch onto the session mesh's ``data``
+    axis as one global array. On a process-spanning mesh (multi-host
+    tensor plane) each worker contributes its shard
+    (``jax.make_array_from_process_local_data``); single-process meshes
+    just device_put with the sharding. The returned array feeds a pjit'd
+    step whose gradient psum then rides the compiled collectives."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    s = _get_session()
+    if s is None or s.mesh is None:
+        raise RuntimeError("shard_batch() needs a session with a mesh")
+    if spec is None:
+        spec = P("data")
+    sharding = NamedSharding(s.mesh, spec)
+    arr = np.asarray(array)
+    if jax.process_count() > 1:
+        return jax.make_array_from_process_local_data(sharding, arr)
+    return jax.device_put(arr, sharding)
