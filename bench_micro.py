@@ -1,0 +1,161 @@
+"""Core-runtime microbenchmarks, ray_perf style.
+
+The task/actor/object-plane latency suite the reference tracks in
+``python/ray/_private/ray_perf.py:93`` (tasks/sec, actor calls/sec,
+put/get latency) — run against BOTH the in-process runtime and a real
+two-daemon ``ProcessCluster`` so the wire protocol, scheduler, and object
+plane are measured, not just Python dispatch.
+
+Usage:
+    python bench_micro.py [--mode inproc|cluster|both] [--out FILE]
+
+Prints one JSON line per metric; --out also writes them as a JSON array
+(tracked round-over-round in BENCH_MICRO.json).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import numpy as np
+
+RESULTS = []
+
+
+def emit(metric: str, value: float, unit: str):
+    row = {"metric": metric, "value": round(value, 2), "unit": unit}
+    RESULTS.append(row)
+    print(json.dumps(row), flush=True)
+
+
+def bench_tasks(prefix: str, n: int = 2000):
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0.01)
+    def tiny():
+        return 1
+
+    ray_tpu.get([tiny.remote() for _ in range(50)])  # warm the path
+    t0 = time.perf_counter()
+    ray_tpu.get([tiny.remote() for _ in range(n)])
+    el = time.perf_counter() - t0
+    emit(f"{prefix}_tasks_per_second", n / el, "tasks/s")
+
+
+def bench_actor_calls(prefix: str, n: int = 1000):
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0.01)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    ray_tpu.get(c.inc.remote())
+    # Sequential round-trips (latency-bound).
+    t0 = time.perf_counter()
+    for _ in range(n // 4):
+        ray_tpu.get(c.inc.remote())
+    el = time.perf_counter() - t0
+    emit(f"{prefix}_actor_roundtrips_per_second", (n // 4) / el, "calls/s")
+    # Pipelined (throughput-bound; the reference's async actor bench).
+    t0 = time.perf_counter()
+    ray_tpu.get([c.inc.remote() for _ in range(n)])
+    el = time.perf_counter() - t0
+    emit(f"{prefix}_actor_calls_per_second", n / el, "calls/s")
+    ray_tpu.kill(c)
+
+
+def bench_put_get(prefix: str):
+    import ray_tpu
+    small = np.zeros(128, np.int64)  # ~1KB
+    t0 = time.perf_counter()
+    n = 1000
+    for _ in range(n):
+        ray_tpu.get(ray_tpu.put(small))
+    el = time.perf_counter() - t0
+    emit(f"{prefix}_put_get_1kb_us", el / n * 1e6, "us")
+
+    big = np.zeros((64, 1024, 1024), np.uint8)  # 64 MB
+    t0 = time.perf_counter()
+    for _ in range(3):
+        ray_tpu.get(ray_tpu.put(big))
+    el = time.perf_counter() - t0
+    emit(f"{prefix}_put_get_64mb_gbps", 3 * big.nbytes / el / 1e9, "GB/s")
+
+
+def bench_remote_fetch(prefix: str, mb: int = 32):
+    """Cross-daemon object pull: a large result produced on a daemon,
+    fetched by the driver over FETCH_OBJECT chunks."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def produce():
+        return np.zeros((mb, 1024, 1024), np.uint8)
+
+    ray_tpu.get(produce.remote(), timeout=120)  # warm
+    t0 = time.perf_counter()
+    out = ray_tpu.get(produce.remote(), timeout=120)
+    el = time.perf_counter() - t0
+    emit(f"{prefix}_remote_fetch_gbps", out.nbytes / el / 1e9, "GB/s")
+
+
+def run_inproc():
+    import ray_tpu
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=float(os.cpu_count() or 8))
+    bench_tasks("inproc")
+    bench_actor_calls("inproc")
+    bench_put_get("inproc")
+    ray_tpu.shutdown()
+
+
+def run_cluster():
+    import ray_tpu
+    from ray_tpu.cluster_utils import ProcessCluster
+    ray_tpu.shutdown()
+    c = ProcessCluster(num_daemons=2, num_cpus=float(os.cpu_count() or 8))
+    ray_tpu.init(address=c.address)
+    try:
+        bench_tasks("cluster", n=1000)
+        bench_actor_calls("cluster", n=500)
+        bench_remote_fetch("cluster")
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+def main():
+    # Honor JAX_PLATFORMS even when a site hook pre-registered a device
+    # plugin that overrides the default platform (same pin host_daemon
+    # applies): these benches measure the RUNTIME, not the accelerator.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            import jax
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=["inproc", "cluster", "both"],
+                    default="both")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    if args.mode in ("inproc", "both"):
+        run_inproc()
+    if args.mode in ("cluster", "both"):
+        run_cluster()
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(RESULTS, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

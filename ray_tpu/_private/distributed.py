@@ -586,8 +586,14 @@ class DistributedRuntime(Runtime):
                 pass
             if deadline is not None and time.monotonic() > deadline:
                 raise exc.GetTimeoutError(f"get({oid}) timed out")
-            time.sleep(backoff)
+            # Event-driven: a local seal wakes us immediately; the backoff
+            # bounds how often we re-probe REMOTE locations.
+            self._wait_for_seal(lambda: self._sealed_locally(oid), backoff)
             backoff = min(backoff * 2, 0.1)
+
+    def _sealed_locally(self, oid: ObjectID) -> bool:
+        return (self.local_node.store.contains(oid)
+                or oid in self._completed_returns)
 
     def _inflight_for_return(self, oid: ObjectID) -> Optional[dict]:
         with self._inflight_lock:
@@ -1088,6 +1094,7 @@ class DistributedRuntime(Runtime):
                             self._owner_addr.setdefault(rid, addr)
                         self._completed_returns.add(rid)
                     self.task_states[spec.task_id] = "FINISHED"
+            self._notify_sealed()  # wake get()/wait() blocked on the seal cv
             self._unpin_args(spec)
             self._fire_completion(spec)
         finally:
@@ -1665,7 +1672,9 @@ class DistributedRuntime(Runtime):
                 if self.local_node.store.contains(oid):
                     ready = True
                     break
-                time.sleep(0.005)
+                self._wait_for_seal(
+                    lambda: self.local_node.store.contains(oid),
+                    min(0.25, max(0.0, deadline - time.monotonic())))
             ctx.reply(pb.WaitObjectReply(ready=ready).SerializeToString())
         elif method == pb.DRAIN:
             ctx.reply()

@@ -202,6 +202,10 @@ class Runtime:
 
         # object directory: ObjectID -> NodeID (owner store)
         self.object_locations: Dict[ObjectID, NodeID] = {}
+        # Seal notifications: get()/wait() block here instead of polling;
+        # every seal_return/seal_error wakes the waiters (the reference's
+        # plasma object-ready notification path).
+        self._seal_cv = threading.Condition()
         # lineage: ObjectID -> TaskSpec that produces it
         self.lineage: Dict[ObjectID, TaskSpec] = {}
         self.task_states: Dict[TaskID, str] = {}
@@ -294,11 +298,42 @@ class Runtime:
         node.store.put(oid, value)
         with self.lock:
             self.object_locations[oid] = node.node_id
+        self._notify_sealed()
 
     def seal_error(self, oid: ObjectID, error: BaseException, node: Node):
         node.store.put_error(oid, error)
         with self.lock:
             self.object_locations[oid] = node.node_id
+        self._notify_sealed()
+
+    def _notify_sealed(self):
+        with self._seal_cv:
+            self._seal_cv.notify_all()
+
+    def _wait_for_seal(self, ready_pred, max_wait_s: float):
+        """Block until ``ready_pred()`` or ``max_wait_s`` elapsed; wakes on
+        seal notifications. The predicate is evaluated under the condvar
+        (sealers notify under it too) so a seal landing between the
+        caller's check and the wait is never lost, and unrelated seals
+        don't end the wait early (the loop re-waits until the deadline).
+        Predicates must be CHEAP and must NOT take self.lock (they run
+        with the seal lock held; seal paths hold self.lock while
+        notifying) — check stores directly."""
+        deadline = time.monotonic() + max_wait_s
+        with self._seal_cv:
+            while not ready_pred():
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return
+                self._seal_cv.wait(remaining)
+
+    def _sealed_locally(self, oid: ObjectID) -> bool:
+        """Lock-free-ish readiness probe safe inside _wait_for_seal
+        predicates: store containment only, no runtime lock, no RPCs."""
+        for node in list(self.nodes.values()):
+            if node.alive and node.store.contains(oid):
+                return True
+        return False
 
     def get_object(self, oid: ObjectID, timeout: Optional[float] = None) -> Any:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -337,7 +372,7 @@ class Runtime:
                         f"object {oid} is lost and could not be reconstructed")
             if deadline is not None and time.monotonic() > deadline:
                 raise exc.GetTimeoutError(f"get({oid}) timed out")
-            time.sleep(0.005)
+            self._wait_for_seal(lambda: self._sealed_locally(oid), 0.05)
 
     def object_ready(self, oid: ObjectID) -> bool:
         node = self._locate(oid)

@@ -20,6 +20,17 @@ from ray_tpu.object_ref import ObjectRef
 
 _global_lock = threading.Lock()
 _global = None  # type: Optional["Worker"]
+# Subsystems with background threads that outlive the runtime unless torn
+# down with it (serve controller loop etc.) register a hook; shutdown()
+# drains them first so no stray thread auto-reinitializes the worker
+# between an explicit shutdown() and the next init().
+_shutdown_hooks: list = []
+
+
+def register_shutdown_hook(fn) -> None:
+    with _global_lock:
+        if fn not in _shutdown_hooks:
+            _shutdown_hooks.append(fn)
 
 
 class Worker:
@@ -103,6 +114,13 @@ def init(num_cpus: Optional[float] = None,
 def shutdown():
     global _global
     with _global_lock:
+        hooks, _shutdown_hooks[:] = list(_shutdown_hooks), []
+    for hook in hooks:
+        try:
+            hook()
+        except Exception:
+            pass
+    with _global_lock:
         if _global is not None:
             if getattr(_global, "dashboard_port", None) is not None:
                 from ray_tpu._private.state_server import stop_state_server
@@ -180,7 +198,12 @@ def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
             ready_list = [r for r in refs if r in ready_set]
             not_ready = [r for r in refs if r not in ready_set]
             return ready_list, not_ready
-        time.sleep(0.001)
+        # Wake as soon as any still-pending ref seals locally (checked
+        # under the seal condvar so nothing is lost); the 10ms cap covers
+        # completions that seal in another process.
+        pending = [r.id() for r in refs if r not in set(ready)]
+        w.runtime._wait_for_seal(
+            lambda: any(w.runtime._sealed_locally(o) for o in pending), 0.01)
 
 
 def kill(actor, *, no_restart: bool = True):

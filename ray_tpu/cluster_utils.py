@@ -92,49 +92,18 @@ class ProcessCluster:
     def add_daemon(self, num_cpus: Optional[float] = None,
                    resources: Optional[Dict[str, float]] = None,
                    num_tpus: float = 0):
-        import json
-        import subprocess
-        import sys
-        import tempfile
-        import time as _time
-        ready = tempfile.mktemp(prefix="raytpu_daemon_ready_")
-        cmd = [sys.executable, "-m", "ray_tpu._private.host_daemon",
-               "--state-addr", self.address,
-               "--num-cpus", str(num_cpus if num_cpus is not None
-                                 else self._daemon_args["num_cpus"]),
-               "--num-tpus", str(num_tpus),
-               "--resources", json.dumps(
-                   resources or self._daemon_args["resources"]),
-               "--heartbeat-interval-s",
-               str(self._daemon_args["heartbeat_s"]),
-               "--ready-file", ready]
-        env = dict(os.environ)
-        env.setdefault("JAX_PLATFORMS", "cpu")  # daemons in tests stay CPU
-        tp_n = self._daemon_args.get("tp_cpu_devices") or 0
-        if tp_n:
-            env["RAY_TPU_TP_CPU_DEVICES"] = str(tp_n)
-            # jax_num_cpu_devices (set at tensor-plane join) loses to an
-            # inherited force_host_platform_device_count; strip it so the
-            # daemon gets exactly tp_n devices.
-            flags = [f for f in env.get("XLA_FLAGS", "").split()
-                     if "xla_force_host_platform_device_count" not in f]
-            env["XLA_FLAGS"] = " ".join(flags)
-        proc = subprocess.Popen(cmd, env=env)
-        deadline = _time.monotonic() + 60
-        addr = None
-        while _time.monotonic() < deadline:
-            if os.path.exists(ready):
-                with open(ready) as f:
-                    addr = f.read().strip()
-                os.unlink(ready)
-                break
-            if proc.poll() is not None:
-                raise RuntimeError(
-                    f"daemon exited rc={proc.returncode} during startup")
-            _time.sleep(0.02)
-        if addr is None:
-            proc.kill()
-            raise TimeoutError("daemon did not become ready")
+        from ray_tpu._private.node import spawn_daemon
+        env = ({} if os.environ.get("JAX_PLATFORMS")
+               else {"JAX_PLATFORMS": "cpu"})  # test daemons stay CPU
+        proc, addr = spawn_daemon(
+            self.address,
+            num_cpus=(num_cpus if num_cpus is not None
+                      else self._daemon_args["num_cpus"]),
+            num_tpus=num_tpus,
+            resources=resources or self._daemon_args["resources"],
+            heartbeat_s=self._daemon_args["heartbeat_s"],
+            tp_cpu_devices=self._daemon_args.get("tp_cpu_devices") or 0,
+            env_overrides=env)
         self.daemons.append({"proc": proc, "address": addr})
         return addr
 

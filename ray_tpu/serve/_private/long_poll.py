@@ -53,6 +53,25 @@ class LongPollHost:
                 self._cond.wait(remaining)
 
 
+import weakref
+
+_live_clients: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def stop_all_clients(join_timeout_s: float = 3.0) -> None:
+    """Stop every live long-poll loop in this process AND join the threads:
+    serve shutdown calls this so no poller can slip one more .remote()
+    past the runtime teardown and auto-reinitialize the worker."""
+    clients = list(_live_clients)
+    for client in clients:
+        client.stop()
+    deadline = time.monotonic() + join_timeout_s
+    for client in clients:
+        t = getattr(client, "_thread", None)
+        if t is not None and t.is_alive():
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+
+
 class LongPollClient:
     """Background thread long-polling the controller for watched keys."""
 
@@ -64,6 +83,7 @@ class LongPollClient:
         self._listeners = dict(key_listeners)
         self._snapshot_ids = {k: 0 for k in self._listeners}
         self._stopped = threading.Event()
+        _live_clients.add(self)
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="serve-long-poll")
         self._thread.start()

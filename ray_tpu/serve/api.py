@@ -37,6 +37,8 @@ def start(detached: bool = True, http_host: Optional[str] = "127.0.0.1",
                     name=CONTROLLER_NAME, max_concurrency=64).remote()
                 # Wait until the controller is live.
                 ray_tpu.get(_controller.get_route_table.remote())
+            from ray_tpu._private.worker import register_shutdown_hook
+            register_shutdown_hook(shutdown)
         return _controller
 
 
@@ -190,7 +192,12 @@ def status() -> Dict[str, dict]:
 
 
 def shutdown() -> None:
+    """Stop the controller (and its control-loop thread) and the proxy.
+    Registered as a worker shutdown hook so a bare ray_tpu.shutdown()
+    cannot leave the loop running against a dead runtime."""
     global _controller, _proxy
+    from ray_tpu.serve._private.long_poll import stop_all_clients
+    stop_all_clients()
     with _client_lock:
         if _proxy is not None:
             _proxy.shutdown()

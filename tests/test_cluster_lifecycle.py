@@ -1,0 +1,104 @@
+"""Cluster lifecycle tests: start/stop/status CLI + supervised restart.
+
+Parity with the reference's ``ray start --head`` / ``--address`` / ``ray
+stop`` flow (``python/ray/scripts/scripts.py:532``) and the node process
+supervisor (``python/ray/_private/node.py:1061``): a head node and a
+worker node come up as supervised processes, a driver attaches via the
+published address, a SIGKILLed daemon is restarted by its supervisor, and
+``stop`` tears everything down.
+"""
+
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.scripts import cluster as cl
+
+
+def _read_pid(run_dir, name):
+    with open(os.path.join(run_dir, name)) as f:
+        return int(f.read().strip())
+
+
+def _wait(pred, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.25)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+@pytest.fixture()
+def lifecycle_dirs(tmp_path):
+    ray_tpu.shutdown()
+    head_dir = str(tmp_path / "head")
+    worker_dir = str(tmp_path / "worker")
+    yield head_dir, worker_dir
+    ray_tpu.shutdown()
+    for d in (worker_dir, head_dir):
+        cl.stop(d)
+
+
+def test_start_attach_restart_stop(lifecycle_dirs):
+    head_dir, worker_dir = lifecycle_dirs
+
+    # Terminal 1: start the head (state service + daemon, supervised).
+    addr = cl.start(head=True, num_cpus=2, run_dir=head_dir,
+                    heartbeat_timeout_ms=3000)
+    assert addr == cl.read_address(head_dir)
+
+    # Terminal 2: start a worker against the published address.
+    cl.start(address=addr, num_cpus=2, run_dir=worker_dir,
+             heartbeat_timeout_ms=3000)
+
+    info = cl.status(run_dir=head_dir)
+    assert sum(1 for n in info["nodes"] if n["alive"]) == 2
+
+    # Terminal 3: a driver attaches and uses both nodes.
+    ray_tpu.init(address=addr)
+
+    @ray_tpu.remote
+    def where(i):
+        return os.getpid(), i
+
+    res = ray_tpu.get([where.remote(i) for i in range(16)], timeout=60)
+    pids = {p for p, _ in res}
+    assert len(pids) == 2 and os.getpid() not in pids
+    assert sorted(i for _, i in res) == list(range(16))
+
+    # Chaos: SIGKILL the worker daemon; its supervisor must restart it
+    # and the replacement must register as a fresh alive node.
+    old_daemon_pid = _read_pid(worker_dir, "daemon.pid")
+    os.kill(old_daemon_pid, signal.SIGKILL)
+
+    def _restarted():
+        try:
+            return _read_pid(worker_dir, "daemon.pid") != old_daemon_pid
+        except OSError:
+            return False
+
+    _wait(_restarted, 60, "supervisor restart of the daemon")
+    # Alive nodes: head daemon + attached driver + REPLACEMENT worker
+    # (the killed incarnation shows dead).
+    _wait(lambda: sum(1 for n in cl.status(run_dir=head_dir)["nodes"]
+                      if n["alive"]) == 3, 60, "replacement node alive")
+
+    # The replacement node runs work (retry machinery drains the kill).
+    res = ray_tpu.get([where.options(max_retries=5).remote(i)
+                       for i in range(8)], timeout=90)
+    assert len({p for p, _ in res}) >= 1
+    ray_tpu.shutdown()
+
+    # Stop both. The supervisor's graceful shutdown removes its pidfile
+    # (the process itself lingers as a zombie under pytest — nothing
+    # reaps grandchildren here — so poll the pidfile, not the pid).
+    assert cl.stop(worker_dir)
+    assert cl.stop(head_dir)
+    for d in (worker_dir, head_dir):
+        _wait(lambda d=d: not os.path.exists(
+            os.path.join(d, "supervisor.pid")), 20,
+            f"supervisor pidfile cleanup in {d}")
