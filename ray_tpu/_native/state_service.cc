@@ -83,6 +83,7 @@ std::string frame(const raytpu::Envelope& env) {
 
 struct Conn {
   int fd = -1;
+  bool authed = false;
   std::string rbuf;
   std::string wbuf;
   std::set<std::string> channels;  // pubsub subscriptions
@@ -91,8 +92,10 @@ struct Conn {
 class StateService {
  public:
   StateService(int port, const std::string& host, const std::string& data_dir,
-               double hb_timeout_ms, double snapshot_interval_s)
-      : host_(host),
+               double hb_timeout_ms, double snapshot_interval_s,
+               const std::string& auth_token)
+      : auth_token_(auth_token),
+        host_(host),
         port_(port),
         data_dir_(data_dir),
         hb_timeout_ms_(hb_timeout_ms),
@@ -256,8 +259,24 @@ class StateService {
       if (c.rbuf.size() - off - 4 < len) break;
       raytpu::Envelope env;
       if (env.ParseFromArray(c.rbuf.data() + off + 4, len)) {
-        Dispatch(fd, env);
-        if (!conns_.count(fd)) return;  // handler closed us
+        if (!auth_token_.empty() && !c.authed) {
+          // Opening frame must be AUTH with the shared secret
+          // (constant-time compare); otherwise drop the socket before
+          // anything reaches a handler.
+          if (env.method() != raytpu::AUTH ||
+              !ConstantTimeEq(env.body(), auth_token_)) {
+            fprintf(stderr, "[state_service] rejected unauthenticated "
+                            "connection\n");
+            CloseConn(fd);
+            return;
+          }
+          c.authed = true;
+        } else if (env.method() == raytpu::AUTH) {
+          // redundant re-auth: ignore
+        } else {
+          Dispatch(fd, env);
+          if (!conns_.count(fd)) return;  // handler closed us
+        }
       }
       off += 4 + len;
     }
@@ -355,6 +374,13 @@ class StateService {
 
   // Applies a mutating method to the tables. `live` is false during journal
   // replay (no fd, no pubsub, no re-journaling).
+  static bool ConstantTimeEq(const std::string& a, const std::string& b) {
+    if (a.size() != b.size()) return false;
+    unsigned char acc = 0;
+    for (size_t i = 0; i < a.size(); i++) acc |= (a[i] ^ b[i]);
+    return acc == 0;
+  }
+
   void Dispatch(int fd, const raytpu::Envelope& env) {
     counters_["rpc_total"]++;
     switch (env.method()) {
@@ -943,6 +969,7 @@ class StateService {
 
   // -------------------------------------------------------------- members
 
+  std::string auth_token_;
   std::string host_;
   int port_;
   std::string data_dir_;
@@ -978,6 +1005,7 @@ int main(int argc, char** argv) {
   std::string port_file;
   double hb_timeout_ms = 10000;
   double snapshot_interval_s = 30;
+  std::string token_file;
   for (int i = 1; i < argc; i++) {
     std::string a = argv[i];
     auto next = [&](const char* flag) -> std::string {
@@ -995,6 +1023,7 @@ int main(int argc, char** argv) {
       hb_timeout_ms = atof(next("--heartbeat-timeout-ms").c_str());
     else if (a == "--snapshot-interval-s")
       snapshot_interval_s = atof(next("--snapshot-interval-s").c_str());
+    else if (a == "--token-file") token_file = next("--token-file");
     else {
       fprintf(stderr, "unknown flag %s\n", a.c_str());
       return 2;
@@ -1003,6 +1032,29 @@ int main(int argc, char** argv) {
   signal(SIGINT, on_signal);
   signal(SIGTERM, on_signal);
   signal(SIGPIPE, SIG_IGN);
-  StateService svc(port, host, data_dir, hb_timeout_ms, snapshot_interval_s);
+  std::string auth_token;
+  if (!token_file.empty()) {
+    FILE* f = fopen(token_file.c_str(), "rb");
+    if (!f) {
+      fprintf(stderr, "cannot read --token-file %s\n", token_file.c_str());
+      return 2;
+    }
+    char buf[4096];
+    size_t n = fread(buf, 1, sizeof(buf), f);
+    fclose(f);
+    auth_token.assign(buf, n);
+    // Match Python's str.strip(): whitespace off both ends.
+    while (!auth_token.empty() && isspace((unsigned char)auth_token.back()))
+      auth_token.pop_back();
+    size_t lead = 0;
+    while (lead < auth_token.size() &&
+           isspace((unsigned char)auth_token[lead]))
+      lead++;
+    auth_token.erase(0, lead);
+  } else if (const char* t = getenv("RAY_TPU_AUTH_TOKEN")) {
+    auth_token = t;
+  }
+  StateService svc(port, host, data_dir, hb_timeout_ms, snapshot_interval_s,
+                   auth_token);
   return svc.Run(port_file);
 }

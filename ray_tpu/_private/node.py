@@ -86,7 +86,8 @@ class NodeSupervisor:
                  num_tpus: Optional[float] = None,
                  resources: Optional[Dict[str, float]] = None,
                  tp_cpu_devices: int = 0,
-                 heartbeat_timeout_ms: float = 5000):
+                 heartbeat_timeout_ms: float = 5000,
+                 auth_token: str = ""):
         self.run_dir = run_dir
         self.head = head
         self.state_addr = state_addr
@@ -95,6 +96,7 @@ class NodeSupervisor:
         self.resources = resources or {}
         self.tp_cpu_devices = tp_cpu_devices
         self.heartbeat_timeout_ms = heartbeat_timeout_ms
+        self.auth_token = auth_token
         self.state_proc: Optional[subprocess.Popen] = None
         self.daemon_proc: Optional[subprocess.Popen] = None
         self._stop = False
@@ -136,6 +138,11 @@ class NodeSupervisor:
     # -- main loop -----------------------------------------------------------
 
     def run(self):
+        if self.auth_token:
+            # Children (state service via getenv, daemons via inherited
+            # env) and our own clients all read the shared secret from the
+            # environment; see rpc.default_auth_token.
+            os.environ["RAY_TPU_AUTH_TOKEN"] = self.auth_token
         self._write("supervisor.pid", str(os.getpid()))
         signal.signal(signal.SIGTERM, lambda *_: setattr(self, "_stop", True))
         signal.signal(signal.SIGINT, lambda *_: setattr(self, "_stop", True))

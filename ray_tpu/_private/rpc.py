@@ -14,7 +14,9 @@ Wire format: ``4-byte big-endian length | Envelope protobuf`` — see
 
 from __future__ import annotations
 
+import hmac
 import logging
+import os
 import socket
 import struct
 import threading
@@ -27,6 +29,18 @@ logger = logging.getLogger("ray_tpu")
 
 MAX_FRAME = 1 << 31  # 2 GiB hard cap per frame
 _LEN = struct.Struct(">I")
+
+
+def default_auth_token() -> Optional[bytes]:
+    """The cluster's shared secret, if one is set for this process.
+
+    Minted by the head node at cluster start (scripts/cluster.py) and
+    distributed out-of-band (run-dir token file / env) like the
+    reference's redis password. Every daemon/state connection must open
+    with it — an unauthenticated socket that can reach a daemon is
+    remote code execution by design (PUSH_TASK carries cloudpickle)."""
+    tok = os.environ.get("RAY_TPU_AUTH_TOKEN")
+    return tok.encode() if tok else None
 
 
 class RpcConnectionError(ConnectionError):
@@ -75,7 +89,8 @@ class RpcClient:
 
     def __init__(self, address: str, connect_timeout: float = 10.0,
                  on_push: Optional[Callable[[pb.Envelope], None]] = None,
-                 on_close: Optional[Callable[[Exception], None]] = None):
+                 on_close: Optional[Callable[[Exception], None]] = None,
+                 auth_token: Optional[bytes] = None):
         host, port = address.rsplit(":", 1)
         self.address = address
         try:
@@ -86,6 +101,21 @@ class RpcClient:
                 f"connect to {address} failed: {e}") from e
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        token = auth_token if auth_token is not None else default_auth_token()
+        if token:
+            # First frame of every connection: prove membership. The server
+            # closes the socket on mismatch; the caller surfaces that as a
+            # connection error on its first real call.
+            try:
+                self._sock.sendall(frame_bytes(pb.Envelope(
+                    seq=0, method=pb.AUTH, body=token)))
+            except OSError as e:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                raise RpcConnectionError(
+                    f"auth handshake to {address} failed: {e}") from e
         self._wlock = threading.Lock()
         self._plock = threading.Lock()
         self._pending: Dict[int, _Pending] = {}
@@ -277,8 +307,11 @@ class RpcServer:
 
     def __init__(self, handler: Handler, host: str = "127.0.0.1",
                  port: int = 0, max_workers: int = 64,
-                 inline_methods: Optional[set] = None):
+                 inline_methods: Optional[set] = None,
+                 auth_token: Optional[bytes] = None):
         self._handler = handler
+        self._auth_token = (auth_token if auth_token is not None
+                            else default_auth_token())
         # Methods handled synchronously on the connection's reader thread:
         # cheap enqueue-style handlers that need per-connection ordering
         # (actor mailbox inserts — the reference's actor sequencing queues,
@@ -341,8 +374,19 @@ class RpcServer:
     def _conn_loop(self, conn_id: int, sock: socket.socket,
                    wlock: threading.Lock):
         try:
+            if self._auth_token:
+                # Constant-time check of the connection's opening frame;
+                # anything else (wrong token, other method, garbage) drops
+                # the socket before a single byte reaches the handler.
+                env = read_frame(sock)
+                if env.method != pb.AUTH or not hmac.compare_digest(
+                        bytes(env.body), self._auth_token):
+                    logger.warning("rejected unauthenticated connection")
+                    return
             while True:
                 env = read_frame(sock)
+                if env.method == pb.AUTH:
+                    continue  # redundant re-auth: ignore
                 ctx = RpcContext(self, sock, wlock, env)
                 ctx.conn_id = conn_id
                 if env.method in self._inline:

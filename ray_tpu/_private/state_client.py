@@ -55,9 +55,10 @@ def start_state_service(port: int = 0, host: str = "127.0.0.1",
 
 
 class StateClient:
-    def __init__(self, address: str):
+    def __init__(self, address: str, auth_token=None):
         self.address = address
-        self._client = RpcClient(address)
+        self._auth_token = auth_token
+        self._client = RpcClient(address, auth_token=auth_token)
         self._sub_client: Optional[RpcClient] = None
         self._sub_lock = threading.Lock()
         self._handlers: Dict[str, List[Callable[[pb.Event], None]]] = {}
@@ -149,7 +150,8 @@ class StateClient:
                 self._handlers.setdefault(ch, []).append(handler)
             if self._sub_client is None:
                 self._sub_client = RpcClient(
-                    self.address, on_push=self._on_push)
+                    self.address, on_push=self._on_push,
+                    auth_token=self._auth_token)
             self._sub_client.call(
                 pb.SUBSCRIBE,
                 pb.SubscribeRequest(channels=channels).SerializeToString(),

@@ -58,6 +58,7 @@ def init(num_cpus: Optional[float] = None,
          include_dashboard: bool = False,
          dashboard_port: int = 0,
          address: Optional[str] = None,
+         auth_token: Optional[str] = None,
          _system_config: Optional[dict] = None,
          _create_default_node: bool = True,
          **kwargs) -> "Worker":
@@ -78,6 +79,10 @@ def init(num_cpus: Optional[float] = None,
             raise RuntimeError("ray_tpu.init() called twice; pass "
                                "ignore_reinit_error=True to ignore")
         _config.apply_system_config(_system_config)
+        if auth_token:
+            # Process-wide: every RPC connection (state client, daemon
+            # peers) opens with this shared secret (rpc.default_auth_token).
+            os.environ["RAY_TPU_AUTH_TOKEN"] = auth_token
         if address is not None:
             from ray_tpu._private.distributed import DistributedRuntime
             amounts: Dict[str, float] = {}

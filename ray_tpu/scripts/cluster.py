@@ -52,11 +52,18 @@ def start(head: bool = False, address: str = "",
           resources: Optional[Dict[str, float]] = None,
           tp_cpu_devices: int = 0, run_dir: str = DEFAULT_RUN_DIR,
           heartbeat_timeout_ms: float = 5000,
+          auth: bool = True, auth_token: str = "",
           block: bool = False) -> str:
     """Start a supervised node; returns the cluster (state service) address.
 
     ``block=False`` leaves a detached ``supervise`` process running; stop
     it with ``stop(run_dir)``.
+
+    ``auth`` (default on) protects every daemon/state connection with a
+    shared secret: the head mints one (written to ``<run_dir>/token``,
+    mode 0600) unless ``auth_token``/$RAY_TPU_AUTH_TOKEN supplies it;
+    workers and drivers must present the same token (reference analogue:
+    the redis password every raylet/driver needs).
     """
     if head == bool(address):
         raise ValueError("pass exactly one of head=True or address=...")
@@ -72,13 +79,30 @@ def start(head: bool = False, address: str = "",
             os.unlink(os.path.join(run_dir, stale))
         except OSError:
             pass
+    token = ""
+    if auth:
+        token = (auth_token or os.environ.get("RAY_TPU_AUTH_TOKEN", ""))
+        if not token:
+            if head:
+                import secrets
+                token = secrets.token_hex(16)
+            else:
+                raise ValueError(
+                    "joining an authenticated cluster needs its token: pass "
+                    "auth_token=, set RAY_TPU_AUTH_TOKEN, or use auth=False "
+                    "for an open cluster")
+        token_path = os.path.join(run_dir, "token")
+        fd = os.open(token_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            f.write(token)
     if block:
         from ray_tpu._private.node import NodeSupervisor
         sup = NodeSupervisor(run_dir, head=head, state_addr=address,
                              num_cpus=num_cpus, num_tpus=num_tpus,
                              resources=resources,
                              tp_cpu_devices=tp_cpu_devices,
-                             heartbeat_timeout_ms=heartbeat_timeout_ms)
+                             heartbeat_timeout_ms=heartbeat_timeout_ms,
+                             auth_token=token)
         sup.run()  # returns on SIGTERM/SIGINT
         return read_address(run_dir) or address
     cmd = [sys.executable, "-m", "ray_tpu.scripts.cluster", "supervise",
@@ -86,6 +110,8 @@ def start(head: bool = False, address: str = "",
            "--heartbeat-timeout-ms", str(heartbeat_timeout_ms),
            "--resources", json.dumps(resources or {}),
            "--tp-cpu-devices", str(tp_cpu_devices)]
+    if token:
+        cmd += ["--token-file", os.path.join(run_dir, "token")]
     if head:
         cmd.append("--head")
     else:
@@ -167,8 +193,16 @@ def status(address: Optional[str] = None,
     addr = address or read_address(run_dir)
     if addr is None:
         raise RuntimeError(f"no cluster address (run dir {run_dir})")
+    # LOCAL cluster (addr from run_dir): its token file is authoritative.
+    # An explicit address may be a different cluster — never assume the
+    # local token, and never mutate process env from a status query.
+    token = None
+    token_path = os.path.join(run_dir, "token")
+    if address is None and os.path.exists(token_path):
+        with open(token_path) as f:
+            token = f.read().strip().encode()
     from ray_tpu._private.state_client import StateClient
-    client = StateClient(addr)
+    client = StateClient(addr, auth_token=token)
     try:
         nodes = client.list_nodes()
         out = {"address": addr, "nodes": []}
@@ -190,14 +224,23 @@ def status(address: Optional[str] = None,
 
 
 def _cmd_start(args):
+    token = ""
+    if args.token_file:
+        with open(args.token_file) as f:
+            token = f.read().strip()
     addr = start(head=args.head, address=args.address or "",
                  num_cpus=args.num_cpus, num_tpus=args.num_tpus,
                  resources=json.loads(args.resources),
                  tp_cpu_devices=args.tp_cpu_devices,
                  run_dir=args.run_dir,
                  heartbeat_timeout_ms=args.heartbeat_timeout_ms,
+                 auth=not args.no_auth, auth_token=token,
                  block=args.block)
     print(f"ray_tpu node up; cluster address: {addr}")
+    if not args.no_auth:
+        print(f"auth token: {os.path.join(args.run_dir, 'token')} "
+              f"(workers/drivers need it: RAY_TPU_AUTH_TOKEN or "
+              f"init(auth_token=...))")
     print(f'connect with ray_tpu.init(address="{addr}")')
 
 
@@ -206,13 +249,18 @@ def _cmd_supervise(args):
     logging.basicConfig(
         level="INFO",
         format="[supervisor %(asctime)s] %(levelname)s %(message)s")
+    token = ""
+    if args.token_file:
+        with open(args.token_file) as f:
+            token = f.read().strip()
     from ray_tpu._private.node import NodeSupervisor
     NodeSupervisor(args.run_dir, head=args.head,
                    state_addr=args.address or "",
                    num_cpus=args.num_cpus, num_tpus=args.num_tpus,
                    resources=json.loads(args.resources),
                    tp_cpu_devices=args.tp_cpu_devices,
-                   heartbeat_timeout_ms=args.heartbeat_timeout_ms).run()
+                   heartbeat_timeout_ms=args.heartbeat_timeout_ms,
+                   auth_token=token).run()
 
 
 def _cmd_stop(args):
@@ -245,6 +293,10 @@ def _add_node_args(p):
     p.add_argument("--tp-cpu-devices", type=int, default=0)
     p.add_argument("--run-dir", default=DEFAULT_RUN_DIR)
     p.add_argument("--heartbeat-timeout-ms", type=float, default=5000)
+    p.add_argument("--token-file", default="",
+                   help="shared-secret file (head generates one by default)")
+    p.add_argument("--no-auth", action="store_true",
+                   help="run an OPEN cluster (any socket can submit work)")
 
 
 def main(argv=None):

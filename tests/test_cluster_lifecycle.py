@@ -35,31 +35,41 @@ def _wait(pred, timeout_s, what):
 @pytest.fixture()
 def lifecycle_dirs(tmp_path):
     ray_tpu.shutdown()
+    old_token = os.environ.get("RAY_TPU_AUTH_TOKEN")
     head_dir = str(tmp_path / "head")
     worker_dir = str(tmp_path / "worker")
     yield head_dir, worker_dir
     ray_tpu.shutdown()
     for d in (worker_dir, head_dir):
         cl.stop(d)
+    if old_token is None:
+        os.environ.pop("RAY_TPU_AUTH_TOKEN", None)
+    else:
+        os.environ["RAY_TPU_AUTH_TOKEN"] = old_token
 
 
 def test_start_attach_restart_stop(lifecycle_dirs):
     head_dir, worker_dir = lifecycle_dirs
 
     # Terminal 1: start the head (state service + daemon, supervised).
+    # Auth is on by default: the head mints the cluster token.
     addr = cl.start(head=True, num_cpus=2, run_dir=head_dir,
                     heartbeat_timeout_ms=3000)
     assert addr == cl.read_address(head_dir)
+    with open(os.path.join(head_dir, "token")) as f:
+        token = f.read().strip()
+    assert token
 
-    # Terminal 2: start a worker against the published address.
+    # Terminal 2: start a worker against the published address, presenting
+    # the head's token.
     cl.start(address=addr, num_cpus=2, run_dir=worker_dir,
-             heartbeat_timeout_ms=3000)
+             heartbeat_timeout_ms=3000, auth_token=token)
 
     info = cl.status(run_dir=head_dir)
     assert sum(1 for n in info["nodes"] if n["alive"]) == 2
 
-    # Terminal 3: a driver attaches and uses both nodes.
-    ray_tpu.init(address=addr)
+    # Terminal 3: a driver attaches (with the token) and uses both nodes.
+    ray_tpu.init(address=addr, auth_token=token)
 
     @ray_tpu.remote
     def where(i):
