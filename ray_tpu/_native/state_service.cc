@@ -237,6 +237,13 @@ class StateService {
       ssize_t n = recv(fd, buf, sizeof(buf), 0);
       if (n > 0) {
         c.rbuf.append(buf, n);
+        // Unauthenticated peers get a tiny buffer allowance. Stop
+        // draining (don't close yet: the allowance may hold a valid AUTH
+        // frame pipelined ahead of a large first request — the parse
+        // loop below consumes it and flips c.authed). Level-triggered
+        // epoll re-delivers whatever is left in the socket.
+        if (!auth_token_.empty() && !c.authed &&
+            c.rbuf.size() > (1u << 16) + 4) break;
       } else if (n == 0) {
         CloseConn(fd);
         return;
@@ -253,6 +260,13 @@ class StateService {
       uint32_t len = (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
                      (uint32_t(p[2]) << 8) | uint32_t(p[3]);
       if (len > (1u << 30)) {  // 1 GiB sanity cap
+        CloseConn(fd);
+        return;
+      }
+      // An unauthenticated peer may only send the tiny AUTH frame —
+      // don't let it commit us to buffering a huge declared length.
+      if (!auth_token_.empty() && !c.authed && len > (1u << 16)) {
+        fprintf(stderr, "[state_service] oversized pre-auth frame\n");
         CloseConn(fd);
         return;
       }
@@ -277,10 +291,24 @@ class StateService {
           Dispatch(fd, env);
           if (!conns_.count(fd)) return;  // handler closed us
         }
+      } else if (!auth_token_.empty() && !c.authed) {
+        // pre-auth frames must parse as a valid AUTH Envelope; garbage
+        // gets the socket dropped, not skipped
+        CloseConn(fd);
+        return;
       }
       off += 4 + len;
     }
     if (off > 0) c.rbuf.erase(0, off);
+    // Parse consumed everything it could; a peer still unauthenticated
+    // with an over-allowance buffer is streaming garbage, not an AUTH
+    // frame — drop it (anti pre-auth OOM).
+    if (!auth_token_.empty() && !c.authed &&
+        c.rbuf.size() > (1u << 16) + 4) {
+      fprintf(stderr, "[state_service] pre-auth buffer overflow\n");
+      CloseConn(fd);
+      return;
+    }
   }
 
   void SendTo(int fd, const raytpu::Envelope& env) {

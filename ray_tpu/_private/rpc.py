@@ -61,9 +61,13 @@ def _read_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def read_frame(sock: socket.socket) -> pb.Envelope:
+PRE_AUTH_MAX_FRAME = 1 << 16  # before auth, only a tiny AUTH frame is legal
+
+
+def read_frame(sock: socket.socket,
+               max_len: int = MAX_FRAME) -> pb.Envelope:
     (length,) = _LEN.unpack(_read_exact(sock, 4))
-    if length > MAX_FRAME:
+    if length > max_len:
         raise RpcConnectionError(f"frame too large: {length}")
     env = pb.Envelope()
     env.ParseFromString(_read_exact(sock, length))
@@ -378,7 +382,9 @@ class RpcServer:
                 # Constant-time check of the connection's opening frame;
                 # anything else (wrong token, other method, garbage) drops
                 # the socket before a single byte reaches the handler.
-                env = read_frame(sock)
+                # Pre-auth frames are capped small so an unauthenticated
+                # peer cannot make us buffer up to MAX_FRAME.
+                env = read_frame(sock, max_len=PRE_AUTH_MAX_FRAME)
                 if env.method != pb.AUTH or not hmac.compare_digest(
                         bytes(env.body), self._auth_token):
                     logger.warning("rejected unauthenticated connection")

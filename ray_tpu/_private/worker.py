@@ -25,6 +25,9 @@ _global = None  # type: Optional["Worker"]
 # drains them first so no stray thread auto-reinitializes the worker
 # between an explicit shutdown() and the next init().
 _shutdown_hooks: list = []
+# sentinel: no init(auth_token=...) has modified the env this session
+_UNSET = object()
+_displaced_auth_token = _UNSET
 
 
 def register_shutdown_hook(fn) -> None:
@@ -82,6 +85,11 @@ def init(num_cpus: Optional[float] = None,
         if auth_token:
             # Process-wide: every RPC connection (state client, daemon
             # peers) opens with this shared secret (rpc.default_auth_token).
+            # Remember what we displaced so shutdown() can restore it —
+            # a later init(address=other_cluster) must not inherit this
+            # cluster's token.
+            global _displaced_auth_token
+            _displaced_auth_token = os.environ.get("RAY_TPU_AUTH_TOKEN")
             os.environ["RAY_TPU_AUTH_TOKEN"] = auth_token
         if address is not None:
             from ray_tpu._private.distributed import DistributedRuntime
@@ -132,6 +140,13 @@ def shutdown():
                 stop_state_server()
             _global.runtime.shutdown()
             _global = None
+        global _displaced_auth_token
+        if _displaced_auth_token is not _UNSET:
+            if _displaced_auth_token is None:
+                os.environ.pop("RAY_TPU_AUTH_TOKEN", None)
+            else:
+                os.environ["RAY_TPU_AUTH_TOKEN"] = _displaced_auth_token
+            _displaced_auth_token = _UNSET
 
 
 def is_initialized() -> bool:

@@ -223,11 +223,17 @@ def status(address: Optional[str] = None,
 # -- CLI ---------------------------------------------------------------------
 
 
+def _read_token(path: str) -> str:
+    """Token files are written with a trailing newline; strip the way the
+    C++ state service does (leading/trailing whitespace)."""
+    if not path:
+        return ""
+    with open(path) as f:
+        return f.read().strip()
+
+
 def _cmd_start(args):
-    token = ""
-    if args.token_file:
-        with open(args.token_file) as f:
-            token = f.read().strip()
+    token = _read_token(args.token_file)
     addr = start(head=args.head, address=args.address or "",
                  num_cpus=args.num_cpus, num_tpus=args.num_tpus,
                  resources=json.loads(args.resources),
@@ -249,10 +255,7 @@ def _cmd_supervise(args):
     logging.basicConfig(
         level="INFO",
         format="[supervisor %(asctime)s] %(levelname)s %(message)s")
-    token = ""
-    if args.token_file:
-        with open(args.token_file) as f:
-            token = f.read().strip()
+    token = _read_token(args.token_file)
     from ray_tpu._private.node import NodeSupervisor
     NodeSupervisor(args.run_dir, head=args.head,
                    state_addr=args.address or "",

@@ -16,6 +16,7 @@ from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
                                      PopulationBasedTraining, TrialScheduler)
 from ray_tpu.tune.search import (BasicVariantGenerator, ConcurrencyLimiter,
                                  Repeater, Searcher)
+from ray_tpu.tune.tpe import TPESearcher
 from ray_tpu.tune.session import get_checkpoint, get_trial_id, report
 from ray_tpu.tune.trainable import FunctionTrainable, Trainable, wrap_function
 from ray_tpu.tune.trial import Trial
@@ -29,5 +30,6 @@ __all__ = [
     "FIFOScheduler", "AsyncHyperBandScheduler", "HyperBandScheduler",
     "MedianStoppingRule", "PopulationBasedTraining", "TrialScheduler",
     "BasicVariantGenerator", "ConcurrencyLimiter", "Repeater", "Searcher",
+    "TPESearcher",
     "ExperimentAnalysis", "ResultGrid",
 ]

@@ -18,7 +18,6 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
-from ray_tpu.tune import schedulers as sched_mod
 from ray_tpu.tune.schedulers import CONTINUE, PAUSE, STOP, FIFOScheduler
 from ray_tpu.tune.trainable import Trainable, wrap_function
 from ray_tpu.tune.trial import (ERROR, PAUSED, PENDING, RUNNING, TERMINATED,
@@ -81,9 +80,21 @@ class TrialRunner:
         self.trials = list(trials)
         self.scheduler = scheduler or FIFOScheduler()
         self.scheduler.set_search_properties(metric, mode)
-        if isinstance(self.scheduler, sched_mod.PopulationBasedTraining):
-            self.scheduler._runner = self
+        # schedulers that act on non-reporting trials (PBT exploit,
+        # HyperBand band cuts) reach the executor through this backref
+        self.scheduler._runner = self
         self.searcher = searcher
+        # fill in only what each searcher was not configured with — a
+        # searcher built with mode="min" must not be flipped by run()'s
+        # "max" default. Walk wrapper chains (ConcurrencyLimiter/Repeater)
+        # so the inner searcher actually doing the learning is reached.
+        s = self.searcher
+        while s is not None:
+            if s.metric is None:
+                s.metric = metric
+            if s.mode is None:
+                s.mode = mode
+            s = getattr(s, "searcher", None)
         self._stop = stop
         self.metric, self.mode = metric, mode
         self.max_failures = max_failures
@@ -115,6 +126,11 @@ class TrialRunner:
         return max(1, int(cpus / per))
 
     # ------------------------------------------------------------------
+    def _may_resume(self, trial: Trial) -> bool:
+        # getattr: duck-typed user schedulers predating may_resume()
+        fn = getattr(self.scheduler, "may_resume", None)
+        return True if fn is None else fn(trial)
+
     def _trial_by_id(self, trial_id: str) -> Optional[Trial]:
         for t in self.trials:
             if t.trial_id == trial_id:
@@ -160,6 +176,18 @@ class TrialRunner:
         trial.status = status
         for cb in self.callbacks:
             cb.on_trial_complete(trial)
+
+    def terminate_trial(self, trial: Trial):
+        """Terminate a trial on a scheduler's behalf (e.g. a HyperBand band
+        cut killing a PAUSED loser). Unlike a bare ``_stop_trial`` this
+        also notifies the searcher, so ConcurrencyLimiter slots are freed
+        and the model sees the loser's final score."""
+        if trial.status == TERMINATED:
+            return
+        self._stop_trial(trial, status=TERMINATED)
+        if self.searcher is not None:
+            self.searcher.on_trial_complete(
+                trial.trial_id, trial.last_result or None)
 
     def _exploit_trial(self, trial: Trial, donor: Trial,
                        new_config: Dict[str, Any]):
@@ -215,8 +243,22 @@ class TrialRunner:
             inflight = {t._future: t for t in self.trials
                         if t.status == RUNNING and t._future is not None}
             if not inflight:
-                if any(t.status in (PENDING, PAUSED) for t in self.trials):
+                if any(t.status == PENDING or
+                       (t.status == PAUSED and self._may_resume(t))
+                       for t in self.trials):
                     continue
+                held = [t for t in self.trials if t.status == PAUSED]
+                if held:
+                    # No runnable work and every paused trial is held by
+                    # the scheduler: ask it to resolve the pending
+                    # synchronization; if that frees nothing, the bracket
+                    # is genuinely stuck — end the experiment rather than
+                    # spin or violate the concurrency cap.
+                    getattr(self.scheduler, "release_holds", lambda: None)()
+                    if any(t.status == PAUSED and self._may_resume(t)
+                           for t in self.trials):
+                        continue
+                    break
                 break
             ready, _ = ray_tpu.wait(list(inflight.keys()), num_returns=1,
                                     timeout=10.0)
@@ -236,7 +278,8 @@ class TrialRunner:
         for t in self.trials:
             if running >= self._max_concurrent:
                 break
-            if t.status == PENDING or t.status == PAUSED:
+            if t.status == PENDING or (
+                    t.status == PAUSED and self._may_resume(t)):
                 self._start_trial(t)
                 running += 1
         # pull more suggestions from a live searcher

@@ -18,7 +18,7 @@ import random
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.tune.sample import Domain
-from ray_tpu.tune.trial import Trial
+from ray_tpu.tune.trial import TERMINATED, Trial
 
 CONTINUE = "CONTINUE"
 PAUSE = "PAUSE"
@@ -31,6 +31,10 @@ class TrialScheduler:
         self.metric = metric
         self.mode = mode
         self.time_attr = time_attr
+        # set by the TrialRunner so schedulers that act on trials other
+        # than the one currently reporting (PBT exploit, HyperBand band
+        # cuts) can reach the executor
+        self._runner = None
 
     def set_search_properties(self, metric: Optional[str], mode: Optional[str]):
         if self.metric is None:
@@ -58,6 +62,17 @@ class TrialScheduler:
 
     def choose_trial_to_run(self, trials: List[Trial]) -> Optional[Trial]:
         return None
+
+    def may_resume(self, trial: Trial) -> bool:
+        """Whether a PAUSED trial is eligible to restart now. Synchronous
+        schedulers return False while the trial awaits a band cut."""
+        return True
+
+    def release_holds(self):
+        """Called by the runner when no trial is runnable and every paused
+        trial is held: resolve whatever synchronization is pending so the
+        experiment can make progress."""
+        pass
 
 
 class FIFOScheduler(TrialScheduler):
@@ -131,17 +146,175 @@ class AsyncHyperBandScheduler(TrialScheduler):
         return action
 
 
-# Synchronous HyperBand shares the rung machinery; the reference's version
-# (hyperband.py) additionally synchronizes bands. We run it as ASHA with
-# multiple brackets, which the ASHA paper shows dominates sync HyperBand.
-class HyperBandScheduler(AsyncHyperBandScheduler):
+class _SyncBracket:
+    """One successive-halving bracket of a HyperBand band.
+
+    Starts ``n0`` trials at milestone ``r0``; every time all live trials
+    have reported at the current milestone, keeps the top ``1/eta`` and
+    multiplies the milestone by ``eta`` until it reaches ``max_t``.
+    """
+
+    def __init__(self, s: int, n0: int, r0: float, eta: float, max_t: float):
+        self.s = s
+        self.n0 = n0
+        self.eta = eta
+        self.max_t = max_t
+        self.milestone = float(r0)
+        self.members: List[str] = []     # all trial ids ever admitted
+        self.live: set = set()           # not yet stopped/errored
+        self.reported: Dict[str, float] = {}  # scores at current milestone
+
+    def full(self) -> bool:
+        return len(self.members) >= self.n0
+
+    def add(self, trial_id: str):
+        self.members.append(trial_id)
+        self.live.add(trial_id)
+
+    def cut_ready(self) -> bool:
+        # A cut needs the bracket FULL as well as fully reported: trials
+        # can be admitted lazily (searcher-driven), and halving over a
+        # partially admitted bracket would break the exact-halving
+        # contract. If admission stops early (searcher exhausted), the
+        # runner's release_holds() fail-safe resolves the held trials.
+        return (self.full() and bool(self.live)
+                and set(self.reported) >= self.live)
+
+    def perform_cut(self):
+        """Returns (survivors, losers) and advances the milestone."""
+        ranked = sorted(self.reported.items(), key=lambda kv: kv[1],
+                        reverse=True)
+        keep = max(1, int(math.ceil(len(ranked) / self.eta)))
+        survivors = [tid for tid, _ in ranked[:keep]]
+        losers = [tid for tid, _ in ranked[keep:]]
+        for tid in losers:
+            self.live.discard(tid)
+        self.reported.clear()
+        self.milestone = min(self.milestone * self.eta, self.max_t)
+        return survivors, losers
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Synchronized HyperBand (reference ``tune/schedulers/hyperband.py``).
+
+    Bands of ``s_max+1`` brackets; bracket ``s`` admits
+    ``ceil((s_max+1)/(s+1) * eta^s)`` trials starting at ``max_t / eta^s``
+    iterations. Within a bracket, trials PAUSE at each milestone; when the
+    last live trial reports, the bottom ``1 - 1/eta`` are terminated and
+    the survivors resume toward the next milestone (successive halving).
+    Unlike ASHA, cuts wait for every live trial — the original algorithm,
+    which some workloads prefer for its exact halving guarantees.
+    """
+
     def __init__(self, metric: Optional[str] = None, mode: str = "max",
                  time_attr: str = "training_iteration", max_t: float = 81,
-                 reduction_factor: float = 3):
-        brackets = max(1, int(math.log(max_t, reduction_factor)))
-        super().__init__(metric, mode, time_attr, max_t=max_t,
-                         grace_period=1, reduction_factor=reduction_factor,
-                         brackets=brackets)
+                 reduction_factor: float = 3, stop_last_trials: bool = True):
+        super().__init__(metric, mode, time_attr)
+        self.max_t = max_t
+        self.eta = reduction_factor
+        self.stop_last_trials = stop_last_trials
+        self._s_max_1 = int(round(
+            math.log(max_t) / math.log(reduction_factor))) + 1
+        self._bands: List[List[_SyncBracket]] = []
+        self._bracket_of: Dict[str, _SyncBracket] = {}
+        self._held: set = set()   # paused, awaiting a band cut
+
+    def _n0(self, s: int) -> int:
+        return int(math.ceil(self._s_max_1 / (s + 1) * self.eta ** s))
+
+    def _r0(self, s: int) -> float:
+        return max(1.0, self.max_t * self.eta ** (-s))
+
+    def _open_bracket(self) -> _SyncBracket:
+        if self._bands:
+            band = self._bands[-1]
+            if not band[-1].full():
+                return band[-1]
+            if len(band) < self._s_max_1:
+                s = band[-1].s - 1
+                b = _SyncBracket(s, self._n0(s), self._r0(s), self.eta,
+                                 self.max_t)
+                band.append(b)
+                return b
+        # new band, starting from the most-aggressive bracket
+        s = self._s_max_1 - 1
+        b = _SyncBracket(s, self._n0(s), self._r0(s), self.eta, self.max_t)
+        self._bands.append([b])
+        return b
+
+    def on_trial_add(self, trial: Trial):
+        bracket = self._open_bracket()
+        bracket.add(trial.trial_id)
+        self._bracket_of[trial.trial_id] = bracket
+
+    def may_resume(self, trial: Trial) -> bool:
+        return trial.trial_id not in self._held
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        score = self._score(result)
+        t = result.get(self.time_attr)
+        bracket = self._bracket_of.get(trial.trial_id)
+        if score is None or t is None or bracket is None:
+            return CONTINUE
+        if t >= self.max_t:
+            bracket.live.discard(trial.trial_id)
+            bracket.reported.pop(trial.trial_id, None)
+            self._maybe_cut(bracket, exclude=trial.trial_id)
+            return STOP if self.stop_last_trials else CONTINUE
+        if t < bracket.milestone:
+            return CONTINUE
+        bracket.reported[trial.trial_id] = score
+        if bracket.cut_ready():
+            survivors, losers = bracket.perform_cut()
+            self._apply_cut(survivors, losers, reporting=trial.trial_id)
+            return STOP if trial.trial_id in losers else PAUSE
+        self._held.add(trial.trial_id)
+        return PAUSE
+
+    def _apply_cut(self, survivors: List[str], losers: List[str],
+                   reporting: Optional[str] = None):
+        for tid in survivors:
+            self._held.discard(tid)
+        for tid in losers:
+            self._held.discard(tid)
+            if tid == reporting:
+                continue  # runner stops it via the returned STOP
+            if self._runner is not None:
+                paused = self._runner._trial_by_id(tid)
+                if paused is not None:
+                    self._runner.terminate_trial(paused)
+
+    def _drop(self, trial: Trial):
+        bracket = self._bracket_of.get(trial.trial_id)
+        if bracket is None:
+            return
+        bracket.live.discard(trial.trial_id)
+        bracket.reported.pop(trial.trial_id, None)
+        self._held.discard(trial.trial_id)
+        self._maybe_cut(bracket, exclude=trial.trial_id)
+
+    def _maybe_cut(self, bracket: _SyncBracket, exclude: Optional[str] = None):
+        """A departure can leave the bracket cut-ready; fire the cut so the
+        remaining paused trials are not held forever."""
+        if bracket.cut_ready():
+            survivors, losers = bracket.perform_cut()
+            self._apply_cut(survivors, losers, reporting=exclude)
+
+    def release_holds(self):
+        """Force a cut from whatever has reported so far (invariant says
+        cut_ready fires when the last live trial reports, so reaching this
+        means some trial departed without bookkeeping — fail safe)."""
+        for band in self._bands:
+            for bracket in band:
+                if bracket.reported:
+                    survivors, losers = bracket.perform_cut()
+                    self._apply_cut(survivors, losers)
+
+    def on_trial_complete(self, trial: Trial, result: Dict[str, Any]):
+        self._drop(trial)
+
+    def on_trial_error(self, trial: Trial):
+        self._drop(trial)
 
 
 class MedianStoppingRule(TrialScheduler):

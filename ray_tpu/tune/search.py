@@ -68,9 +68,13 @@ def generate_variants(space: Dict[str, Any], num_samples: int,
 
 
 class Searcher:
-    """Base searcher interface (reference ``tune/search/searcher.py``)."""
+    """Base searcher interface (reference ``tune/search/searcher.py``).
 
-    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+    ``mode=None`` means "not configured": the TrialRunner fills it from
+    ``run()``'s mode. Searchers must treat ``None`` as "max"."""
+
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None):
         self.metric, self.mode = metric, mode
 
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
@@ -98,8 +102,13 @@ class BasicVariantGenerator(Searcher):
         self.max_concurrent = max_concurrent
         self._iter: Optional[Iterator[Dict[str, Any]]] = None
 
-    def set_space(self, space: Dict[str, Any], num_samples: int):
-        self._space, self._num_samples = space, num_samples
+    def set_space(self, space: Optional[Dict[str, Any]],
+                  num_samples: Optional[int] = None):
+        """None leaves the corresponding constructor value in place."""
+        if space:
+            self._space = space
+        if num_samples is not None:
+            self._num_samples = num_samples
 
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
         if self._iter is None:

@@ -26,7 +26,7 @@ from ray_tpu.tune.trial import Trial
 class TuneConfig:
     metric: Optional[str] = None
     mode: str = "max"
-    num_samples: int = 1
+    num_samples: Optional[int] = None  # None = searcher's own budget, else 1
     max_concurrent_trials: Optional[int] = None
     scheduler: Optional[Any] = None
     search_alg: Optional[Searcher] = None
@@ -37,7 +37,7 @@ class TuneConfig:
 def run(trainable,
         config: Optional[Dict[str, Any]] = None,
         *,
-        num_samples: int = 1,
+        num_samples: Optional[int] = None,
         metric: Optional[str] = None,
         mode: str = "max",
         stop: Optional[Any] = None,
@@ -64,12 +64,15 @@ def run(trainable,
         trials = TrialRunner.load_experiment_state(resume_from)
     elif search_alg is not None:
         # live searcher supplies configs during the run
-        if isinstance(search_alg, BasicVariantGenerator):
-            search_alg.set_space(config or {}, num_samples)
+        if hasattr(search_alg, "set_space") and (
+                config or num_samples is not None):
+            # an explicit run() config/num_samples overrides the
+            # constructor-supplied space/budget; None leaves each in place
+            search_alg.set_space(config or None, num_samples)
         trials = []
         searcher = search_alg
     else:
-        gen = BasicVariantGenerator(config or {}, num_samples, seed=seed)
+        gen = BasicVariantGenerator(config or {}, num_samples or 1, seed=seed)
         trials = []
         while True:
             cfg = gen.suggest(f"trial_{len(trials)}")

@@ -8,6 +8,7 @@ chrome_tracing_dump assertions).
 
 import json
 import os
+import time
 import urllib.request
 
 import pytest
@@ -125,11 +126,20 @@ def test_event_log_persists_jsonl(tmp_path):
             return 1
 
         ray_tpu.get(f.remote())
+        # TASK_DONE is emitted by the executor thread *after* the result
+        # seal releases this get(), so persistence is eventually-consistent
+        # with respect to the caller — poll briefly before asserting.
+        deadline = time.time() + 5.0
+        events = []
+        while time.time() < deadline:
+            files = list(tmp_path.glob("events_*.jsonl"))
+            if files:
+                events = [json.loads(line) for line in
+                          files[0].read_text().splitlines()]
+                if any(e["kind"] == "TASK_DONE" for e in events):
+                    break
+            time.sleep(0.02)
         ray_tpu.shutdown()
-        files = list(tmp_path.glob("events_*.jsonl"))
-        assert files
-        events = [json.loads(line) for line in
-                  files[0].read_text().splitlines()]
         assert any(e["kind"] == "TASK_DONE" for e in events)
     finally:
         _config.set("event_log_enabled", False)

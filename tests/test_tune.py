@@ -266,3 +266,220 @@ def test_concurrency_limiter_and_searcher():
     assert limited.suggest("t3") is None  # capped
     limited.on_trial_complete("t1")
     assert limited.suggest("t3") is not None
+
+
+# ---------------------------------------------------------------- hyperband
+def test_hyperband_bracket_layout():
+    """max_t=9, eta=3 -> s_max+1=3 brackets per band: n0=9@r0=1, n0=5(ceil(1.5*3))@r0=3, n0=3@r0=9."""
+    sched = tune.HyperBandScheduler(metric="score", mode="max",
+                                    max_t=9, reduction_factor=3)
+    trials = [tune.Trial({"i": i}, trial_id=f"t{i}") for i in range(16)]
+    for t in trials:
+        sched.on_trial_add(t)
+    band = sched._bands[0]
+    assert [b.s for b in band] == [2, 1, 0]
+    assert [b.n0 for b in band] == [9, 5, 3]
+    assert [b.milestone for b in band] == [1.0, 3.0, 9.0]
+    assert [len(b.members) for b in band] == [9, 5, 2]
+    # a 17th trial opens a second band
+    sched.on_trial_add(tune.Trial({}, trial_id="t16"))
+    assert len(sched._bands) == 1  # third bracket still has room
+    sched.on_trial_add(tune.Trial({}, trial_id="t17"))
+    assert len(sched._bands) == 2
+
+
+def test_hyperband_synchronized_halving(tmp_path):
+    """9 trials in one bracket: milestone-1 cut keeps top 3, milestone-3 cut
+    keeps top 1, and only that one reaches max_t."""
+    def trainable(config):
+        for i in range(20):
+            tune.report(score=config["q"] * (i + 1))
+
+    sched = tune.HyperBandScheduler(max_t=9, reduction_factor=3)
+    analysis = tune.run(
+        trainable, config={"q": tune.grid_search(list(range(1, 10)))},
+        metric="score", mode="max", scheduler=sched,
+        max_concurrent_trials=3, local_dir=str(tmp_path), verbose=0)
+    iters = {t.config["q"]: len(t.results) for t in analysis.trials}
+    assert iters[9] == 9                      # winner runs to max_t
+    assert iters[7] == 3 and iters[8] == 3    # survived cut 1, lost cut 2
+    for q in range(1, 7):
+        assert iters[q] == 1                  # cut at the first milestone
+    assert all(t.status == TERMINATED for t in analysis.trials)
+
+
+# ---------------------------------------------------------------- TPE
+def _drive_searcher(searcher, objective, n):
+    best = -float("inf")
+    for i in range(n):
+        cfg = searcher.suggest(f"t{i}")
+        if cfg is None:
+            break
+        score = objective(cfg)
+        searcher.on_trial_complete(f"t{i}", {"score": score})
+        best = max(best, score)
+    return best
+
+
+def test_tpe_finds_quadratic_optimum():
+    from ray_tpu.tune.tpe import TPESearcher
+
+    def objective(cfg):
+        return -((cfg["x"] - 0.7) ** 2 + (cfg["y"] + 0.3) ** 2)
+
+    space = {"x": tune.uniform(-2, 2), "y": tune.uniform(-2, 2)}
+    tpe = TPESearcher(space, metric="score", mode="max", num_samples=60,
+                      n_initial_points=10, seed=0)
+    tpe_best = _drive_searcher(tpe, objective, 60)
+
+    import random
+    rng = random.Random(0)
+    rand_best = max(
+        objective({"x": rng.uniform(-2, 2), "y": rng.uniform(-2, 2)})
+        for _ in range(60))
+    assert tpe_best > -0.05
+    assert tpe_best >= rand_best
+
+
+def test_tpe_mixed_space():
+    from ray_tpu.tune.tpe import TPESearcher
+
+    def objective(cfg):
+        lr_term = -(abs(__import__("math").log10(cfg["lr"]) + 2.0))  # best 1e-2
+        width_term = -abs(cfg["width"] - 32) / 32.0
+        act_term = 1.0 if cfg["act"] == "gelu" else 0.0
+        return lr_term + width_term + act_term
+
+    space = {"lr": tune.loguniform(1e-5, 1e-1),
+             "width": tune.randint(8, 65),
+             "act": tune.choice(["relu", "tanh", "gelu"])}
+    tpe = TPESearcher(space, metric="score", mode="max", num_samples=80,
+                      n_initial_points=12, seed=1)
+    best = _drive_searcher(tpe, objective, 80)
+    assert best > -0.8
+    # the model phase should concentrate on the winning category
+    late = [o for o, _ in tpe._obs[-20:]]
+    gelu_frac = sum(1 for o in late if o[("act",)] == "gelu") / len(late)
+    assert gelu_frac >= 0.5
+
+
+def test_tpe_minimize_mode():
+    from ray_tpu.tune.tpe import TPESearcher
+
+    def objective(cfg):
+        return (cfg["x"] - 1.0) ** 2
+
+    tpe = TPESearcher({"x": tune.uniform(-4, 4)}, metric="loss", mode="min",
+                      num_samples=50, n_initial_points=8, seed=2)
+    best = float("inf")
+    for i in range(50):
+        cfg = tpe.suggest(f"t{i}")
+        loss = objective(cfg)
+        tpe.on_trial_complete(f"t{i}", {"loss": loss})
+        best = min(best, loss)
+    assert best < 0.05
+
+
+def test_tpe_in_tune_run(tmp_path):
+    from ray_tpu.tune.tpe import TPESearcher
+
+    def trainable(config):
+        tune.report(score=-(config["x"] - 0.5) ** 2)
+
+    analysis = tune.run(trainable, config={"x": tune.uniform(-1, 1)},
+                        num_samples=12, metric="score", mode="max",
+                        search_alg=TPESearcher(seed=3, n_initial_points=4),
+                        local_dir=str(tmp_path), verbose=0)
+    assert len(analysis.trials) == 12
+    assert all(t.status == TERMINATED for t in analysis.trials)
+    assert analysis.best_result["score"] <= 0.0
+
+
+def test_tpe_constructor_space_survives_run(tmp_path):
+    """run() without config= must not wipe a searcher-supplied space."""
+    from ray_tpu.tune.tpe import TPESearcher
+
+    def trainable(config):
+        tune.report(score=-abs(config["x"]))
+
+    tpe = TPESearcher({"x": tune.uniform(-1, 1)}, num_samples=6,
+                      n_initial_points=2, seed=4)
+    analysis = tune.run(trainable, metric="score", mode="max",
+                        search_alg=tpe, local_dir=str(tmp_path), verbose=0)
+    assert len(analysis.trials) == 6
+    assert all("x" in t.config for t in analysis.trials)
+
+
+def test_searcher_min_mode_not_flipped_by_run_default(tmp_path):
+    """A searcher built with mode='min' keeps it when run() defaults to max."""
+    from ray_tpu.tune.tpe import TPESearcher
+
+    tpe = TPESearcher({"x": tune.uniform(-4, 4)}, metric="loss", mode="min",
+                      num_samples=30, n_initial_points=6, seed=5)
+
+    def trainable(config):
+        tune.report(loss=(config["x"] - 1.0) ** 2, score=0.0)
+
+    tune.run(trainable, metric="score", mode="max", search_alg=tpe,
+             local_dir=str(tmp_path), verbose=0)
+    assert tpe.mode == "min"
+    # internal scores are negated losses: best observation near x=1
+    best_flat = max(tpe._obs, key=lambda ov: ov[1])[0]
+    assert abs(best_flat[("x",)] - 1.0) < 1.0
+
+
+def test_hyperband_cut_losers_release_limiter_slots(tmp_path):
+    """Losers killed by a band cut must notify the searcher, or a
+    ConcurrencyLimiter starves (regression for the _apply_cut path)."""
+    def trainable(config):
+        for i in range(20):
+            tune.report(score=config["q"] * (i + 1))
+
+    gen = tune.BasicVariantGenerator(
+        {"q": tune.grid_search(list(range(1, 10)))}, num_samples=1)
+    limited = tune.ConcurrencyLimiter(gen, max_concurrent=3)
+    sched = tune.HyperBandScheduler(max_t=9, reduction_factor=3)
+    analysis = tune.run(trainable, metric="score", mode="max",
+                        scheduler=sched, search_alg=limited,
+                        max_concurrent_trials=3,
+                        local_dir=str(tmp_path), verbose=0)
+    assert len(analysis.trials) == 9       # limiter never starved
+    assert not limited._live               # every slot released
+    # paused trials hold limiter slots, so the 9-bracket can never fill;
+    # the release_holds fail-safe degrades to halving over each admitted
+    # group — verify it stays sane: most trials cut early, winners reach
+    # max_t, nothing hangs
+    iters = sorted(len(t.results) for t in analysis.trials)
+    assert iters[0] == 1 and iters[-1] == 9
+    assert sum(1 for i in iters if i < 9) >= 2, iters
+
+
+def test_hyperband_lazy_admission_exact_halving(tmp_path):
+    """Searcher-driven (lazy) trial admission must not trigger premature
+    cuts: the bracket waits until full, then halves exactly (9 -> 3 -> 1)."""
+    def trainable(config):
+        for i in range(20):
+            tune.report(score=config["q"] * (i + 1))
+
+    gen = tune.BasicVariantGenerator(
+        {"q": tune.grid_search(list(range(1, 10)))}, num_samples=1)
+    sched = tune.HyperBandScheduler(max_t=9, reduction_factor=3)
+    analysis = tune.run(trainable, metric="score", mode="max",
+                        scheduler=sched, search_alg=gen,
+                        max_concurrent_trials=3,
+                        local_dir=str(tmp_path), verbose=0)
+    iters = sorted(len(t.results) for t in analysis.trials)
+    assert iters == [1] * 6 + [3] * 2 + [9], iters
+
+
+def test_tpe_integer_stays_in_domain():
+    from ray_tpu.tune.tpe import TPESearcher
+    tpe = TPESearcher({"n": tune.randint(0, 4)}, metric="score", mode="max",
+                      num_samples=40, n_initial_points=5, seed=6)
+    seen = set()
+    for i in range(40):
+        cfg = tpe.suggest(f"t{i}")
+        assert 0 <= cfg["n"] < 4
+        seen.add(cfg["n"])
+        tpe.on_trial_complete(f"t{i}", {"score": float(cfg["n"])})
+    assert 3 in seen

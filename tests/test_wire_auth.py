@@ -94,3 +94,34 @@ def test_correct_token_accepted_raw(auth_cluster):
         assert len(rep.nodes) >= 2
     finally:
         client.close()
+
+
+def test_oversized_preauth_frame_dropped(auth_cluster):
+    """An unauthenticated peer declaring a huge first frame must be
+    disconnected immediately — servers must not buffer toward MAX_FRAME
+    for a socket that has not authenticated (anti-OOM)."""
+    import socket
+    import struct
+
+    for address in (auth_cluster.address,
+                    auth_cluster.daemons[0]["address"]):
+        host, port = address.rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=5)
+        try:
+            s.settimeout(5)
+            # declare a 512 MiB frame and start streaming garbage
+            s.sendall(struct.pack(">I", 512 << 20))
+            dropped = False
+            try:
+                for _ in range(64):
+                    s.sendall(b"\x00" * (1 << 16))
+                # server should have closed on us: recv sees EOF
+                s.settimeout(2)
+                dropped = s.recv(1) == b""
+            except (BrokenPipeError, ConnectionResetError, socket.timeout,
+                    OSError):
+                dropped = True
+            assert dropped, f"{address} kept buffering an unauthenticated " \
+                            "oversized frame"
+        finally:
+            s.close()
