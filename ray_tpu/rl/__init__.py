@@ -16,6 +16,13 @@ from ray_tpu.rl.env import (CartPoleEnv, EnvSpec, PendulumEnv, VectorEnv,
 from ray_tpu.rl.impala import Impala, ImpalaConfig
 from ray_tpu.rl.policy import Policy
 from ray_tpu.rl.ppo import PPO, PPOConfig
+from ray_tpu.rl.multi_agent import (CoordinationGameEnv, MultiAgentBatch,
+                                    MultiAgentEnv, MultiAgentPPO,
+                                    MultiAgentPPOConfig,
+                                    MultiAgentRolloutWorker,
+                                    RockPaperScissorsEnv,
+                                    register_multi_agent_env)
+from ray_tpu.rl.sac import SAC, SACConfig
 from ray_tpu.rl.replay_buffer import (PrioritizedReplayBuffer, ReplayBuffer)
 from ray_tpu.rl.rollout_worker import (RolloutWorker, WorkerSet,
                                        synchronous_parallel_sample)
@@ -26,6 +33,10 @@ __all__ = [
     "RolloutWorker", "WorkerSet", "synchronous_parallel_sample",
     "ReplayBuffer", "PrioritizedReplayBuffer",
     "PPO", "PPOConfig", "DQN", "DQNConfig", "Impala", "ImpalaConfig",
+    "SAC", "SACConfig",
+    "MultiAgentEnv", "MultiAgentBatch", "MultiAgentRolloutWorker",
+    "MultiAgentPPO", "MultiAgentPPOConfig", "CoordinationGameEnv",
+    "RockPaperScissorsEnv", "register_multi_agent_env",
     "CartPoleEnv", "PendulumEnv", "VectorEnv", "EnvSpec", "make_env",
     "register_env",
 ]

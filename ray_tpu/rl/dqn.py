@@ -226,29 +226,8 @@ class DQN(Algorithm):
                          for w in self.workers.remote_workers])
 
     def _with_next_obs(self, batch: SampleBatch) -> SampleBatch:
-        """Reconstruct NEXT_OBS from the obs column + episode boundaries.
-
-        The rollout path stores per-step OBS; for TD learning the
-        transition needs s'. Within an episode s'[t] = s[t+1]; at the
-        fragment end or episode boundary the worker's terminal obs is not
-        in the fragment, so those transitions are dropped (standard
-        fragment-boundary discard, negligible at fragment_length >= 4).
-        """
-        eps = batch[SampleBatch.EPS_ID]
-        keep = np.ones(len(batch), bool)
-        # zeros (not empty): rows at masked boundaries still pass through
-        # the target net, and garbage floats there can overflow to inf and
-        # poison 0 * inf = NaN targets.
-        next_obs = np.zeros_like(batch[SampleBatch.OBS])
-        next_obs[:-1] = batch[SampleBatch.OBS][1:]
-        for t in range(len(batch)):
-            last = t == len(batch) - 1 or eps[t + 1] != eps[t]
-            if last and not batch[SampleBatch.TERMINATEDS][t]:
-                keep[t] = False
-        out = SampleBatch({**{k: v for k, v in batch.items()},
-                           SampleBatch.NEXT_OBS: next_obs})
-        idx = np.nonzero(keep)[0]
-        return SampleBatch({k: v[idx] for k, v in out.items()})
+        from ray_tpu.rl.postprocessing import add_next_obs
+        return add_next_obs(batch)
 
     def _learner_state(self):
         return {"learner": self.learner.state(),

@@ -65,3 +65,29 @@ def compute_gae(batch: SampleBatch, last_value: float, gamma: float = 0.99,
 def standardize(x: np.ndarray) -> np.ndarray:
     """Reference: ``rllib/utils/numpy.py`` ``standardized`` (ppo.py:415)."""
     return (x - x.mean()) / max(1e-4, x.std())
+
+
+def add_next_obs(batch: SampleBatch) -> SampleBatch:
+    """Append NEXT_OBS from the obs column + episode boundaries, dropping
+    fragment-boundary rows whose successor obs never made it into the
+    fragment (standard discard; negligible at fragment_length >= 4).
+
+    Shared by the replay-based learners (DQN/SAC): within an episode
+    s'[t] = s[t+1]; at a non-terminal fragment/episode boundary the
+    transition is dropped rather than paired with a bogus successor.
+    """
+    eps = batch[SampleBatch.EPS_ID]
+    keep = np.ones(len(batch), bool)
+    # zeros (not empty): rows at masked boundaries still pass through the
+    # target net, and garbage floats there can overflow to inf and poison
+    # 0 * inf = NaN targets.
+    next_obs = np.zeros_like(batch[SampleBatch.OBS])
+    next_obs[:-1] = batch[SampleBatch.OBS][1:]
+    for t in range(len(batch)):
+        last = t == len(batch) - 1 or eps[t + 1] != eps[t]
+        if last and not batch[SampleBatch.TERMINATEDS][t]:
+            keep[t] = False
+    out = SampleBatch({**{k: v for k, v in batch.items()},
+                       SampleBatch.NEXT_OBS: next_obs})
+    idx = np.nonzero(keep)[0]
+    return SampleBatch({k: v[idx] for k, v in out.items()})

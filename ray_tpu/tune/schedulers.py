@@ -18,7 +18,7 @@ import random
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.tune.sample import Domain
-from ray_tpu.tune.trial import TERMINATED, Trial
+from ray_tpu.tune.trial import Trial
 
 CONTINUE = "CONTINUE"
 PAUSE = "PAUSE"
@@ -26,7 +26,12 @@ STOP = "STOP"
 
 
 class TrialScheduler:
-    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+    """``mode=None`` means "not configured": ``set_search_properties``
+    fills it from ``run()``'s mode. A constructor-supplied ``mode='min'``
+    must survive run()'s 'max' default (scores are negated for min)."""
+
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None,
                  time_attr: str = "training_iteration"):
         self.metric = metric
         self.mode = mode
@@ -39,14 +44,14 @@ class TrialScheduler:
     def set_search_properties(self, metric: Optional[str], mode: Optional[str]):
         if self.metric is None:
             self.metric = metric
-        if mode:
+        if self.mode is None and mode:
             self.mode = mode
 
     def _score(self, result: Dict[str, Any]) -> Optional[float]:
         if self.metric is None or self.metric not in result:
             return None
         v = float(result[self.metric])
-        return v if self.mode == "max" else -v
+        return -v if self.mode == "min" else v
 
     def on_trial_add(self, trial: Trial):
         pass
@@ -99,7 +104,8 @@ class AsyncHyperBandScheduler(TrialScheduler):
     ``grace_period * rf^k``; a trial reaching a rung is stopped if its score
     is below the rung's top-1/rf cutoff."""
 
-    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None,
                  time_attr: str = "training_iteration",
                  max_t: float = 100, grace_period: float = 1,
                  reduction_factor: float = 4, brackets: int = 1):
@@ -206,7 +212,8 @@ class HyperBandScheduler(TrialScheduler):
     which some workloads prefer for its exact halving guarantees.
     """
 
-    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None,
                  time_attr: str = "training_iteration", max_t: float = 81,
                  reduction_factor: float = 3, stop_last_trials: bool = True):
         super().__init__(metric, mode, time_attr)
@@ -322,7 +329,8 @@ class MedianStoppingRule(TrialScheduler):
     running averages of other trials at the same time step
     (reference ``median_stopping_rule.py``)."""
 
-    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None,
                  time_attr: str = "training_iteration",
                  grace_period: float = 1, min_samples_required: int = 3):
         super().__init__(metric, mode, time_attr)
@@ -353,7 +361,8 @@ class PopulationBasedTraining(TrialScheduler):
     top-quantile trial and perturbs hyperparameters in
     ``hyperparam_mutations``."""
 
-    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None,
                  time_attr: str = "training_iteration",
                  perturbation_interval: float = 5,
                  hyperparam_mutations: Optional[Dict[str, Any]] = None,

@@ -346,3 +346,174 @@ def test_algorithm_is_tune_trainable():
                         stop={"training_iteration": 2},
                         metric="episode_reward_mean", mode="max")
     assert len(analysis.trials) == 1
+
+
+# -- SAC -------------------------------------------------------------------
+
+def test_sac_policy_actions_squashed_in_bounds():
+    from ray_tpu.rl.sac import SquashedGaussianPolicy
+    env = PendulumEnv({"seed": 0})
+    pol = SquashedGaussianPolicy(env.spec, seed=0)
+    obs = np.stack([env.reset(seed=i) for i in range(16)])
+    a, logp, vf = pol.compute_actions(obs)
+    assert a.shape == (16, 1)
+    assert np.all(a >= -2.0) and np.all(a <= 2.0)
+    # deterministic mode returns the squashed mean
+    a2, _, _ = pol.compute_actions(obs, explore=False)
+    a3, _, _ = pol.compute_actions(obs, explore=False)
+    np.testing.assert_allclose(a2, a3)
+
+
+def test_sac_requires_continuous_actions():
+    from ray_tpu.rl.sac import SquashedGaussianPolicy
+    env = CartPoleEnv({})
+    with pytest.raises(ValueError):
+        SquashedGaussianPolicy(env.spec, seed=0)
+
+
+def test_sac_learns_pendulum():
+    """Learning gate (reference pass-criteria style): SAC must lift
+    Pendulum return from the ~-1300 random level to > -1000 within a
+    small step budget."""
+    from ray_tpu.rl import SAC
+    algo = (SAC.get_default_config()
+            .environment("Pendulum-v1")
+            .training(train_batch_size=128, n_updates_per_iter=8,
+                      num_steps_sampled_before_learning_starts=256)
+            .debugging(seed=0)
+            .build())
+    try:
+        early = []
+        for i in range(900):
+            r = algo.step()
+            rew = r.get("episode_reward_mean")
+            if rew is not None and len(early) < 5:
+                early.append(rew)
+        final = r["episode_reward_mean"]
+        # measured trajectory (seed 0): -1300s at start, ~-680 by 800
+        # iters, -387 by 1800. The reported mean lags (100-episode
+        # window), so gate at -800 with a 100-pt improvement check.
+        assert final > -800, (early, final)
+        assert final - float(np.mean(early)) > 100, (early, final)
+    finally:
+        algo.stop()
+
+
+def test_sac_checkpoint_restore_roundtrip(tmp_path):
+    from ray_tpu.rl import SAC
+    algo = (SAC.get_default_config()
+            .environment("Pendulum-v1")
+            .training(train_batch_size=32, n_updates_per_iter=1,
+                      num_steps_sampled_before_learning_starts=16)
+            .debugging(seed=1)
+            .build())
+    try:
+        for _ in range(5):
+            algo.step()
+        state = algo.__getstate__()
+        algo2 = (SAC.get_default_config()
+                 .environment("Pendulum-v1")
+                 .debugging(seed=2)
+                 .build())
+        try:
+            algo2.__setstate__(state)
+            w1 = algo.get_weights()
+            w2 = algo2.get_weights()
+            for a, b in zip(np.asarray(w1["actor"]["layers"][0]["w"]).flat,
+                            np.asarray(w2["actor"]["layers"][0]["w"]).flat):
+                assert a == b
+        finally:
+            algo2.stop()
+    finally:
+        algo.stop()
+
+
+# -- multi-agent -----------------------------------------------------------
+
+def test_multi_agent_env_contract():
+    from ray_tpu.rl import CoordinationGameEnv, RockPaperScissorsEnv
+    for env_cls in (CoordinationGameEnv, RockPaperScissorsEnv):
+        env = env_cls({"episode_len": 5})
+        obs = env.reset()
+        assert set(obs) == set(env.agent_ids)
+        for t in range(5):
+            acts = {a: env.action_spaces[a].sample(
+                np.random.default_rng(t)) for a in env.agent_ids}
+            obs, rews, terms, truncs, infos = env.step(acts)
+            assert set(rews) == set(env.agent_ids)
+            assert "__all__" in terms and "__all__" in truncs
+        assert truncs["__all__"]  # episode_len reached
+
+
+def test_rock_paper_scissors_zero_sum():
+    from ray_tpu.rl import RockPaperScissorsEnv
+    env = RockPaperScissorsEnv({"episode_len": 50})
+    env.reset()
+    for m0 in range(3):
+        for m1 in range(3):
+            _, rews, _, _, _ = env.step(
+                {"player_0": m0, "player_1": m1})
+            assert rews["player_0"] + rews["player_1"] == 0.0
+
+
+def test_multi_agent_rollout_worker_per_policy_batches():
+    from ray_tpu.rl import CoordinationGameEnv, MultiAgentRolloutWorker
+    w = MultiAgentRolloutWorker(lambda c: CoordinationGameEnv(c),
+                                rollout_fragment_length=40, seed=0)
+    ma = w.sample()
+    assert sorted(ma) == ["agent_0", "agent_1"]
+    assert ma.env_steps == 40 and ma.agent_steps() == 80
+    for b in ma.values():
+        assert SB.ADVANTAGES in b and SB.VALUE_TARGETS in b
+        assert len(b[SB.OBS]) == 40
+
+
+def test_multi_agent_policy_mapping_shares_policy():
+    from ray_tpu.rl import CoordinationGameEnv, MultiAgentRolloutWorker
+    w = MultiAgentRolloutWorker(lambda c: CoordinationGameEnv(c),
+                                policy_mapping_fn=lambda aid: "shared",
+                                rollout_fragment_length=10, seed=0)
+    assert sorted(w.policies) == ["shared"]
+    ma = w.sample()
+    assert sorted(ma) == ["shared"]
+    assert len(ma["shared"]) == 20  # both agents' steps in one batch
+    assert ma.env_steps == 10       # but only 10 true env steps
+
+
+def test_independent_ppo_learns_coordination():
+    """Independent learners must find the payoff-dominant equilibrium of
+    the coordination game (both pick 0 -> 1.0/step; max 25/episode)."""
+    from ray_tpu.rl import MultiAgentPPO
+    algo = (MultiAgentPPO.get_default_config()
+            .environment("CoordinationGame")
+            .training(train_batch_size=200, sgd_minibatch_size=50,
+                      num_sgd_iter=8, lr=3e-3, entropy_coeff=0.01)
+            .debugging(seed=0)
+            .build())
+    try:
+        for _ in range(25):
+            r = algo.step()
+        assert r["episode_reward_mean"] > 15.0, r["episode_reward_mean"]
+    finally:
+        algo.stop()
+
+
+def test_shared_policy_batches_are_agent_contiguous():
+    """With a shared policy, each agent's trajectory must be a contiguous
+    GAE'd segment — interleaving rows would chain one agent's value
+    recursion through the other's rewards (regression)."""
+    from ray_tpu.rl import MultiAgentRolloutWorker, RockPaperScissorsEnv
+    w = MultiAgentRolloutWorker(lambda c: RockPaperScissorsEnv(c),
+                                env_config={"episode_len": 10},
+                                policy_mapping_fn=lambda aid: "shared",
+                                rollout_fragment_length=10, seed=0)
+    ma = w.sample()
+    b = ma["shared"]
+    assert len(b) == 20 and ma.env_steps == 10
+    truncs = np.nonzero(b[SB.TRUNCATEDS])[0].tolist()
+    # one truncation at the end of EACH agent's contiguous 10-row block
+    assert truncs == [9, 19], truncs
+    # zero-sum: per-episode rewards of the two blocks are exact negations
+    np.testing.assert_allclose(b[SB.REWARDS][:10], -b[SB.REWARDS][10:])
+    assert np.isfinite(b[SB.ADVANTAGES]).all()
+    assert "bootstrap_values" in b  # truncation bootstraps V(terminal obs)

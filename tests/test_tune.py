@@ -483,3 +483,22 @@ def test_tpe_integer_stays_in_domain():
         seen.add(cfg["n"])
         tpe.on_trial_complete(f"t{i}", {"score": float(cfg["n"])})
     assert 3 in seen
+
+
+def test_hyperband_min_mode_survives_run_default(tmp_path):
+    """A scheduler built with mode='min' must not be flipped to 'max' by
+    run()'s default — the lowest-metric trial has to win (regression)."""
+    def trainable(config):
+        for i in range(20):
+            tune.report(loss=config["q"] * (i + 1), score=0.0)
+
+    sched = tune.HyperBandScheduler(metric="loss", mode="min",
+                                    max_t=9, reduction_factor=3)
+    analysis = tune.run(trainable,
+                        config={"q": tune.grid_search(list(range(1, 10)))},
+                        metric="score", mode="max", scheduler=sched,
+                        max_concurrent_trials=3, local_dir=str(tmp_path),
+                        verbose=0)
+    iters = {t.config["q"]: len(t.results) for t in analysis.trials}
+    assert iters[1] == 9      # lowest loss runs to max_t
+    assert iters[9] == 1      # highest loss cut at the first milestone
