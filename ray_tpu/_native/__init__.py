@@ -8,4 +8,5 @@ cached next to the source.
 """
 
 from ray_tpu._native.build import load_native_library  # noqa: F401
-from ray_tpu._native.store import NativeObjectStore  # noqa: F401
+from ray_tpu._native.store import (NativeObjectStore,  # noqa: F401
+                                   NativeStoreClient)

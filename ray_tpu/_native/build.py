@@ -37,7 +37,7 @@ def load_native_library(name: str) -> Optional[ctypes.CDLL]:
                 tmp = so + ".tmp"
                 subprocess.run(
                     ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                     "-o", tmp, src],
+                     "-pthread", "-o", tmp, src],
                     check=True, capture_output=True, text=True)
                 os.replace(tmp, so)
             lib = ctypes.CDLL(so)

@@ -4,6 +4,11 @@ The Python face of ``object_store.cc`` (plasma client role, reference
 ``src/ray/object_manager/plasma/client.h``): put/get of immutable byte
 payloads in the mmap arena, zero-copy reads via memoryview, LRU eviction
 candidates for the spilling path.
+
+``NativeObjectStore`` owns an arena in-process; ``NativeStoreClient``
+joins another process's served arena over its Unix socket (fd-passing) —
+both expose the same op surface through ``_ArenaOps``, parameterized only
+by the C symbol family (``nps_*`` vs ``npc_*``).
 """
 
 from __future__ import annotations
@@ -13,51 +18,41 @@ from typing import List, Optional, Tuple
 
 from ray_tpu._native.build import load_native_library
 
+_PTR = ctypes.POINTER(ctypes.c_uint8)
+_U64P = ctypes.POINTER(ctypes.c_uint64)
 
-class NativeObjectStore:
-    """Thin, thread-safe wrapper; raises ``RuntimeError`` if the native
-    library cannot be built (callers should gate on ``available()``)."""
 
-    @staticmethod
-    def available() -> bool:
-        return load_native_library("object_store") is not None
+def _bind_ops(lib: ctypes.CDLL, prefix: str) -> dict:
+    """Bind the shared op family ``<prefix>_{create_object,seal,...}``
+    once per library (argtypes are idempotent to re-set)."""
+    ops = {}
+    f = ops["create"] = getattr(lib, f"{prefix}_create_object")
+    f.restype = ctypes.c_int
+    f.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+                  ctypes.POINTER(_PTR)]
+    f = ops["seal"] = getattr(lib, f"{prefix}_seal")
+    f.restype = ctypes.c_int
+    f.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    f = ops["get"] = getattr(lib, f"{prefix}_get")
+    f.restype = ctypes.c_int
+    f.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(_PTR),
+                  _U64P, ctypes.c_int]
+    for name in ("unpin", "delete", "contains"):
+        f = ops[name] = getattr(lib, f"{prefix}_{name}")
+        f.restype = ctypes.c_int
+        f.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    f = ops["stats"] = getattr(lib, f"{prefix}_stats")
+    f.argtypes = [ctypes.c_void_p, _U64P, _U64P, _U64P]
+    return ops
 
-    def __init__(self, capacity_bytes: int):
-        lib = load_native_library("object_store")
-        if lib is None:
-            raise RuntimeError("native object store unavailable")
-        self._lib = lib
-        lib.nps_create.restype = ctypes.c_void_p
-        lib.nps_create.argtypes = [ctypes.c_uint64]
-        lib.nps_destroy.argtypes = [ctypes.c_void_p]
-        lib.nps_create_object.restype = ctypes.c_int
-        lib.nps_create_object.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
-            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
-        lib.nps_seal.restype = ctypes.c_int
-        lib.nps_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-        lib.nps_get.restype = ctypes.c_int
-        lib.nps_get.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p,
-            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
-            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
-        lib.nps_unpin.restype = ctypes.c_int
-        lib.nps_unpin.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-        lib.nps_delete.restype = ctypes.c_int
-        lib.nps_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-        lib.nps_contains.restype = ctypes.c_int
-        lib.nps_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-        lib.nps_evict_candidates.restype = ctypes.c_uint64
-        lib.nps_evict_candidates.argtypes = [
-            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
-            ctypes.c_uint64]
-        lib.nps_stats.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
-            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
-        self._handle = lib.nps_create(capacity_bytes)
-        if not self._handle:
-            raise RuntimeError("failed to create native store arena")
-        self.capacity = capacity_bytes
+
+class _ArenaOps:
+    """Shared op surface over a store handle (owner or client)."""
+
+    _lib: ctypes.CDLL
+    _handle: int
+    _ops: dict
+    capacity: int
 
     @staticmethod
     def _key(object_id: bytes) -> bytes:
@@ -69,30 +64,32 @@ class NativeObjectStore:
         """Create+write+seal. False if the id exists; raises MemoryError
         when the arena is full (caller evicts/spills then retries)."""
         key = self._key(object_id)
-        out = ctypes.POINTER(ctypes.c_uint8)()
-        rc = self._lib.nps_create_object(
-            self._handle, key, len(data), ctypes.byref(out))
+        out = _PTR()
+        rc = self._ops["create"](self._handle, key, len(data),
+                                 ctypes.byref(out))
         if rc == -1:
             return False
         if rc == -2:
             raise MemoryError(
-                f"native store full ({self.capacity} bytes); evict first")
+                f"arena full ({self.capacity} bytes); evict first")
+        if rc != 0:
+            raise RuntimeError(f"arena create failed rc={rc}")
         if data:
             ctypes.memmove(out, data, len(data))
-        self._lib.nps_seal(self._handle, key)
+        self._ops["seal"](self._handle, key)
         return True
 
     def get(self, object_id: bytes) -> Optional[memoryview]:
         """Zero-copy read. The object is pinned until ``release``."""
         key = self._key(object_id)
-        ptr = ctypes.POINTER(ctypes.c_uint8)()
+        ptr = _PTR()
         size = ctypes.c_uint64()
-        rc = self._lib.nps_get(self._handle, key, ctypes.byref(ptr),
-                               ctypes.byref(size), 1)
+        rc = self._ops["get"](self._handle, key, ctypes.byref(ptr),
+                              ctypes.byref(size), 1)
         if rc != 0:
             return None
         if size.value == 0:
-            self._lib.nps_unpin(self._handle, key)
+            self._ops["unpin"](self._handle, key)
             return memoryview(b"")
         array = (ctypes.c_uint8 * size.value).from_address(
             ctypes.addressof(ptr.contents))
@@ -109,15 +106,53 @@ class NativeObjectStore:
             self.release(object_id)
 
     def release(self, object_id: bytes) -> None:
-        self._lib.nps_unpin(self._handle, self._key(object_id))
+        self._ops["unpin"](self._handle, self._key(object_id))
 
     def delete(self, object_id: bytes) -> bool:
-        return self._lib.nps_delete(self._handle,
-                                    self._key(object_id)) == 0
+        return self._ops["delete"](self._handle,
+                                   self._key(object_id)) == 0
 
     def contains(self, object_id: bytes) -> bool:
-        return bool(self._lib.nps_contains(self._handle,
-                                           self._key(object_id)))
+        return self._ops["contains"](self._handle,
+                                     self._key(object_id)) == 1
+
+    def stats(self) -> Tuple[int, int, int]:
+        """-> (used_bytes, capacity_bytes, num_objects)."""
+        used = ctypes.c_uint64()
+        cap = ctypes.c_uint64()
+        count = ctypes.c_uint64()
+        self._ops["stats"](self._handle, ctypes.byref(used),
+                           ctypes.byref(cap), ctypes.byref(count))
+        return used.value, cap.value or self.capacity, count.value
+
+
+class NativeObjectStore(_ArenaOps):
+    """Arena owner; raises ``RuntimeError`` if the native library cannot
+    be built (callers should gate on ``available()``)."""
+
+    @staticmethod
+    def available() -> bool:
+        return load_native_library("object_store") is not None
+
+    def __init__(self, capacity_bytes: int):
+        lib = load_native_library("object_store")
+        if lib is None:
+            raise RuntimeError("native object store unavailable")
+        self._lib = lib
+        self._ops = _bind_ops(lib, "nps")
+        lib.nps_create.restype = ctypes.c_void_p
+        lib.nps_create.argtypes = [ctypes.c_uint64]
+        lib.nps_destroy.argtypes = [ctypes.c_void_p]
+        lib.nps_serve.restype = ctypes.c_int
+        lib.nps_serve.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.nps_evict_candidates.restype = ctypes.c_uint64
+        lib.nps_evict_candidates.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_uint64]
+        self._handle = lib.nps_create(capacity_bytes)
+        if not self._handle:
+            raise RuntimeError("failed to create native store arena")
+        self.capacity = capacity_bytes
 
     def evict_candidates(self, nbytes: int,
                          max_candidates: int = 1024) -> List[bytes]:
@@ -127,17 +162,47 @@ class NativeObjectStore:
                                            max_candidates)
         return [buf.raw[i * 16:(i + 1) * 16] for i in range(n)]
 
-    def stats(self) -> Tuple[int, int, int]:
-        """-> (used_bytes, capacity_bytes, num_objects)."""
-        used = ctypes.c_uint64()
-        cap = ctypes.c_uint64()
-        count = ctypes.c_uint64()
-        self._lib.nps_stats(self._handle, ctypes.byref(used),
-                            ctypes.byref(cap), ctypes.byref(count))
-        return used.value, cap.value, count.value
+    def serve(self, path: str) -> bool:
+        """Serve this arena over a Unix domain socket: same-host peer
+        processes connect with ``NativeStoreClient`` and map the SAME
+        memfd pages (fd passed via SCM_RIGHTS) — a shared-memory read, not
+        a TCP round-trip. Idempotent per store; refuses a store whose
+        mapping fell back to private (nothing to share)."""
+        return self._lib.nps_serve(self._handle, path.encode()) == 0
 
     def __del__(self):
         handle = getattr(self, "_handle", None)
         if handle:
             self._lib.nps_destroy(handle)
             self._handle = None
+
+
+class NativeStoreClient(_ArenaOps):
+    """Same-host client of a served arena: identical surface to
+    ``NativeObjectStore`` but reads/writes the OWNER's pages through the
+    passed memfd (plasma client role, ``plasma/client.cc``)."""
+
+    def __init__(self, socket_path: str):
+        lib = load_native_library("object_store")
+        if lib is None:
+            raise RuntimeError("native object store unavailable")
+        self._lib = lib
+        self._ops = _bind_ops(lib, "npc")
+        lib.npc_connect.restype = ctypes.c_void_p
+        lib.npc_connect.argtypes = [ctypes.c_char_p]
+        lib.npc_close.argtypes = [ctypes.c_void_p]
+        lib.npc_capacity.restype = ctypes.c_uint64
+        lib.npc_capacity.argtypes = [ctypes.c_void_p]
+        self._handle = lib.npc_connect(socket_path.encode())
+        if not self._handle:
+            raise RuntimeError(f"cannot connect to arena at {socket_path}")
+        self.capacity = lib.npc_capacity(self._handle)
+
+    def close(self) -> None:
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.npc_close(handle)
+            self._handle = None
+
+    def __del__(self):
+        self.close()

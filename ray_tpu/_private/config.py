@@ -110,6 +110,16 @@ _config.define("heartbeat_interval_ms", int, 100, "node heartbeat period")
 _config.define("num_heartbeats_timeout", int, 30, "missed heartbeats before a node is dead")
 _config.define("health_check_period_ms", int, 1000, "actor health check period")
 
+# -- Host-shared object plane ---------------------------------------------------
+_config.define("arena_enabled", bool, True,
+               "share one shm arena per host between daemons (fd-passing)")
+_config.define("arena_capacity_mb", int, 256, "host arena size")
+_config.define("object_push_threshold_bytes", int, 256 * 1024,
+               "proactively push task args at least this large to the "
+               "executing daemon (push_manager.h role)")
+_config.define("object_push_window_bytes", int, 32 * 1024 * 1024,
+               "per-peer in-flight push budget (backpressure window)")
+
 # -- Collectives / device plane -------------------------------------------------
 _config.define("collective_default_backend", str, "xla", "xla | cpu")
 _config.define("ici_axes_preference", str, "data,fsdp,tensor",

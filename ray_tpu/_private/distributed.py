@@ -233,6 +233,26 @@ class DistributedRuntime(Runtime):
         self._borrow_q_lock = threading.Lock()
         self._borrow_registered: set = set()
 
+        # Host-shared object plane: the first daemon on a host owns one shm
+        # arena (memfd) and serves it over a UDS; same-host peers map the
+        # SAME pages via fd-passing, so a local "transfer" is a shared-
+        # memory read, not a TCP stream (reference: plasma store socket,
+        # src/ray/object_manager/plasma/store.h).
+        self.host_arena = None
+        self.host_arena_key = ""
+        self._arena_is_owner = False
+        if _config.get("arena_enabled"):
+            try:
+                self._setup_host_arena(is_driver)
+            except Exception as e:  # degrade to TCP pulls
+                logger.debug("host arena unavailable: %s", e)
+        # Proactive pushes of large task args to the executing daemon
+        # (reference: push_manager.h), window-limited per peer.
+        self._push_mgr = _PushManager(self)
+        self._incoming_pushes: Dict[ObjectID, io.BytesIO] = {}
+        self._incoming_push_seen: Dict[ObjectID, float] = {}
+        self._incoming_pushes_lock = threading.Lock()
+
         # Pubsub: node lifecycle.
         self.state.subscribe(["nodes"], self._on_node_event)
         self._refresh_view()
@@ -244,6 +264,95 @@ class DistributedRuntime(Runtime):
         self._view_thread = threading.Thread(target=self._view_loop,
                                              daemon=True, name="dist-view")
         self._view_thread.start()
+
+    # ----------------------------------------------------- host arena plane
+
+    def _setup_host_arena(self, is_driver: bool, _retry: bool = True):
+        """Own or join this host's shared arena, brokered through the
+        state-service KV (namespace ``arena``, key = hostname). Daemons
+        race to own (CAS put); losers and drivers connect as clients. A
+        stale entry (owner crashed, socket dead) is repaired: the joiner
+        deletes it and re-runs the race so a healthy daemon can take over."""
+        import socket as _socket
+        from ray_tpu._native import NativeObjectStore, NativeStoreClient
+        if not NativeObjectStore.available():
+            return
+        host_key = _socket.gethostname().encode()
+        ns = b"arena"
+        if not is_driver:
+            path = (f"/tmp/ray_tpu_arena_{os.getpid()}_"
+                    f"{abs(hash(self.address)) % 100000}.sock")
+            if self.state.kv_put(host_key, path.encode(), overwrite=False,
+                                 namespace=ns):
+                cap = _config.get("arena_capacity_mb") * (1 << 20)
+                store = NativeObjectStore(cap)
+                if store.serve(path):
+                    self.host_arena = store
+                    self.host_arena_key = path
+                    self._arena_is_owner = True
+                    self._arena_host_key = host_key
+                    logger.debug("serving host arena at %s (%d MB)", path,
+                                 cap >> 20)
+                else:
+                    # don't squat on the hostname with a dead entry
+                    self.state.kv_del(host_key, namespace=ns)
+                return
+        existing = self.state.kv_get(host_key, namespace=ns)
+        if existing:
+            try:
+                self.host_arena = NativeStoreClient(existing.decode())
+                self.host_arena_key = existing.decode()
+                logger.debug("joined host arena at %s", self.host_arena_key)
+            except Exception:
+                # stale entry from a dead owner: clear it and re-race once
+                # (a daemon may now win ownership; a driver re-joins)
+                self.host_arena = None
+                try:
+                    self.state.kv_del(host_key, namespace=ns)
+                except Exception:
+                    return
+                if _retry:
+                    self._setup_host_arena(is_driver, _retry=False)
+
+    @staticmethod
+    def _arena_payload_key(oid: ObjectID, payload: bytes) -> bytes:
+        """Content-bound arena key: a reconstructed object whose bytes
+        differ (e.g. a recomputed result embedding a fresh pid) must NOT
+        alias the stale entry of its predecessor."""
+        h = hashlib.blake2b(digest_size=16)
+        h.update(oid.binary())
+        h.update(hashlib.blake2b(payload, digest_size=16).digest())
+        return h.digest()
+
+    def _arena_put(self, key: bytes, payload: bytes) -> bool:
+        """Best-effort drop of a serialized payload into the shared arena.
+        The owner evicts LRU (sealed, unpinned) entries to make room; a
+        client simply gives up on full (it cannot evict others' objects)."""
+        arena = self.host_arena
+        if arena is None:
+            return False
+        try:
+            return arena.put(key, payload)
+        except MemoryError:
+            if not self._arena_is_owner:
+                return False
+            try:
+                for victim in arena.evict_candidates(len(payload)):
+                    arena.delete(victim)
+                return arena.put(key, payload)
+            except MemoryError:
+                return False
+        except Exception:
+            return False
+
+    def _arena_get(self, key: bytes) -> Optional[bytes]:
+        arena = self.host_arena
+        if arena is None:
+            return None
+        try:
+            return arena.get_bytes(key)
+        except Exception:
+            return None
 
     # ------------------------------------------------------------- lifecycle
 
@@ -380,6 +489,25 @@ class DistributedRuntime(Runtime):
 
     def shutdown(self):
         self._hb_stop.set()
+        self._push_mgr.close()
+        if self.host_arena is not None:
+            if self._arena_is_owner:
+                # release the hostname claim so a future daemon can own a
+                # fresh arena, and remove the socket file
+                try:
+                    self.state.kv_del(self._arena_host_key,
+                                      namespace=b"arena")
+                except Exception:
+                    pass
+                try:
+                    os.unlink(self.host_arena_key)
+                except OSError:
+                    pass
+            else:
+                try:
+                    self.host_arena.close()
+                except Exception:
+                    pass
         with self._borrow_q_lock:
             for q in self._borrow_qs.values():
                 q.put(None)
@@ -645,22 +773,33 @@ class DistributedRuntime(Runtime):
         return None, False
 
     def _fetch_from(self, addr: str, oid: ObjectID):
-        """Chunked pull of a pickled object. Returns (value | _FETCH_MISS,
+        """Pull of a pickled object. Same-host owners serve through the
+        shared arena (one shm read, zero payload bytes on the wire);
+        otherwise chunked TCP. Returns (value | _FETCH_MISS,
         error_or_none)."""
         client = self.pool.get(addr)
         buf = io.BytesIO()
         offset = 0
+        arena_key = self.host_arena_key
         while True:
             rep = pb.FetchObjectReply()
             rep.ParseFromString(client.call(
                 pb.FETCH_OBJECT, pb.FetchObjectRequest(
                     object_id=oid.binary(), offset=offset,
-                    max_bytes=FETCH_CHUNK).SerializeToString(),
+                    max_bytes=FETCH_CHUNK,
+                    arena_key=arena_key).SerializeToString(),
                 timeout=120).body)
             if not rep.found:
                 return _FETCH_MISS, None
             if rep.error_pickle:
                 return _FETCH_MISS, pickle.loads(rep.error_pickle)
+            if rep.in_arena:
+                payload = self._arena_get(bytes(rep.arena_object_key))
+                if payload is not None:
+                    return pickle.loads(payload), None
+                # raced an eviction: retry over TCP
+                arena_key = ""
+                continue
             buf.write(rep.data)
             offset += len(rep.data)
             if rep.eof or not rep.data:
@@ -1023,6 +1162,20 @@ class DistributedRuntime(Runtime):
             client.call_async(method, msg.SerializeToString(), _done)
         except Exception as e:  # connection refused etc.
             self._on_remote_reply(spec, attempt, addr, cancel, None, e)
+            return
+        # Proactively stream large arg objects to the executor (the
+        # reference's push path) — skipped when the peer shares our host
+        # arena, where the pull is already one shm read.
+        threshold = int(_config.get("object_push_threshold_bytes"))
+        if threshold > 0 and arg_pins and not (
+                self.host_arena is not None and self._same_host(addr)):
+            for oid in arg_pins:
+                if self.local_node.store.contains(oid):
+                    self._push_mgr.maybe_push(addr, oid, threshold)
+
+    def _same_host(self, addr: str) -> bool:
+        return (addr.rsplit(":", 1)[0]
+                == self.address.rsplit(":", 1)[0])
 
     def _on_remote_reply(self, spec: TaskSpec, attempt: int, addr: str,
                          cancel, env, error):
@@ -1610,6 +1763,8 @@ class DistributedRuntime(Runtime):
             ctx.reply()
         elif method == pb.FETCH_OBJECT:
             self._handle_fetch_object(ctx)
+        elif method == pb.PUSH_OBJECT:
+            self._handle_push_object(ctx)
         elif method == pb.RESERVE_BUNDLE:
             req = pb.BundleRequest()
             req.ParseFromString(ctx.body)
@@ -1979,6 +2134,66 @@ class DistributedRuntime(Runtime):
                 self._fetch_cache.pop(next(iter(self._fetch_cache)))
         return payload
 
+    def _handle_push_object(self, ctx: RpcContext):
+        """Receiver half of the push path: chunks accumulate per object;
+        at EOF the value lands in the local store exactly like a completed
+        pull (location advertised), so the executor resolves it locally."""
+        req = pb.PushObjectRequest()
+        req.ParseFromString(ctx.body)
+        oid = ObjectID(req.object_id)
+        rep = pb.PushObjectReply(accepted=True)
+        store = self.local_node.store
+        if store.contains(oid):
+            rep.accepted = False
+            with self._incoming_pushes_lock:
+                self._incoming_pushes.pop(oid, None)
+                self._incoming_push_seen.pop(oid, None)
+            ctx.reply(rep.SerializeToString())
+            return
+        done = False
+        now = time.monotonic()
+        with self._incoming_pushes_lock:
+            # expire half-received streams whose sender died without eof —
+            # they must not accumulate for the daemon's lifetime
+            for stale in [o for o, t in self._incoming_push_seen.items()
+                          if now - t > 60.0]:
+                self._incoming_pushes.pop(stale, None)
+                self._incoming_push_seen.pop(stale, None)
+            buf = self._incoming_pushes.get(oid)
+            if buf is None:
+                buf = self._incoming_pushes[oid] = io.BytesIO()
+            self._incoming_push_seen[oid] = now
+            if req.offset != buf.tell():
+                if req.offset == 0:   # sender restarted
+                    buf.seek(0)
+                    buf.truncate()
+                else:                 # out-of-order: abandon this stream
+                    self._incoming_pushes.pop(oid, None)
+                    self._incoming_push_seen.pop(oid, None)
+                    rep.accepted = False
+                    ctx.reply(rep.SerializeToString())
+                    return
+            buf.write(req.data)
+            if req.eof:
+                self._incoming_pushes.pop(oid, None)
+                self._incoming_push_seen.pop(oid, None)
+                done = True
+        if done:
+            try:
+                value = pickle.loads(buf.getvalue())
+            except Exception:
+                ctx.reply(rep.SerializeToString())
+                return
+            store.put(oid, value)
+            with self.lock:
+                self.object_locations[oid] = self.local_node.node_id
+            try:
+                self.state.add_location(
+                    oid.binary(), self.local_node.node_id.binary())
+            except Exception:
+                pass
+        ctx.reply(rep.SerializeToString())
+
     def _handle_fetch_object(self, ctx: RpcContext):
         req = pb.FetchObjectRequest()
         req.ParseFromString(ctx.body)
@@ -2007,6 +2222,19 @@ class DistributedRuntime(Runtime):
             return
         rep.found = True
         rep.total_size = len(payload)
+        # Same-host requester: hand the payload over through the shared
+        # arena instead of streaming it back over TCP.
+        if (req.offset == 0 and req.arena_key
+                and req.arena_key == self.host_arena_key
+                and self.host_arena is not None):
+            key = self._arena_payload_key(oid, payload)
+            if (self.host_arena.contains(key)
+                    or self._arena_put(key, payload)):
+                rep.in_arena = True
+                rep.arena_object_key = key
+                rep.eof = True
+                ctx.reply(rep.SerializeToString())
+                return
         end = min(len(payload), req.offset + (req.max_bytes or FETCH_CHUNK))
         rep.data = payload[req.offset:end]
         rep.eof = end >= len(payload)
@@ -2014,3 +2242,85 @@ class DistributedRuntime(Runtime):
 
 
 _FETCH_MISS = object()
+
+
+class _PushManager:
+    """Owner-side proactive object pushes with per-peer backpressure.
+
+    The role of the reference's PushManager
+    (``src/ray/object_manager/push_manager.h:29``): when a task is pushed
+    to a remote daemon, its large argument objects are streamed there
+    ahead of execution so the executor's ``_resolve_refs`` finds them
+    locally instead of stalling on a pull. In-flight bytes per peer are
+    capped (``object_push_window_bytes``); pushes are an optimization —
+    any failure falls back silently to the authoritative pull path.
+    """
+
+    def __init__(self, rt: "DistributedRuntime"):
+        from concurrent.futures import ThreadPoolExecutor
+        self.rt = rt
+        self.window = int(_config.get("object_push_window_bytes"))
+        self._cv = threading.Condition()
+        self._inflight: Dict[str, int] = {}       # addr -> bytes on the wire
+        self._active: set = set()                 # (addr, oid) deduplication
+        self._pool = ThreadPoolExecutor(max_workers=4,
+                                        thread_name_prefix="obj-push")
+        self._closed = False
+        self.pushes_initiated = 0  # monotone; observable in tests/metrics
+
+    def maybe_push(self, addr: str, oid: ObjectID, threshold: int):
+        with self._cv:
+            if self._closed or (addr, oid) in self._active:
+                return
+            self._active.add((addr, oid))
+            self.pushes_initiated += 1
+        self._pool.submit(self._run, addr, oid, threshold)
+
+    def _run(self, addr: str, oid: ObjectID, threshold: int):
+        try:
+            payload = self.rt._serialized_for_fetch(oid)
+            if len(payload) < threshold:
+                return
+            client = self.rt.pool.get(addr)
+            offset = 0
+            while offset < len(payload) or offset == 0:
+                chunk = payload[offset:offset + FETCH_CHUNK]
+                eof = offset + len(chunk) >= len(payload)
+                with self._cv:
+                    while (not self._closed
+                           and self._inflight.get(addr, 0) + len(chunk)
+                           > self.window
+                           and self._inflight.get(addr, 0) > 0):
+                        self._cv.wait(timeout=1.0)
+                    if self._closed:
+                        return
+                    self._inflight[addr] = (self._inflight.get(addr, 0)
+                                            + len(chunk))
+                try:
+                    rep = pb.PushObjectReply()
+                    rep.ParseFromString(client.call(
+                        pb.PUSH_OBJECT, pb.PushObjectRequest(
+                            object_id=oid.binary(), offset=offset,
+                            total_size=len(payload), data=chunk,
+                            eof=eof).SerializeToString(), timeout=120).body)
+                finally:
+                    with self._cv:
+                        self._inflight[addr] = max(
+                            0, self._inflight.get(addr, 0) - len(chunk))
+                        self._cv.notify_all()
+                if not rep.accepted:
+                    return  # receiver already has it
+                offset += len(chunk)
+                if eof:
+                    return
+        except Exception:
+            pass  # pull path remains authoritative
+        finally:
+            with self._cv:
+                self._active.discard((addr, oid))
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._pool.shutdown(wait=False, cancel_futures=True)

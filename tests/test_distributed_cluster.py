@@ -223,3 +223,95 @@ def test_spillback_on_infeasible_local(cluster):
 
     with pytest.raises(ray_tpu.exceptions.RayTpuError):
         ray_tpu.get(impossible.remote(), timeout=60)
+
+
+# -- host-shared object plane ----------------------------------------------
+
+def test_same_host_fetch_goes_through_arena(cluster):
+    """Daemons + driver on one host share the shm arena: a large fetch
+    lands the payload in the arena (fd-passed memfd pages), not in a TCP
+    stream. (plasma store.h role)"""
+    rt = ray_tpu._private.worker.global_worker().runtime
+    if rt.host_arena is None:
+        pytest.skip("native arena unavailable in this environment")
+
+    @ray_tpu.remote
+    def produce():
+        return np.full((700, 700), 3.25)  # ~3.9 MB
+
+    before = rt.host_arena.stats()[2]
+    val = ray_tpu.get(produce.remote(), timeout=60)
+    assert float(val[0, 0]) == 3.25
+    used, cap, count = rt.host_arena.stats()
+    assert count >= before + 1, "payload should be cached in the arena"
+    assert used > 3_000_000
+
+
+def test_arena_survives_repeat_fetches_and_eviction(cluster):
+    rt = ray_tpu._private.worker.global_worker().runtime
+    if rt.host_arena is None:
+        pytest.skip("native arena unavailable")
+
+    @ray_tpu.remote
+    def make(i):
+        return np.full((256, 256), float(i))
+
+    refs = [make.remote(i) for i in range(6)]
+    for i, r in enumerate(refs):
+        v = ray_tpu.get(r, timeout=60)
+        assert float(v[0, 0]) == float(i)
+    # re-fetch: second consumer path hits the existing arena entries
+    for i, r in enumerate(refs):
+        rt.local_node.store.free(r.id())
+        rt._location_hints.pop(r.id(), None)
+        v = ray_tpu.get(r, timeout=60)
+        assert float(v[0, 0]) == float(i)
+
+
+def test_push_path_streams_object_to_peer():
+    """With the arena off, large task args are proactively pushed to the
+    executing daemon with windowed backpressure (push_manager.h role)."""
+    ray_tpu.shutdown()
+    os.environ["RAY_TPU_ARENA_ENABLED"] = "0"
+    c = ProcessCluster(num_daemons=2, num_cpus=2)
+    try:
+        ray_tpu.init(address=c.address,
+                     _system_config={"arena_enabled": False,
+                                     "object_push_threshold_bytes": 4096})
+        rt = ray_tpu._private.worker.global_worker().runtime
+        assert rt.host_arena is None
+
+        big = ray_tpu.put(np.full((600, 600), 1.5))  # ~2.9 MB driver-local
+
+        # 1) deterministic: push directly to a chosen daemon (no pull race)
+        target = c.daemons[1]["address"]
+        rt._push_mgr.maybe_push(target, big.id(), 4096)
+        deadline = time.monotonic() + 30
+        addrs = []
+        while time.monotonic() < deadline:
+            rep = rt.state.get_locations(big.id().binary())
+            addrs = list(rep.addresses)
+            if target in addrs:
+                break
+            time.sleep(0.2)
+        assert target in addrs, addrs
+
+        # 2) end-to-end: a dependent task resolves the arg (push or pull)
+        before = rt._push_mgr.pushes_initiated
+
+        @ray_tpu.remote
+        def consume(arr):
+            return float(arr[0, 0]), os.getpid()
+
+        v, pid = ray_tpu.get(consume.remote(big), timeout=60)
+        assert v == 1.5
+        # the task-push trigger must have initiated a NEW push (beyond the
+        # direct one above) toward the executing daemon
+        assert rt._push_mgr.pushes_initiated > before
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+        os.environ.pop("RAY_TPU_ARENA_ENABLED", None)
+        from ray_tpu._private.config import _config
+        _config.set("arena_enabled", True)
+        _config.set("object_push_threshold_bytes", 256 * 1024)

@@ -6,6 +6,9 @@ lifecycle, eviction ordering, and the integration with the Python
 ObjectStore's spill path.
 """
 
+import os
+import time
+
 import numpy as np
 import pytest
 
@@ -108,3 +111,63 @@ def test_python_store_uses_arena_and_spills():
             assert store.get(oid_) == value
     finally:
         _config.set("object_spilling_threshold", old_threshold)
+
+
+def _pin_and_die(path, q):
+    from ray_tpu._native import NativeStoreClient
+    c = NativeStoreClient(path)
+    view = c.get(b"pinned-obj")  # pins server-side
+    q.put(bytes(view[:4]))
+    q.close()
+    q.join_thread()  # flush the feeder before the hard exit
+    os._exit(0)  # die without unpinning — server must roll back
+
+
+def test_served_arena_rollback_on_client_death(tmp_path):
+    """A client that dies holding pins must not pin objects forever: the
+    server rolls its pins back on disconnect (plasma disconnect path)."""
+    import multiprocessing as mp
+    from ray_tpu._native import NativeObjectStore
+    s = NativeObjectStore(1 << 20)
+    path = str(tmp_path / "arena.sock")
+    assert s.serve(path)
+    assert s.put(b"pinned-obj", b"abcd" * 100)
+    q = mp.Queue()
+    p = mp.Process(target=_pin_and_die, args=(path, q))
+    p.start()
+    assert q.get(timeout=20) == b"abcd"
+    p.join(10)
+    # after disconnect rollback the object is deletable (pin released)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if s.delete(b"pinned-obj"):
+            break
+        time.sleep(0.05)
+    assert not s.contains(b"pinned-obj")
+
+
+def test_served_arena_concurrent_clients(tmp_path):
+    import multiprocessing as mp
+    from ray_tpu._native import NativeObjectStore
+
+    def worker(path, i, q):
+        from ray_tpu._native import NativeStoreClient
+        c = NativeStoreClient(path)
+        key = f"obj-{i}".encode()
+        c.put(key, bytes([i]) * 10000)
+        data = c.get_bytes(key)
+        q.put((i, data == bytes([i]) * 10000))
+        c.close()
+
+    s = NativeObjectStore(1 << 22)
+    path = str(tmp_path / "arena.sock")
+    assert s.serve(path)
+    q = mp.Queue()
+    procs = [mp.Process(target=worker, args=(path, i, q)) for i in range(4)]
+    for p in procs:
+        p.start()
+    results = [q.get(timeout=30) for _ in procs]
+    for p in procs:
+        p.join(10)
+    assert all(ok for _, ok in results)
+    assert s.stats()[2] == 4
