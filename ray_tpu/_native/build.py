@@ -19,6 +19,24 @@ _LOCK = threading.Lock()
 _CACHE = {}
 
 
+def _sanitize_flags() -> list:
+    """RAY_TPU_SANITIZE=address|thread|undefined adds the corresponding
+    -fsanitize instrumentation to every native build (the .bazelrc asan/
+    tsan config role, reference ``.bazelrc:91-107``). Sanitized artifacts
+    get a distinct suffix so they never shadow the production cache."""
+    kind = os.environ.get("RAY_TPU_SANITIZE", "").strip()
+    if not kind:
+        return []
+    if kind not in ("address", "thread", "undefined"):
+        raise NativeBuildError(f"unknown RAY_TPU_SANITIZE={kind!r}")
+    return [f"-fsanitize={kind}", "-g", "-fno-omit-frame-pointer"]
+
+
+def _artifact_suffix() -> str:
+    kind = os.environ.get("RAY_TPU_SANITIZE", "").strip()
+    return f".{kind[0]}san" if kind else ""
+
+
 class NativeBuildError(RuntimeError):
     pass
 
@@ -30,14 +48,14 @@ def load_native_library(name: str) -> Optional[ctypes.CDLL]:
         if name in _CACHE:
             return _CACHE[name]
         src = os.path.join(_DIR, f"{name}.cc")
-        so = os.path.join(_DIR, f"lib{name}.so")
+        so = os.path.join(_DIR, f"lib{name}{_artifact_suffix()}.so")
         try:
             if (not os.path.exists(so)
                     or os.path.getmtime(so) < os.path.getmtime(src)):
                 tmp = so + ".tmp"
                 subprocess.run(
                     ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                     "-pthread", "-o", tmp, src],
+                     "-pthread", *_sanitize_flags(), "-o", tmp, src],
                     check=True, capture_output=True, text=True)
                 os.replace(tmp, so)
             lib = ctypes.CDLL(so)
@@ -61,7 +79,7 @@ def build_state_service() -> str:
     src = os.path.join(_DIR, "state_service.cc")
     gen_dir = os.path.join(_DIR, "gen")
     pb_cc = os.path.join(gen_dir, "raytpu.pb.cc")
-    exe = os.path.join(_DIR, "raytpu_state_service")
+    exe = os.path.join(_DIR, f"raytpu_state_service{_artifact_suffix()}")
     with _LOCK:
         try:
             src_mtime = max(os.path.getmtime(src), os.path.getmtime(proto))
@@ -81,7 +99,8 @@ def build_state_service() -> str:
                                        dir=_DIR)
             os.close(fd)
             subprocess.run(
-                ["g++", "-O2", "-std=c++17", "-o", tmp, src, pb_cc,
+                ["g++", "-O2", "-std=c++17", *_sanitize_flags(),
+                 "-o", tmp, src, pb_cc,
                  f"-I{_DIR}", "-lprotobuf", "-lpthread"],
                 check=True, capture_output=True, text=True)
             os.chmod(tmp, 0o755)

@@ -385,13 +385,15 @@ class StoreServer {
     }
     if (accept_thread_.joinable()) accept_thread_.join();
     // Kick every parked connection thread out of its blocking read, then
-    // wait for the (detached) threads to drain — without this the dtor
-    // hangs as long as any idle client stays connected.
+    // wait for the (detached) threads to drain. The wait is UNBOUNDED on
+    // purpose: returning early would free this server (and soon the
+    // Store) under a thread that still dereferences both — the fds are
+    // shut down, so every blocking read/write fails immediately and the
+    // only remaining work is mutex-bounded Store cleanup.
     {
       std::unique_lock<std::mutex> g(conns_mu_);
       for (int fd : conn_fds_) shutdown(fd, SHUT_RDWR);
-      conns_cv_.wait_for(g, std::chrono::seconds(5),
-                         [this] { return conn_fds_.empty(); });
+      conns_cv_.wait(g, [this] { return conn_fds_.empty(); });
     }
   }
 

@@ -233,6 +233,11 @@ class DistributedRuntime(Runtime):
         self._borrow_q_lock = threading.Lock()
         self._borrow_registered: set = set()
 
+        # Placement retry loops park here instead of fixed-interval
+        # sleeping; _kick (task completion, resource release, view change)
+        # wakes them immediately.
+        self._placement_cv = threading.Condition()
+
         # Host-shared object plane: the first daemon on a host owns one shm
         # arena (memfd) and serves it over a UDS; same-host peers map the
         # SAME pages via fd-passing, so a local "transfer" is a shared-
@@ -265,6 +270,20 @@ class DistributedRuntime(Runtime):
                                              daemon=True, name="dist-view")
         self._view_thread.start()
 
+    def _kick(self):
+        super()._kick()
+        cv = getattr(self, "_placement_cv", None)  # base init kicks early
+        if cv is not None:
+            with cv:
+                cv.notify_all()
+
+    def _placement_wait(self, timeout: float = 0.05):
+        """Event-driven pause for placement retry loops: wakes on the next
+        _kick (completion/release/view change), with ``timeout`` as the
+        fallback so no wakeup is ever lost."""
+        with self._placement_cv:
+            self._placement_cv.wait(timeout=timeout)
+
     # ----------------------------------------------------- host arena plane
 
     def _setup_host_arena(self, is_driver: bool, _retry: bool = True):
@@ -282,21 +301,28 @@ class DistributedRuntime(Runtime):
         if not is_driver:
             path = (f"/tmp/ray_tpu_arena_{os.getpid()}_"
                     f"{abs(hash(self.address)) % 100000}.sock")
-            if self.state.kv_put(host_key, path.encode(), overwrite=False,
-                                 namespace=ns):
-                cap = _config.get("arena_capacity_mb") * (1 << 20)
-                store = NativeObjectStore(cap)
-                if store.serve(path):
-                    self.host_arena = store
-                    self.host_arena_key = path
-                    self._arena_is_owner = True
-                    self._arena_host_key = host_key
-                    logger.debug("serving host arena at %s (%d MB)", path,
-                                 cap >> 20)
-                else:
-                    # don't squat on the hostname with a dead entry
-                    self.state.kv_del(host_key, namespace=ns)
+            # Bind the socket BEFORE claiming the hostname: the KV entry
+            # must never point at a not-yet-listening socket, or a racing
+            # joiner would mistake the healthy owner-to-be for a dead one,
+            # delete the claim, and usurp it (two arenas on one host).
+            cap = _config.get("arena_capacity_mb") * (1 << 20)
+            store = NativeObjectStore(cap)
+            if store.serve(path) and self.state.kv_put(
+                    host_key, path.encode(), overwrite=False, namespace=ns):
+                self.host_arena = store
+                self.host_arena_key = path
+                self._arena_is_owner = True
+                self._arena_host_key = host_key
+                logger.debug("serving host arena at %s (%d MB)", path,
+                             cap >> 20)
                 return
+            # lost the race (or no shared backing): release our arena and
+            # fall through to join the winner's
+            del store
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
         existing = self.state.kv_get(host_key, namespace=ns)
         if existing:
             try:
@@ -493,10 +519,14 @@ class DistributedRuntime(Runtime):
         if self.host_arena is not None:
             if self._arena_is_owner:
                 # release the hostname claim so a future daemon can own a
-                # fresh arena, and remove the socket file
+                # fresh arena, and remove the socket file — but only if
+                # the claim is still OURS (a repair may have replaced it)
                 try:
-                    self.state.kv_del(self._arena_host_key,
-                                      namespace=b"arena")
+                    cur = self.state.kv_get(self._arena_host_key,
+                                            namespace=b"arena")
+                    if cur == self.host_arena_key.encode():
+                        self.state.kv_del(self._arena_host_key,
+                                          namespace=b"arena")
                 except Exception:
                     pass
                 try:
@@ -1399,7 +1429,7 @@ class DistributedRuntime(Runtime):
                     f"(resources {request})"))
                 self._sync_actor_info(state)
                 return
-            time.sleep(0.05)
+            self._placement_wait(0.05)
 
     def _create_actor_remote(self, state: ActorState, nid: bytes) -> bool:
         with self._view_lock:
@@ -1527,7 +1557,7 @@ class DistributedRuntime(Runtime):
                 self._mark_actor_dead(state, exc.ActorDiedError(
                     f"could not re-place actor {state.cls.__name__} locally"))
                 return
-            time.sleep(0.02)
+            self._placement_wait(0.02)
         state.node_id = node.node_id
         state.devices = self._assign_devices(request, node)
         self._start_actor_on_node(state, node, request)
@@ -1640,7 +1670,7 @@ class DistributedRuntime(Runtime):
                 self._register_pg_info(pg)
                 self._kick()
                 return
-            time.sleep(0.05)
+            self._placement_wait(0.05)
         pg.state = "INFEASIBLE"
         pg.ready.set()
 
@@ -2106,7 +2136,7 @@ class DistributedRuntime(Runtime):
                     rep.available.amounts[k] = v
                 ctx.reply(rep.SerializeToString())
                 return
-            time.sleep(0.02)
+            self._placement_wait(0.02)
         state.node_id = node.node_id
         state.devices = self._assign_devices(request, node)
         self._start_actor_on_node(state, node, request)

@@ -92,10 +92,22 @@ def main(argv=None) -> int:
                  runtime.local_node.node_id.hex()[:8], runtime.address,
                  amounts)
 
+    # Per-node reporter agent (dashboard/agent.py role): publishes proc +
+    # store stats into the state-service KV for the dashboard head.
+    reporter = None
+    try:
+        from ray_tpu.dashboard.agent import NodeReporterAgent
+        reporter = NodeReporterAgent(runtime)
+        reporter.start()
+    except Exception:
+        logging.warning("node reporter unavailable", exc_info=True)
+
     try:
         while not stop["flag"] and not runtime._hb_stop.is_set():
             time.sleep(0.2)
     finally:
+        if reporter is not None:
+            reporter.stop()
         try:
             runtime.shutdown()
         except Exception:

@@ -8,10 +8,14 @@ materialized by the per-node runtime-env agent
 Host-granular redesign: workers are threads of the device-owner process,
 so "materialize" means (a) stage working_dir/py_modules into a
 content-hashed cache directory and put them on ``sys.path``, and (b)
-apply ``env_vars`` around execution under a global env lock (os.environ
-is process-wide — concurrent tasks with conflicting env_vars serialize
-on this lock rather than racing). ``pip``/``conda`` fields are rejected:
-the runtime has no network egress and one shared interpreter.
+apply ``env_vars`` around execution under the environment GATE:
+``os.environ``/``sys.path`` are process-wide, so only one *distinct*
+environment can be active at a time — but any number of tasks sharing
+that same environment run concurrently (refcounted entry/exit; the first
+applier mutates, the last restorer undoes). This replaces the earlier
+whole-body global lock, which serialized even identical-env tasks.
+``pip``/``conda`` fields are rejected: the runtime has no network egress
+and one shared interpreter.
 """
 
 from __future__ import annotations
@@ -26,8 +30,95 @@ import zipfile
 from typing import Any, Dict, List, Optional
 
 _CACHE_DIR = "/tmp/ray_tpu/runtime_envs"
-_ENV_LOCK = threading.RLock()
 _SUPPORTED = {"env_vars", "working_dir", "py_modules"}
+
+
+class _EnvGate:
+    """Admission gate over the process-wide environment: tasks with the
+    SAME env run concurrently (refcount); a different env waits until the
+    count drains, then swaps. The first entrant applies the mutations and
+    snapshots what it displaced; the last leaver restores."""
+
+    def __init__(self):
+        self.cv = threading.Condition()
+        self.active_key: Optional[str] = None
+        self.count = 0
+        self._saved: Dict[str, Optional[str]] = {}
+        self._inserted: List[str] = []
+        self._depth = threading.local()  # nested applied() on one thread
+
+    def enter(self, env: "MaterializedEnv"):
+        depth = getattr(self._depth, "n", 0)
+        self._depth.n = depth + 1
+        if depth > 0:
+            # nested applied() on one thread: the outer call holds the
+            # gate; apply inline with a per-level snapshot so the nested
+            # env's mutations are fully undone at its own exit (a nested
+            # DIFFERENT env must not bleed past its scope)
+            saved = {k: os.environ.get(k) for k in env.env_vars}
+            inserted = []
+            os.environ.update(env.env_vars)
+            for p in env.sys_paths:
+                if p not in sys.path:
+                    sys.path.insert(0, p)
+                    inserted.append(p)
+            stack = getattr(self._depth, "stack", None)
+            if stack is None:
+                stack = self._depth.stack = []
+            stack.append((saved, inserted))
+            return
+        with self.cv:
+            while self.active_key not in (None, env.key):
+                self.cv.wait(timeout=1.0)
+            if self.active_key is None:
+                self.active_key = env.key
+                self._apply(env, save=True)
+            self.count += 1
+
+    def exit(self, env: "MaterializedEnv"):
+        self._depth.n = getattr(self._depth, "n", 1) - 1
+        if self._depth.n > 0:
+            saved, inserted = self._depth.stack.pop()
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            for p in inserted:
+                with contextlib.suppress(ValueError):
+                    sys.path.remove(p)
+            return
+        with self.cv:
+            self.count -= 1
+            if self.count == 0:
+                self._restore()
+                self.active_key = None
+                self.cv.notify_all()
+
+    def _apply(self, env: "MaterializedEnv", save: bool):
+        if save:
+            self._saved = {k: os.environ.get(k) for k in env.env_vars}
+            self._inserted = []
+        os.environ.update(env.env_vars)
+        for p in env.sys_paths:
+            if p not in sys.path:
+                sys.path.insert(0, p)
+                if save:
+                    self._inserted.append(p)
+
+    def _restore(self):
+        for k, v in self._saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        for p in self._inserted:
+            with contextlib.suppress(ValueError):
+                sys.path.remove(p)
+        self._saved, self._inserted = {}, []
+
+
+_ENV_GATE = _EnvGate()
 
 
 class RuntimeEnvError(ValueError):
@@ -101,28 +192,17 @@ class MaterializedEnv:
                  sys_paths: List[str]):
         self.env_vars = env_vars
         self.sys_paths = sys_paths
+        self.key = hashlib.blake2b(
+            repr((sorted(env_vars.items()), sorted(sys_paths))).encode(),
+            digest_size=12).hexdigest()
 
     @contextlib.contextmanager
     def applied(self):
-        with _ENV_LOCK:
-            saved = {k: os.environ.get(k) for k in self.env_vars}
-            inserted = []
-            try:
-                os.environ.update(self.env_vars)
-                for p in self.sys_paths:
-                    if p not in sys.path:
-                        sys.path.insert(0, p)
-                        inserted.append(p)
-                yield
-            finally:
-                for k, v in saved.items():
-                    if v is None:
-                        os.environ.pop(k, None)
-                    else:
-                        os.environ[k] = v
-                for p in inserted:
-                    with contextlib.suppress(ValueError):
-                        sys.path.remove(p)
+        _ENV_GATE.enter(self)
+        try:
+            yield
+        finally:
+            _ENV_GATE.exit(self)
 
 
 class RuntimeEnvManager:

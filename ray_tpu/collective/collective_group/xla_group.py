@@ -172,16 +172,15 @@ class XLAGroupShared:
         xs = [slots[r] for r in range(self.world_size)]
         if kind == "barrier":
             return {r: None for r in range(self.world_size)}
-        if kind == "broadcast":
-            root = op_desc[1]
-            src = xs[root]
-            if self.distinct:
-                return {r: jax.device_put(src, self.rank_devices[r])
-                        for r in range(self.world_size)}
-            return {r: src for r in range(self.world_size)}
         if self.distinct and self.mesh is not None and kind in (
-                "allreduce", "reducescatter", "allgather", "reduce"):
+                "allreduce", "reducescatter", "allgather", "reduce",
+                "broadcast"):
             return self._run_mesh_op(xs, op_desc)
+        if kind == "broadcast":
+            # folded ranks share devices: every rank reads the same buffer
+            # (the distinct-devices case routed into _run_mesh_op above)
+            src = xs[op_desc[1]]
+            return {r: src for r in range(self.world_size)}
         return self._run_host_op(xs, op_desc)
 
     def _run_mesh_op(self, xs: List[Any], op_desc: tuple) -> Dict[int, Any]:
@@ -217,6 +216,15 @@ class XLAGroupShared:
                 body = lambda x: jax.lax.psum_scatter(  # noqa: E731
                     x[0], axis, scatter_dimension=0, tiled=True)
                 out_spec = P("ranks")
+            elif kind == "broadcast":
+                # one compiled fan-out from root over ICI (ppermute cannot
+                # express one-to-many; all_gather + root index lowers to a
+                # single ICI all-gather, not a host-mediated device_put
+                # per rank)
+                root = op_desc[1]
+                body = lambda x: jax.lax.all_gather(  # noqa: E731
+                    x[0], axis)[root][None]
+                out_spec = P("ranks")
             else:
                 raise ValueError(kind)
             fn = shard_map(body, mesh=self.mesh, in_specs=P("ranks"),
@@ -231,10 +239,10 @@ class XLAGroupShared:
             [jax.device_put(x[None], d) for x, d in zip(xs, self.rank_devices)])
         out = fn(global_arr)
         shards = {s.device.id: s.data for s in out.addressable_shards}
-        # allreduce/reduce blocks carry a leading rank dim of 1 to squeeze;
-        # allgather blocks are the full stack and reducescatter blocks are
-        # the rank's chunk — returned as-is.
-        squeeze = kind in ("allreduce", "reduce")
+        # allreduce/reduce/broadcast blocks carry a leading rank dim of 1 to
+        # squeeze; allgather blocks are the full stack and reducescatter
+        # blocks are the rank's chunk — returned as-is.
+        squeeze = kind in ("allreduce", "reduce", "broadcast")
         results = {}
         for r, d in enumerate(self.rank_devices):
             local = shards[d.id]
@@ -278,19 +286,50 @@ class XLAGroupShared:
                 self._p2p[key] = rdv
             return rdv
 
+    def _p2p_transfer(self, src: int, dst: int, tensor):
+        """Move ``tensor`` from src's device to dst's device.
+
+        Distinct devices: ONE compiled ``ppermute`` over the (src, dst)
+        pair mesh — the transfer rides ICI like any other collective, not
+        a host-mediated ``device_put`` copy. Folded ranks: same buffer."""
+        src_dev = self.rank_devices[src]
+        dst_dev = self.rank_devices[dst]
+        if not self.distinct or src_dev.id == dst_dev.id:
+            return tensor
+        shape, dtype = tensor.shape, tensor.dtype
+        key = ("p2p", src_dev.id, dst_dev.id, tuple(shape), str(dtype))
+
+        def builder():
+            mesh = Mesh(np.array([src_dev, dst_dev]), ("pair",))
+            fn = jax.jit(shard_map(
+                lambda x: jax.lax.ppermute(x, "pair", [(0, 1)]),
+                mesh=mesh, in_specs=P("pair"), out_specs=P("pair"),
+                check_vma=False))
+            return fn, mesh
+
+        fn, mesh = self._program(key, builder)
+        stacked = jax.make_array_from_single_device_arrays(
+            (2,) + tuple(shape), NamedSharding(mesh, P("pair")),
+            [jax.device_put(tensor[None], src_dev),
+             jax.device_put(jnp.zeros((1,) + tuple(shape), dtype),
+                            dst_dev)])
+        out = fn(stacked)
+        for s in out.addressable_shards:
+            if s.device.id == dst_dev.id:
+                return s.data[0]
+        return jax.device_put(tensor, dst_dev)  # unreachable fallback
+
     def p2p_send(self, rank: int, dst_rank: int, tensor):
         rdv = self._pair_rdv(rank, dst_rank)
 
         def compute(slots):
-            value = slots[rank]
-            if self.distinct:
-                value = jax.device_put(value, self.rank_devices[dst_rank])
-            return value
+            return self._p2p_transfer(rank, dst_rank, slots[rank])
 
         rdv.run(rank, jnp.asarray(tensor), compute)
 
     def p2p_recv(self, rank: int, src_rank: int):
         rdv = self._pair_rdv(src_rank, rank)
-        return rdv.run(rank, None, lambda slots: slots[src_rank]
-                       if not self.distinct else jax.device_put(
-                           slots[src_rank], self.rank_devices[rank]))
+        return rdv.run(
+            rank, None,
+            lambda slots: self._p2p_transfer(src_rank, rank,
+                                             slots[src_rank]))

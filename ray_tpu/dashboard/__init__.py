@@ -1,0 +1,15 @@
+"""ray_tpu.dashboard — cluster dashboard head + per-node reporter agent.
+
+The TPU-native re-design of the reference's dashboard
+(``dashboard/head.py:63``, ``dashboard/agent.py:51``): instead of an
+aiohttp head process aggregating gRPC streams from per-node agents, the
+head here is one stdlib HTTP server that reads everything from the C++
+state service (node/actor/PG/job tables plus the ``node_stats`` KV
+namespace), and the agent is a daemon thread inside each host daemon
+sampling /proc and publishing one JSON blob per heartbeat-ish interval.
+No external UI build: ``/`` serves a self-contained HTML page that polls
+the JSON API.
+"""
+
+from ray_tpu.dashboard.agent import NodeReporterAgent  # noqa: F401
+from ray_tpu.dashboard.head import DashboardHead, start_dashboard  # noqa: F401

@@ -1,0 +1,195 @@
+"""Dashboard head: HTTP UI + JSON API over the state service.
+
+Parity with ``dashboard/head.py:63`` / ``state_aggregator.py``: a single
+HTTP server that renders cluster state. Everything is read live from the
+C++ state service (tables + the ``node_stats`` reporter KV), so the head
+can run in the driver, on the head node, or standalone against any
+cluster address — it holds no state of its own.
+
+Endpoints:
+  /                 — self-contained HTML UI (polls the JSON API)
+  /api/cluster      — nodes + reporter stats + resource totals
+  /api/actors       — actor table
+  /api/pgs          — placement groups
+  /api/jobs         — job table
+  /api/stats        — state-service counters
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional
+
+from ray_tpu.dashboard.agent import collect_node_stats
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>ray_tpu dashboard</title><style>
+body{font-family:system-ui,sans-serif;margin:24px;background:#fafafa;color:#222}
+h1{font-size:20px} h2{font-size:15px;margin-top:28px;color:#444}
+table{border-collapse:collapse;width:100%;background:#fff;box-shadow:0 1px 2px #0002}
+th,td{padding:6px 10px;border-bottom:1px solid #eee;text-align:left;font-size:13px}
+th{background:#f0f0f3;font-weight:600}
+.dead{color:#b00} .alive{color:#080}
+#updated{color:#888;font-size:12px}
+</style></head><body>
+<h1>ray_tpu cluster <span id=updated></span></h1>
+<h2>Nodes</h2><table id=nodes></table>
+<h2>Actors</h2><table id=actors></table>
+<h2>Placement groups</h2><table id=pgs></table>
+<h2>Jobs</h2><table id=jobs></table>
+<script>
+function row(cells, tag){tag=tag||'td';return '<tr>'+cells.map(c=>'<'+tag+'>'+c+'</'+tag+'>').join('')+'</tr>'}
+async function refresh(){
+  const c = await (await fetch('/api/cluster')).json();
+  let h = row(['node','address','state','CPU','TPU','cpu%','rss MB','arena','objects'],'th');
+  for (const n of c.nodes){
+    const s = n.stats||{}; const a = s.arena||{};
+    h += row([n.node_id.slice(0,8), n.address,
+      '<span class="'+(n.alive?'alive':'dead')+'">'+(n.alive?'ALIVE':'DEAD')+'</span>',
+      (n.available.CPU??0)+'/'+(n.total.CPU??0),
+      (n.available.TPU??'-')+'/'+(n.total.TPU??'-'),
+      s.cpu_percent??'-', s.rss_mb??'-',
+      a.capacity_mb? a.used_mb+'/'+a.capacity_mb+' MB'+(a.owner?' (owner)':'') : '-',
+      (s.object_store||{}).num_objects??'-']);
+  }
+  document.getElementById('nodes').innerHTML = h;
+  const actors = await (await fetch('/api/actors')).json();
+  let ah = row(['actor','class','state','node','restarts'],'th');
+  for (const x of actors) ah += row([x.actor_id.slice(0,8), x.class_name, x.state, (x.node_id||'').slice(0,8), x.num_restarts??0]);
+  document.getElementById('actors').innerHTML = ah;
+  const pgs = await (await fetch('/api/pgs')).json();
+  let ph = row(['pg','strategy','state','bundles'],'th');
+  for (const p of pgs) ph += row([p.pg_id.slice(0,8), p.strategy, p.state, p.num_bundles]);
+  document.getElementById('pgs').innerHTML = ph;
+  const jobs = await (await fetch('/api/jobs')).json();
+  let jh = row(['job','driver','state'],'th');
+  for (const j of jobs) jh += row([j.job_id, j.driver_address, j.state]);
+  document.getElementById('jobs').innerHTML = jh;
+  document.getElementById('updated').textContent = 'updated '+new Date().toLocaleTimeString();
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+class DashboardHead:
+    """Serves the UI + API against one cluster's state service."""
+
+    def __init__(self, state_addr: str, port: int = 0,
+                 host: str = "127.0.0.1"):
+        from ray_tpu._private.state_client import StateClient
+        self.state = StateClient(state_addr)
+        self._httpd = None
+        self._thread: Optional[threading.Thread] = None
+        self._host, self._want_port = host, port
+        self.port: Optional[int] = None
+
+    # -- API payloads ----------------------------------------------------
+    def _cluster(self) -> dict:
+        stats = collect_node_stats(self.state)
+        nodes = []
+        for n in self.state.list_nodes():
+            nid = n.node_id.hex()
+            nodes.append({
+                "node_id": nid,
+                "address": n.address,
+                "alive": n.alive,
+                "is_head": n.is_head,
+                "total": dict(n.total.amounts),
+                "available": dict(n.available.amounts),
+                "labels": dict(n.labels),
+                "death_reason": n.death_reason,
+                "stats": stats.get(nid),
+            })
+        return {"ts": time.time(), "nodes": nodes}
+
+    def _actors(self) -> list:
+        return [{
+            "actor_id": a.actor_id.hex(),
+            "class_name": a.class_name,
+            "state": a.state,
+            "node_id": a.node_id.hex() if a.node_id else "",
+            "name": a.name,
+            "num_restarts": a.restart_count,
+        } for a in self.state.list_actors()]
+
+    def _pgs(self) -> list:
+        return [{
+            "pg_id": p.pg_id.hex(),
+            "strategy": p.strategy,
+            "state": p.state,
+            "num_bundles": len(p.bundles),
+        } for p in self.state.list_pgs()]
+
+    def _jobs(self) -> list:
+        return [{
+            "job_id": j.job_id.hex(),
+            "driver_address": j.driver_address,
+            "state": j.state,
+        } for j in self.state.list_jobs()]
+
+    # -- server ----------------------------------------------------------
+    def start(self) -> int:
+        import http.server
+        head = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _send(self, body: bytes, ctype: str, code: int = 200):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _json(self, payload, code: int = 200):
+                self._send(json.dumps(payload, default=str).encode(),
+                           "application/json", code)
+
+            def do_GET(self):
+                try:
+                    if self.path in ("/", "/index.html"):
+                        self._send(_PAGE.encode(), "text/html")
+                    elif self.path == "/api/cluster":
+                        self._json(head._cluster())
+                    elif self.path == "/api/actors":
+                        self._json(head._actors())
+                    elif self.path == "/api/pgs":
+                        self._json(head._pgs())
+                    elif self.path == "/api/jobs":
+                        self._json(head._jobs())
+                    elif self.path == "/api/stats":
+                        self._json(head.state.stats())
+                    else:
+                        self._json({"error": "not found"}, 404)
+                except Exception as e:  # noqa: BLE001
+                    self._json({"error": repr(e)}, 500)
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            (self._host, self._want_port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="dashboard-head")
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        try:
+            self.state.close()
+        except Exception:
+            pass
+
+
+def start_dashboard(state_addr: str, port: int = 0,
+                    host: str = "127.0.0.1") -> DashboardHead:
+    head = DashboardHead(state_addr, port=port, host=host)
+    head.start()
+    return head

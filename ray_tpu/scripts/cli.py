@@ -93,6 +93,20 @@ def cmd_events(args):
         print(json.dumps(ev, default=str))
 
 
+def cmd_dashboard(args):
+    import time
+    from ray_tpu.dashboard import start_dashboard
+    head = start_dashboard(args.address, port=args.dashboard_port,
+                           host=args.host)
+    print(f"dashboard at http://{args.host}:{head.port}/ "
+          f"(cluster {args.address}); Ctrl-C to stop")
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        head.stop()
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="ray-tpu")
     p.add_argument("--port", type=int, default=None,
@@ -113,6 +127,13 @@ def main(argv=None):
     ep = sub.add_parser("events")
     ep.add_argument("--limit", type=int, default=100)
     ep.set_defaults(fn=cmd_events)
+    dp = sub.add_parser("dashboard",
+                        help="serve the cluster dashboard UI")
+    dp.add_argument("--address", required=True,
+                    help="host:port of the cluster state service")
+    dp.add_argument("--dashboard-port", type=int, default=8265)
+    dp.add_argument("--host", default="127.0.0.1")
+    dp.set_defaults(fn=cmd_dashboard)
     args = p.parse_args(argv)
     args.fn(args)
 

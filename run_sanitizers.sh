@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Sanitizer CI for the native (C++) components — the role of the
+# reference's .bazelrc asan/tsan configs (.bazelrc:91-107).
+#
+#   ./run_sanitizers.sh            # ASAN over state service + object store
+#   ./run_sanitizers.sh thread     # TSAN instead
+#
+# The state-service binary is a standalone process, so sanitizing it is
+# transparent. The object-store .so loads into the Python interpreter, so
+# its sanitizer runtime must be LD_PRELOADed; leak checking is disabled
+# there (CPython itself "leaks" by ASAN's definition).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+KIND="${1:-address}"
+export RAY_TPU_SANITIZE="$KIND"
+
+case "$KIND" in
+  address) RT_LIB="$(g++ -print-file-name=libasan.so)" ;;
+  thread)  RT_LIB="$(g++ -print-file-name=libtsan.so)" ;;
+  *) echo "usage: $0 [address|thread]" >&2; exit 2 ;;
+esac
+
+echo "== [$KIND] state service (sanitized standalone binary) =="
+python -m pytest tests/test_state_service.py -q
+
+echo "== [$KIND] object store (sanitized .so under LD_PRELOAD) =="
+ASAN_OPTIONS="detect_leaks=0:abort_on_error=1" \
+TSAN_OPTIONS="report_bugs=1" \
+LD_PRELOAD="$RT_LIB" \
+python -m pytest tests/test_native_store.py -q
+
+echo "== [$KIND] scheduling lib (sanitized .so under LD_PRELOAD) =="
+ASAN_OPTIONS="detect_leaks=0:abort_on_error=1" \
+LD_PRELOAD="$RT_LIB" \
+python -m pytest tests/test_scheduling.py -q
+
+echo "sanitizer pass ($KIND) complete"

@@ -1,0 +1,83 @@
+"""Dashboard head + node reporter agent tests (reference:
+dashboard/head.py, dashboard/agent.py — here one HTTP head over the
+state service plus a /proc sampler thread per daemon)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import ProcessCluster
+from ray_tpu.dashboard import start_dashboard
+
+
+@pytest.fixture()
+def cluster():
+    ray_tpu.shutdown()
+    c = ProcessCluster(num_daemons=2, num_cpus=2)
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_dashboard_cluster_and_reporter_stats(cluster):
+    head = start_dashboard(cluster.address)
+    try:
+        # daemons publish reporter blobs every ~2s; wait for both
+        deadline = time.monotonic() + 20
+        nodes = []
+        while time.monotonic() < deadline:
+            nodes = _get(head.port, "/api/cluster")["nodes"]
+            daemon_nodes = [n for n in nodes
+                            if n["alive"] and n["address"]
+                            and n["stats"] is not None]
+            if len(daemon_nodes) >= 2:
+                break
+            time.sleep(0.3)
+        assert len(daemon_nodes) >= 2, nodes
+        s = daemon_nodes[0]["stats"]
+        assert s["rss_mb"] > 10           # a real process
+        assert "cpu_percent" in s and "resources" in s
+        assert any(n.get("stats", {}) and "arena" in (n["stats"] or {})
+                   for n in daemon_nodes), "arena stats missing"
+    finally:
+        head.stop()
+
+
+def test_dashboard_actor_and_job_tables(cluster):
+    @ray_tpu.remote
+    class Counter:
+        def ping(self):
+            return 1
+
+    a = Counter.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == 1
+    head = start_dashboard(cluster.address)
+    try:
+        deadline = time.monotonic() + 15
+        actors = []
+        while time.monotonic() < deadline:
+            actors = _get(head.port, "/api/actors")
+            if any(x["class_name"] == "Counter" and x["state"] == "ALIVE"
+                   for x in actors):
+                break
+            time.sleep(0.3)
+        assert any(x["class_name"] == "Counter" for x in actors), actors
+        jobs = _get(head.port, "/api/jobs")
+        assert any(j["state"] == "RUNNING" for j in jobs)
+        # UI page is served
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{head.port}/", timeout=10) as r:
+            page = r.read().decode()
+        assert "ray_tpu cluster" in page and "/api/cluster" in page
+    finally:
+        head.stop()
