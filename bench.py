@@ -181,7 +181,21 @@ def bench_ppo():
         algo.stop()
 
 
+def _wait_for_backend(retries: int = 6, delay_s: float = 30.0):
+    """The axon TPU tunnel is transiently unavailable at times; retry
+    backend init rather than failing the whole bench run."""
+    for attempt in range(retries):
+        try:
+            jax.devices()
+            return
+        except RuntimeError:
+            if attempt == retries - 1:
+                raise
+            time.sleep(delay_s)
+
+
 def main():
+    _wait_for_backend()
     kind, peak = _chip_peak_flops()
 
     r50_ips, r50_flops = bench_resnet("resnet50", batch=128)

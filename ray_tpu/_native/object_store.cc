@@ -637,6 +637,18 @@ void npc_close(void* h) {
   delete c;
 }
 
+// Close the connection + fd but KEEP the mapping: zero-copy values handed
+// out earlier reference these pages; unmapping under them would turn a
+// post-shutdown read into a SIGSEGV. The pages are reclaimed at process
+// exit (or when the last memfd reference drops).
+void npc_detach(void* h) {
+  StoreClient* c = static_cast<StoreClient*>(h);
+  if (c == nullptr) return;
+  if (c->arena_fd >= 0) close(c->arena_fd);
+  if (c->sock >= 0) close(c->sock);
+  delete c;
+}
+
 uint64_t npc_capacity(void* h) {
   return static_cast<StoreClient*>(h)->capacity;
 }

@@ -47,12 +47,22 @@ def _bind_ops(lib: ctypes.CDLL, prefix: str) -> dict:
 
 
 class _ArenaOps:
-    """Shared op surface over a store handle (owner or client)."""
+    """Shared op surface over a store handle (owner or client).
+
+    Every op checks the handle first: pin-release finalizers (zero-copy
+    reads) can fire at interpreter teardown AFTER the client detached —
+    calling into C with a dead handle would segfault."""
 
     _lib: ctypes.CDLL
     _handle: int
     _ops: dict
     capacity: int
+
+    def _h(self):
+        h = getattr(self, "_handle", None)
+        if not h:
+            raise RuntimeError("arena handle closed")
+        return h
 
     @staticmethod
     def _key(object_id: bytes) -> bytes:
@@ -60,23 +70,40 @@ class _ArenaOps:
             raise ValueError("object id must be <= 16 bytes")
         return object_id.ljust(16, b"\0")
 
-    def put(self, object_id: bytes, data: bytes) -> bool:
-        """Create+write+seal. False if the id exists; raises MemoryError
-        when the arena is full (caller evicts/spills then retries)."""
+    def create(self, object_id: bytes, size: int) -> Optional[memoryview]:
+        """Reserve ``size`` bytes; returns a writable view into the arena
+        (write payload parts directly — zero intermediate copy), or None
+        if the id already exists. ``seal`` when done. Raises MemoryError
+        when the arena is full."""
         key = self._key(object_id)
         out = _PTR()
-        rc = self._ops["create"](self._handle, key, len(data),
+        rc = self._ops["create"](self._h(), key, size,
                                  ctypes.byref(out))
         if rc == -1:
-            return False
+            return None
         if rc == -2:
             raise MemoryError(
                 f"arena full ({self.capacity} bytes); evict first")
         if rc != 0:
             raise RuntimeError(f"arena create failed rc={rc}")
+        if size == 0:
+            return memoryview(b"")
+        array = (ctypes.c_uint8 * size).from_address(
+            ctypes.addressof(out.contents))
+        return memoryview(array).cast("B")
+
+    def seal(self, object_id: bytes) -> None:
+        self._ops["seal"](self._h(), self._key(object_id))
+
+    def put(self, object_id: bytes, data: bytes) -> bool:
+        """Create+write+seal. False if the id exists; raises MemoryError
+        when the arena is full (caller evicts/spills then retries)."""
+        view = self.create(object_id, len(data))
+        if view is None:
+            return False
         if data:
-            ctypes.memmove(out, data, len(data))
-        self._ops["seal"](self._handle, key)
+            view[:] = data
+        self.seal(object_id)
         return True
 
     def get(self, object_id: bytes) -> Optional[memoryview]:
@@ -84,12 +111,12 @@ class _ArenaOps:
         key = self._key(object_id)
         ptr = _PTR()
         size = ctypes.c_uint64()
-        rc = self._ops["get"](self._handle, key, ctypes.byref(ptr),
+        rc = self._ops["get"](self._h(), key, ctypes.byref(ptr),
                               ctypes.byref(size), 1)
         if rc != 0:
             return None
         if size.value == 0:
-            self._ops["unpin"](self._handle, key)
+            self._ops["unpin"](self._h(), key)
             return memoryview(b"")
         array = (ctypes.c_uint8 * size.value).from_address(
             ctypes.addressof(ptr.contents))
@@ -106,14 +133,17 @@ class _ArenaOps:
             self.release(object_id)
 
     def release(self, object_id: bytes) -> None:
-        self._ops["unpin"](self._handle, self._key(object_id))
+        try:
+            self._ops["unpin"](self._h(), self._key(object_id))
+        except RuntimeError:
+            pass  # closed/detached: the pin died with the connection
 
     def delete(self, object_id: bytes) -> bool:
-        return self._ops["delete"](self._handle,
+        return self._ops["delete"](self._h(),
                                    self._key(object_id)) == 0
 
     def contains(self, object_id: bytes) -> bool:
-        return self._ops["contains"](self._handle,
+        return self._ops["contains"](self._h(),
                                      self._key(object_id)) == 1
 
     def stats(self) -> Tuple[int, int, int]:
@@ -121,7 +151,7 @@ class _ArenaOps:
         used = ctypes.c_uint64()
         cap = ctypes.c_uint64()
         count = ctypes.c_uint64()
-        self._ops["stats"](self._handle, ctypes.byref(used),
+        self._ops["stats"](self._h(), ctypes.byref(used),
                            ctypes.byref(cap), ctypes.byref(count))
         return used.value, cap.value or self.capacity, count.value
 
@@ -193,16 +223,26 @@ class NativeStoreClient(_ArenaOps):
         lib.npc_close.argtypes = [ctypes.c_void_p]
         lib.npc_capacity.restype = ctypes.c_uint64
         lib.npc_capacity.argtypes = [ctypes.c_void_p]
+        lib.npc_detach.argtypes = [ctypes.c_void_p]
         self._handle = lib.npc_connect(socket_path.encode())
         if not self._handle:
             raise RuntimeError(f"cannot connect to arena at {socket_path}")
         self.capacity = lib.npc_capacity(self._handle)
 
-    def close(self) -> None:
+    def close(self, unmap: bool = True) -> None:
+        """``unmap=False`` keeps the arena mapping alive: zero-copy values
+        already handed out reference those pages, and unmapping under them
+        would turn a later read into a SIGSEGV. Use it on runtime shutdown;
+        plain close() only when no decoded values can be outstanding."""
         handle = getattr(self, "_handle", None)
         if handle:
-            self._lib.npc_close(handle)
+            if unmap:
+                self._lib.npc_close(handle)
+            else:
+                self._lib.npc_detach(handle)
             self._handle = None
 
     def __del__(self):
-        self.close()
+        # GC cannot know whether decoded views are still alive — never
+        # unmap implicitly
+        self.close(unmap=False)

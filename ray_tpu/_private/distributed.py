@@ -47,8 +47,10 @@ import logging
 import os
 import pickle
 import queue
+import struct as _struct
 import threading
 import time
+import weakref
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import cloudpickle
@@ -371,14 +373,35 @@ class DistributedRuntime(Runtime):
         except Exception:
             return False
 
-    def _arena_get(self, key: bytes) -> Optional[bytes]:
+    def _arena_load(self, key: bytes):
+        """Zero-copy read of a framed payload from the shared arena: the
+        deserialized arrays are backed directly by the pinned arena pages;
+        the pin is released when the last such array is collected (plasma
+        client-pin semantics). Returns ``_FETCH_MISS`` when absent."""
         arena = self.host_arena
         if arena is None:
-            return None
+            return _FETCH_MISS
         try:
-            return arena.get_bytes(key)
+            view = arena.get(key)  # pins server-side
         except Exception:
-            return None
+            return _FETCH_MISS
+        if view is None:
+            return _FETCH_MISS
+        try:
+            value, zero_copy = _loads_framed(view)
+        except Exception:
+            _release_arena_pin(arena, key)
+            return _FETCH_MISS
+        if zero_copy:
+            try:
+                # exporter of the view: collected only once every backed
+                # array is gone — exactly when the pin may drop
+                weakref.finalize(view.obj, _release_arena_pin, arena, key)
+            except TypeError:
+                pass  # not weakrefable: stay pinned (safe, never corrupt)
+        else:
+            _release_arena_pin(arena, key)
+        return value
 
     # ------------------------------------------------------------- lifecycle
 
@@ -535,7 +558,9 @@ class DistributedRuntime(Runtime):
                     pass
             else:
                 try:
-                    self.host_arena.close()
+                    # keep the mapping: zero-copy fetched values may still
+                    # be referenced by the application after shutdown
+                    self.host_arena.close(unmap=False)
                 except Exception:
                     pass
         with self._borrow_q_lock:
@@ -824,9 +849,9 @@ class DistributedRuntime(Runtime):
             if rep.error_pickle:
                 return _FETCH_MISS, pickle.loads(rep.error_pickle)
             if rep.in_arena:
-                payload = self._arena_get(bytes(rep.arena_object_key))
-                if payload is not None:
-                    return pickle.loads(payload), None
+                value = self._arena_load(bytes(rep.arena_object_key))
+                if value is not _FETCH_MISS:
+                    return value, None
                 # raced an eviction: retry over TCP
                 arena_key = ""
                 continue
@@ -834,7 +859,8 @@ class DistributedRuntime(Runtime):
             offset += len(rep.data)
             if rep.eof or not rep.data:
                 break
-        return pickle.loads(buf.getvalue()), None
+        value, _ = _loads_framed(buf.getvalue())
+        return value, None
 
     def object_ready(self, oid: ObjectID) -> bool:
         if self.local_node.store.contains(oid):
@@ -2157,7 +2183,7 @@ class DistributedRuntime(Runtime):
             if hit is not None:
                 return hit
         value = self.local_node.store.get(oid, timeout=0)
-        payload = cloudpickle.dumps(value)
+        payload = _dumps_framed(value)
         with self._fetch_cache_lock:
             self._fetch_cache[oid] = payload
             while len(self._fetch_cache) > 8:
@@ -2210,7 +2236,7 @@ class DistributedRuntime(Runtime):
                 done = True
         if done:
             try:
-                value = pickle.loads(buf.getvalue())
+                value, _ = _loads_framed(buf.getvalue())
             except Exception:
                 ctx.reply(rep.SerializeToString())
                 return
@@ -2266,12 +2292,93 @@ class DistributedRuntime(Runtime):
                 ctx.reply(rep.SerializeToString())
                 return
         end = min(len(payload), req.offset + (req.max_bytes or FETCH_CHUNK))
-        rep.data = payload[req.offset:end]
+        rep.data = bytes(payload[req.offset:end])  # payload is a bytearray
         rep.eof = end >= len(payload)
         ctx.reply(rep.SerializeToString())
 
 
 _FETCH_MISS = object()
+
+# ---------------------------------------------------------------------------
+# Framed out-of-band serialization (pickle protocol 5).
+#
+# The reference gets zero-copy numpy out of plasma by pinning arrays in shm
+# (serialization.py + plasma). Same idea here: large array payloads are
+# pickled with out-of-band buffers and laid out in a frame —
+#
+#   MAGIC  u32 idx_len  idx(header_len, nbuf, buf_lens...)  header
+#   [64-aligned buffer 0] [64-aligned buffer 1] ...
+#
+# — so the ENCODE side copies each array exactly once (into the frame) and
+# the DECODE side copies nothing: arrays are reconstructed backed by views
+# into the received frame (a TCP blob, or pinned shared-arena pages).
+# ---------------------------------------------------------------------------
+
+_FRAME_MAGIC = b"RTF5"
+
+
+def _release_arena_pin(arena, key: bytes):
+    try:
+        arena.release(key)
+    except Exception:
+        pass  # arena closed/shutdown: the pin died with the connection
+
+
+def _frame_layout(header_len: int, buf_lens: List[int]):
+    idx = _struct.pack(f">II{len(buf_lens)}Q", header_len, len(buf_lens),
+                       *buf_lens)
+    header_off = 4 + 4 + len(idx)
+    off = (header_off + header_len + 63) & ~63
+    buf_offs = []
+    for ln in buf_lens:
+        buf_offs.append(off)
+        off = (off + ln + 63) & ~63
+    return off, header_off, buf_offs, idx
+
+
+def _dumps_framed(value: Any) -> bytes:
+    """Serialize into one framed payload (single copy per array)."""
+    pbufs: List[Any] = []
+    header = cloudpickle.dumps(value, protocol=5,
+                               buffer_callback=pbufs.append)
+    raws = []
+    for b in pbufs:
+        try:
+            raws.append(b.raw())
+        except Exception:  # non-contiguous: materialize
+            raws.append(memoryview(bytes(b)))
+    total, hoff, boffs, idx = _frame_layout(len(header),
+                                            [r.nbytes for r in raws])
+    out = bytearray(total)
+    out[0:4] = _FRAME_MAGIC
+    out[4:8] = _struct.pack(">I", len(idx))
+    out[8:8 + len(idx)] = idx
+    out[hoff:hoff + len(header)] = header
+    for off, r in zip(boffs, raws):
+        out[off:off + r.nbytes] = r
+    # returned as the bytearray itself — bytes(out) would duplicate the
+    # whole frame; consumers slice per-chunk (and bytes() those slices
+    # where the wire needs real bytes)
+    return out
+
+
+def _loads_framed(view) -> Tuple[Any, bool]:
+    """Decode a frame from ``view`` (bytes or memoryview).
+
+    Returns ``(value, zero_copy)``: when ``zero_copy`` the value's arrays
+    reference ``view`` directly — the caller must keep the backing alive
+    (and pinned, for arena pages) for the value's lifetime."""
+    mv = memoryview(view).toreadonly()  # sealed objects are immutable —
+    # a writable view into shared arena pages must never leak to users
+    if bytes(mv[:4]) != _FRAME_MAGIC:
+        return pickle.loads(mv), False  # legacy plain-pickle payload
+    (idx_len,) = _struct.unpack(">I", mv[4:8])
+    header_len, nbuf = _struct.unpack_from(">II", mv, 8)
+    buf_lens = list(_struct.unpack_from(f">{nbuf}Q", mv, 16))
+    _, hoff, boffs, _ = _frame_layout(header_len, buf_lens)
+    header = bytes(mv[hoff:hoff + header_len])
+    buffers = [mv[off:off + ln] for off, ln in zip(boffs, buf_lens)]
+    return pickle.loads(header, buffers=buffers), nbuf > 0
 
 
 class _PushManager:
@@ -2314,7 +2421,7 @@ class _PushManager:
             client = self.rt.pool.get(addr)
             offset = 0
             while offset < len(payload) or offset == 0:
-                chunk = payload[offset:offset + FETCH_CHUNK]
+                chunk = bytes(payload[offset:offset + FETCH_CHUNK])
                 eof = offset + len(chunk) >= len(payload)
                 with self._cv:
                     while (not self._closed

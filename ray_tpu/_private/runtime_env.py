@@ -51,21 +51,32 @@ class _EnvGate:
         depth = getattr(self._depth, "n", 0)
         self._depth.n = depth + 1
         if depth > 0:
-            # nested applied() on one thread: the outer call holds the
-            # gate; apply inline with a per-level snapshot so the nested
-            # env's mutations are fully undone at its own exit (a nested
-            # DIFFERENT env must not bleed past its scope)
-            saved = {k: os.environ.get(k) for k in env.env_vars}
-            inserted = []
-            os.environ.update(env.env_vars)
-            for p in env.sys_paths:
-                if p not in sys.path:
-                    sys.path.insert(0, p)
-                    inserted.append(p)
-            stack = getattr(self._depth, "stack", None)
-            if stack is None:
-                stack = self._depth.stack = []
-            stack.append((saved, inserted))
+            # Nested applied() on one thread. A nested env with the SAME
+            # content is a no-op re-entry. A DIFFERENT env would mutate
+            # the process environment underneath concurrently running
+            # same-env peers (count > 1) — that silent bleed is worse
+            # than refusing, so it requires exclusivity.
+            with self.cv:
+                if env.key == self.active_key:
+                    self._push_nested(({}, []))
+                    return
+                if not self.cv.wait_for(lambda: self.count <= 1,
+                                        timeout=5.0):
+                    self._depth.n -= 1
+                    raise RuntimeEnvError(
+                        "nested runtime_env with a different environment "
+                        "while sibling tasks share the outer environment: "
+                        "unsupported in the shared-interpreter runtime "
+                        "(the reference isolates via per-worker "
+                        "processes)")
+                saved = {k: os.environ.get(k) for k in env.env_vars}
+                inserted = []
+                os.environ.update(env.env_vars)
+                for p in env.sys_paths:
+                    if p not in sys.path:
+                        sys.path.insert(0, p)
+                        inserted.append(p)
+                self._push_nested((saved, inserted))
             return
         with self.cv:
             while self.active_key not in (None, env.key):
@@ -75,18 +86,25 @@ class _EnvGate:
                 self._apply(env, save=True)
             self.count += 1
 
+    def _push_nested(self, snapshot):
+        stack = getattr(self._depth, "stack", None)
+        if stack is None:
+            stack = self._depth.stack = []
+        stack.append(snapshot)
+
     def exit(self, env: "MaterializedEnv"):
         self._depth.n = getattr(self._depth, "n", 1) - 1
         if self._depth.n > 0:
             saved, inserted = self._depth.stack.pop()
-            for k, v in saved.items():
-                if v is None:
-                    os.environ.pop(k, None)
-                else:
-                    os.environ[k] = v
-            for p in inserted:
-                with contextlib.suppress(ValueError):
-                    sys.path.remove(p)
+            with self.cv:
+                for k, v in saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+                for p in inserted:
+                    with contextlib.suppress(ValueError):
+                        sys.path.remove(p)
             return
         with self.cv:
             self.count -= 1

@@ -78,7 +78,12 @@ class TPUPodSliceProvider(NodeProvider):
         addr = self.provider_config.get("cluster_address", "")
         if not addr:
             return ""
-        return (f"#! /bin/bash\n"
+        # an authenticated cluster (the default) rejects tokenless joins;
+        # the slice must present the head-minted secret
+        token = self.provider_config.get("auth_token", "")
+        export = (f"export RAY_TPU_AUTH_TOKEN={shlex.quote(token)}\n"
+                  if token else "")
+        return (f"#! /bin/bash\n{export}"
                 f"python -m ray_tpu.scripts.cluster start "
                 f"--address={addr} --block &\n")
 

@@ -217,13 +217,14 @@ class XLAGroupShared:
                     x[0], axis, scatter_dimension=0, tiled=True)
                 out_spec = P("ranks")
             elif kind == "broadcast":
-                # one compiled fan-out from root over ICI (ppermute cannot
-                # express one-to-many; all_gather + root index lowers to a
-                # single ICI all-gather, not a host-mediated device_put
-                # per rank)
+                # one compiled O(N)-per-device fan-out from root over ICI:
+                # psum of the root-masked tensor (all_gather would move
+                # and transiently materialize world_size x the tensor;
+                # ppermute cannot express one-to-many)
                 root = op_desc[1]
-                body = lambda x: jax.lax.all_gather(  # noqa: E731
-                    x[0], axis)[root][None]
+                body = lambda x: jax.lax.psum(  # noqa: E731
+                    jnp.where(jax.lax.axis_index(axis) == root, x,
+                              jnp.zeros_like(x)), axis)
                 out_spec = P("ranks")
             else:
                 raise ValueError(kind)

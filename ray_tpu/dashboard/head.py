@@ -40,32 +40,37 @@ th{background:#f0f0f3;font-weight:600}
 <h2>Placement groups</h2><table id=pgs></table>
 <h2>Jobs</h2><table id=jobs></table>
 <script>
+// all dynamic values are escaped: actor/class/label names are
+// user-controlled and must not inject HTML into the viewer's page
+function esc(v){return String(v).replace(/[&<>"']/g,
+  c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]))}
 function row(cells, tag){tag=tag||'td';return '<tr>'+cells.map(c=>'<'+tag+'>'+c+'</'+tag+'>').join('')+'</tr>'}
+function rowe(cells, tag){return row(cells.map(esc), tag)}
 async function refresh(){
   const c = await (await fetch('/api/cluster')).json();
   let h = row(['node','address','state','CPU','TPU','cpu%','rss MB','arena','objects'],'th');
   for (const n of c.nodes){
     const s = n.stats||{}; const a = s.arena||{};
-    h += row([n.node_id.slice(0,8), n.address,
+    h += row([esc(n.node_id.slice(0,8)), esc(n.address),
       '<span class="'+(n.alive?'alive':'dead')+'">'+(n.alive?'ALIVE':'DEAD')+'</span>',
-      (n.available.CPU??0)+'/'+(n.total.CPU??0),
-      (n.available.TPU??'-')+'/'+(n.total.TPU??'-'),
-      s.cpu_percent??'-', s.rss_mb??'-',
-      a.capacity_mb? a.used_mb+'/'+a.capacity_mb+' MB'+(a.owner?' (owner)':'') : '-',
-      (s.object_store||{}).num_objects??'-']);
+      esc((n.available.CPU??0)+'/'+(n.total.CPU??0)),
+      esc((n.available.TPU??'-')+'/'+(n.total.TPU??'-')),
+      esc(s.cpu_percent??'-'), esc(s.rss_mb??'-'),
+      esc(a.capacity_mb? a.used_mb+'/'+a.capacity_mb+' MB'+(a.owner?' (owner)':'') : '-'),
+      esc((s.object_store||{}).num_objects??'-')]);
   }
   document.getElementById('nodes').innerHTML = h;
   const actors = await (await fetch('/api/actors')).json();
   let ah = row(['actor','class','state','node','restarts'],'th');
-  for (const x of actors) ah += row([x.actor_id.slice(0,8), x.class_name, x.state, (x.node_id||'').slice(0,8), x.num_restarts??0]);
+  for (const x of actors) ah += rowe([x.actor_id.slice(0,8), x.class_name, x.state, (x.node_id||'').slice(0,8), x.num_restarts??0]);
   document.getElementById('actors').innerHTML = ah;
   const pgs = await (await fetch('/api/pgs')).json();
   let ph = row(['pg','strategy','state','bundles'],'th');
-  for (const p of pgs) ph += row([p.pg_id.slice(0,8), p.strategy, p.state, p.num_bundles]);
+  for (const p of pgs) ph += rowe([p.pg_id.slice(0,8), p.strategy, p.state, p.num_bundles]);
   document.getElementById('pgs').innerHTML = ph;
   const jobs = await (await fetch('/api/jobs')).json();
   let jh = row(['job','driver','state'],'th');
-  for (const j of jobs) jh += row([j.job_id, j.driver_address, j.state]);
+  for (const j of jobs) jh += rowe([j.job_id, j.driver_address, j.state]);
   document.getElementById('jobs').innerHTML = jh;
   document.getElementById('updated').textContent = 'updated '+new Date().toLocaleTimeString();
 }

@@ -22,6 +22,9 @@ from ray_tpu.rl.multi_agent import (CoordinationGameEnv, MultiAgentBatch,
                                     MultiAgentRolloutWorker,
                                     RockPaperScissorsEnv,
                                     register_multi_agent_env)
+from ray_tpu.rl.offline import (BC, BCConfig, CQL, CQLConfig,
+                                collect_dataset, read_dataset,
+                                write_dataset)
 from ray_tpu.rl.sac import SAC, SACConfig
 from ray_tpu.rl.replay_buffer import (PrioritizedReplayBuffer, ReplayBuffer)
 from ray_tpu.rl.rollout_worker import (RolloutWorker, WorkerSet,
@@ -34,6 +37,8 @@ __all__ = [
     "ReplayBuffer", "PrioritizedReplayBuffer",
     "PPO", "PPOConfig", "DQN", "DQNConfig", "Impala", "ImpalaConfig",
     "SAC", "SACConfig",
+    "BC", "BCConfig", "CQL", "CQLConfig",
+    "collect_dataset", "read_dataset", "write_dataset",
     "MultiAgentEnv", "MultiAgentBatch", "MultiAgentRolloutWorker",
     "MultiAgentPPO", "MultiAgentPPOConfig", "CoordinationGameEnv",
     "RockPaperScissorsEnv", "register_multi_agent_env",

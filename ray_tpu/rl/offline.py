@@ -1,0 +1,320 @@
+"""Offline RL: dataset IO, Behavior Cloning, and Conservative Q-Learning.
+
+Parity with the reference's offline stack
+(``rllib/offline/json_reader.py``/``json_writer.py`` — SampleBatch
+datasets on disk; ``rllib/algorithms/bc/bc.py`` — supervised policy
+cloning; ``rllib/algorithms/cql/cql.py`` — SAC with the conservative
+Q regularizer for learning from fixed datasets without online
+exploration).
+
+TPU-first: an offline "rollout" is just a minibatch slice of the
+dataset, so training is pure supervised/TD compute — the whole epoch
+runs as jitted steps with no env in the loop. Datasets are columnar
+``.npz`` shards (numpy's native container), not JSON: loads are
+zero-parse and feed device transfers directly.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl import models as _models
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.sample_batch import SampleBatch, concat_samples
+
+# ---------------------------------------------------------------- dataset IO
+
+
+def write_dataset(batch: SampleBatch, path: str) -> str:
+    """Write one columnar shard (``json_writer.py`` role, npz format)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    np.savez_compressed(path, **{k: np.asarray(v) for k, v in batch.items()})
+    return path
+
+
+def read_dataset(path_or_glob: str) -> SampleBatch:
+    """Read shard(s) into one SampleBatch (``json_reader.py`` role)."""
+    paths = sorted(_glob.glob(path_or_glob)) or [path_or_glob]
+    parts = []
+    for p in paths:
+        with np.load(p) as z:
+            parts.append(SampleBatch({k: z[k] for k in z.files}))
+    return concat_samples(parts)
+
+
+def collect_dataset(env_name_or_maker, policy=None, n_steps: int = 1000,
+                    seed: int = 0, env_config: Optional[dict] = None
+                    ) -> SampleBatch:
+    """Roll a (possibly random) behavior policy to build a dataset."""
+    env = make_env(env_name_or_maker, env_config)
+    rng = np.random.default_rng(seed)
+    obs = env.reset(seed=seed)
+    cols: Dict[str, List[Any]] = {k: [] for k in (
+        SampleBatch.OBS, SampleBatch.ACTIONS, SampleBatch.REWARDS,
+        SampleBatch.NEXT_OBS, SampleBatch.TERMINATEDS)}
+    for _ in range(n_steps):
+        if policy is None:
+            action = env.spec.action_space.sample(rng)
+        else:
+            a, _, _ = policy.compute_actions(obs[None])
+            action = a[0]
+        obs2, rew, term, trunc, _ = env.step(action)
+        cols[SampleBatch.OBS].append(obs)
+        cols[SampleBatch.ACTIONS].append(action)
+        cols[SampleBatch.REWARDS].append(rew)
+        cols[SampleBatch.NEXT_OBS].append(obs2)
+        cols[SampleBatch.TERMINATEDS].append(term)
+        obs = env.reset() if (term or trunc) else obs2
+    return SampleBatch({k: np.asarray(v) for k, v in cols.items()})
+
+
+# ---------------------------------------------------------------- BC
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or BC)
+        self.lr = 1e-3
+        self.train_batch_size = 256
+        self.n_updates_per_iter = 32
+        self.input_ = None   # SampleBatch | path/glob (reference: config.offline_data)
+        self.model = {"fcnet_hiddens": (64, 64)}
+
+
+class BC(Algorithm):
+    """Behavior Cloning (``rllib/algorithms/bc``): supervised max-logp of
+    dataset actions. No env interaction; ``env`` is only used for spaces
+    (and optional evaluation)."""
+
+    _config_cls = BCConfig
+
+    @classmethod
+    def get_default_config(cls) -> BCConfig:
+        return BCConfig(cls)
+
+    def _needs_advantages(self) -> bool:
+        return False
+
+    def _make_worker_set(self):
+        # env workers exist only to expose spaces + run evaluation rollouts
+        from ray_tpu.rl.rollout_worker import WorkerSet
+        kw = self._worker_kwargs()
+        kw["rollout_fragment_length"] = 200
+        return WorkerSet(0, kw)
+
+    def _load_dataset(self) -> SampleBatch:
+        inp = getattr(self.algo_config, "input_", None)
+        if inp is None:
+            raise ValueError("BC/CQL require .training(input_=...) — a "
+                             "SampleBatch or an npz path/glob")
+        if isinstance(inp, str):
+            return read_dataset(inp)
+        return inp
+
+    def _make_learner(self):
+        cfg = self.algo_config
+        self.dataset = self._load_dataset()
+        lw = self.workers.local_worker
+        pol = lw.policy
+        self._continuous = pol.continuous
+        self._rng = np.random.default_rng(cfg.seed)
+        params = jax.tree_util.tree_map(jnp.asarray, pol.params)
+        optimizer = optax.adam(cfg.lr)
+        opt_state = optimizer.init(params)
+        continuous = self._continuous
+
+        def bc_step(params, opt_state, obs, actions):
+            def loss_fn(p):
+                dist_in, _ = _models.actor_critic_apply(p, obs)
+                dist = _models.make_distribution(p, dist_in, continuous)
+                return -jnp.mean(dist.logp(actions))
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = optimizer.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._step = jax.jit(bc_step)
+        return {"params": params, "opt_state": opt_state}
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        n = len(self.dataset)
+        losses = []
+        for _ in range(cfg.n_updates_per_iter):
+            idx = self._rng.integers(0, n, cfg.train_batch_size)
+            obs = jnp.asarray(self.dataset[SampleBatch.OBS][idx],
+                              jnp.float32)
+            act = jnp.asarray(self.dataset[SampleBatch.ACTIONS][idx])
+            (self.learner["params"], self.learner["opt_state"],
+             loss) = self._step(self.learner["params"],
+                                self.learner["opt_state"], obs, act)
+            losses.append(float(loss))
+        self._timesteps_total += cfg.n_updates_per_iter * cfg.train_batch_size
+        self.workers.local_worker.set_weights(
+            jax.device_get(self.learner["params"]))
+        return {"bc_loss": float(np.mean(losses)),
+                "timesteps_this_iter": cfg.n_updates_per_iter
+                * cfg.train_batch_size,
+                "dataset_size": n}
+
+    def evaluate(self, n_episodes: int = 5) -> float:
+        """Greedy rollout return of the cloned policy."""
+        lw = self.workers.local_worker
+        total = []
+        for ep in range(n_episodes):
+            env = lw.vector_env.envs[0]
+            obs = env.reset(seed=1000 + ep)
+            ep_ret, done = 0.0, False
+            while not done:
+                a, _, _ = lw.policy.compute_actions(obs[None], explore=False)
+                obs, r, term, trunc, _ = env.step(a[0])
+                ep_ret += r
+                done = term or trunc
+            total.append(ep_ret)
+        return float(np.mean(total))
+
+    def _learner_state(self):
+        return jax.device_get((self.learner["params"],
+                               self.learner["opt_state"]))
+
+    def _set_learner_state(self, state):
+        if state:
+            p, o = state
+            self.learner["params"] = jax.tree_util.tree_map(jnp.asarray, p)
+            self.learner["opt_state"] = jax.tree_util.tree_map(
+                jnp.asarray, o)
+
+
+# ---------------------------------------------------------------- CQL
+
+
+class CQLConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or CQL)
+        self.lr = 3e-4
+        self.train_batch_size = 256
+        self.n_updates_per_iter = 32
+        self.input_ = None
+        self.cql_alpha = 1.0     # conservative penalty weight
+        self.tau = 0.005
+        self.model = {"fcnet_hiddens": (256, 256)}
+
+
+class CQL(Algorithm):
+    """Conservative Q-Learning for discrete control
+    (``rllib/algorithms/cql``): double-Q TD learning on the fixed dataset
+    plus the CQL(H) regularizer ``logsumexp Q - Q(s, a_data)``, which
+    pushes down out-of-distribution action values so the greedy policy
+    stays inside the dataset's support."""
+
+    _config_cls = CQLConfig
+
+    @classmethod
+    def get_default_config(cls) -> CQLConfig:
+        return CQLConfig(cls)
+
+    def _needs_advantages(self) -> bool:
+        return False
+
+    def _make_worker_set(self):
+        from ray_tpu.rl.dqn import EpsilonGreedyPolicy
+        from ray_tpu.rl.rollout_worker import WorkerSet
+        kw = self._worker_kwargs()
+        kw["policy_cls"] = EpsilonGreedyPolicy
+        return WorkerSet(0, kw)
+
+    def _make_learner(self):
+        cfg = self.algo_config
+        self.dataset = BC._load_dataset(self)
+        lw = self.workers.local_worker
+        self._rng = np.random.default_rng(cfg.seed)
+        params = jax.tree_util.tree_map(jnp.asarray, lw.get_weights())
+        target = jax.tree_util.tree_map(jnp.array, params)
+        optimizer = optax.adam(cfg.lr)
+        opt_state = optimizer.init(params)
+        gamma, alpha, tau = cfg.gamma, cfg.cql_alpha, cfg.tau
+
+        def step(params, target, opt_state, batch):
+            obs = batch["obs"]
+            act = batch["act"].astype(jnp.int32)
+            rew = batch["rew"]
+            nxt = batch["nxt"]
+            not_done = 1.0 - batch["done"].astype(jnp.float32)
+
+            def loss_fn(p):
+                q = _models.mlp_apply(p["pi"], obs, activation="relu")
+                qa = jnp.take_along_axis(q, act[:, None], axis=-1)[:, 0]
+                qn = _models.mlp_apply(target["pi"], nxt, activation="relu")
+                y = rew + gamma * not_done * jax.lax.stop_gradient(
+                    jnp.max(qn, axis=-1))
+                td = jnp.mean((qa - y) ** 2)
+                # CQL(H): minimize logsumexp(Q) (OOD actions) while
+                # maximizing Q of dataset actions
+                cql = jnp.mean(
+                    jax.scipy.special.logsumexp(q, axis=-1) - qa)
+                return td + alpha * cql, (td, cql)
+
+            (loss, (td, cql)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = optimizer.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            target = jax.tree_util.tree_map(
+                lambda t, o: (1 - tau) * t + tau * o, target, params)
+            return params, target, opt_state, td, cql
+
+        self._step = jax.jit(step, donate_argnums=(0, 1, 2))
+        return {"params": params, "target": target, "opt_state": opt_state}
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        ds = self.dataset
+        n = len(ds)
+        tds, cqls = [], []
+        for _ in range(cfg.n_updates_per_iter):
+            idx = self._rng.integers(0, n, cfg.train_batch_size)
+            batch = {
+                "obs": jnp.asarray(ds[SampleBatch.OBS][idx], jnp.float32),
+                "act": jnp.asarray(ds[SampleBatch.ACTIONS][idx]),
+                "rew": jnp.asarray(ds[SampleBatch.REWARDS][idx],
+                                   jnp.float32),
+                "nxt": jnp.asarray(ds[SampleBatch.NEXT_OBS][idx],
+                                   jnp.float32),
+                "done": jnp.asarray(ds[SampleBatch.TERMINATEDS][idx]),
+            }
+            (self.learner["params"], self.learner["target"],
+             self.learner["opt_state"], td, cql) = self._step(
+                self.learner["params"], self.learner["target"],
+                self.learner["opt_state"], batch)
+            tds.append(float(td))
+            cqls.append(float(cql))
+        self._timesteps_total += cfg.n_updates_per_iter * cfg.train_batch_size
+        self.workers.local_worker.set_weights(
+            jax.device_get(self.learner["params"]))
+        return {"td_loss": float(np.mean(tds)),
+                "cql_penalty": float(np.mean(cqls)),
+                "timesteps_this_iter": cfg.n_updates_per_iter
+                * cfg.train_batch_size,
+                "dataset_size": n}
+
+    evaluate = BC.evaluate
+
+    def _learner_state(self):
+        return jax.device_get((self.learner["params"],
+                               self.learner["target"],
+                               self.learner["opt_state"]))
+
+    def _set_learner_state(self, state):
+        if state:
+            p, t, o = state
+            self.learner["params"] = jax.tree_util.tree_map(jnp.asarray, p)
+            self.learner["target"] = jax.tree_util.tree_map(jnp.asarray, t)
+            self.learner["opt_state"] = jax.tree_util.tree_map(
+                jnp.asarray, o)

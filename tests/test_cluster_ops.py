@@ -349,6 +349,7 @@ def test_tpu_provider_lifecycle():
     prov = TPUPodSliceProvider({
         "project": "p", "zone": "us-central2-b",
         "cluster_address": "head:6379",
+        "auth_token": "s3cret",
         "node_types": {
             "v5e-8": {"accelerator_type": "v5litepod-8",
                       "resources": {"CPU": 208, "TPU": 8}}},
@@ -359,8 +360,10 @@ def test_tpu_provider_lifecycle():
     create_cmd = fake.commands[0]
     assert "--accelerator-type=v5litepod-8" in create_cmd
     assert "--project=p" in create_cmd and "--zone=us-central2-b" in create_cmd
-    assert any("startup-script" in a and "head:6379" in a
-               for a in create_cmd), create_cmd
+    script = next(a for a in create_cmd if "startup-script" in a)
+    assert "head:6379" in script
+    # the slice must present the cluster's auth token when joining
+    assert "RAY_TPU_AUTH_TOKEN=s3cret" in script
 
     live = prov.non_terminated_nodes()
     assert sorted(live) == sorted(ids)

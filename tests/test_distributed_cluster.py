@@ -245,6 +245,10 @@ def test_same_host_fetch_goes_through_arena(cluster):
     used, cap, count = rt.host_arena.stats()
     assert count >= before + 1, "payload should be cached in the arena"
     assert used > 3_000_000
+    # zero-copy decode: the array is a read-only view over the shared
+    # arena pages (protocol-5 out-of-band buffers), not a pickled copy
+    assert not val.flags.owndata
+    assert not val.flags.writeable
 
 
 def test_arena_survives_repeat_fetches_and_eviction(cluster):

@@ -517,3 +517,68 @@ def test_shared_policy_batches_are_agent_contiguous():
     np.testing.assert_allclose(b[SB.REWARDS][:10], -b[SB.REWARDS][10:])
     assert np.isfinite(b[SB.ADVANTAGES]).all()
     assert "bootstrap_values" in b  # truncation bootstraps V(terminal obs)
+
+
+# -- offline RL ------------------------------------------------------------
+
+class _CartPoleExpert:
+    """Hand-coded balance controller: near-optimal behavior policy."""
+    continuous = False
+
+    def compute_actions(self, obs, explore=True):
+        a = (obs[:, 2] + 0.5 * obs[:, 3] > 0).astype(np.int64)
+        z = np.zeros(len(a), np.float32)
+        return a, z, z
+
+
+def _expert_dataset(n_steps=4000):
+    from ray_tpu.rl import collect_dataset
+    return collect_dataset("CartPole-v1", policy=_CartPoleExpert(),
+                           n_steps=n_steps, seed=0)
+
+
+def test_offline_dataset_io_roundtrip(tmp_path):
+    from ray_tpu.rl import read_dataset, write_dataset
+    ds = _expert_dataset(300)
+    write_dataset(ds.slice(0, 150), str(tmp_path / "shard-000.npz"))
+    write_dataset(ds.slice(150, 300), str(tmp_path / "shard-001.npz"))
+    back = read_dataset(str(tmp_path / "shard-*.npz"))
+    assert len(back) == 300
+    np.testing.assert_array_equal(back[SB.OBS], ds[SB.OBS])
+    np.testing.assert_array_equal(back[SB.ACTIONS], ds[SB.ACTIONS])
+
+
+def test_bc_clones_expert(tmp_path):
+    """BC on an expert CartPole dataset must reach near-expert return
+    (reference: rllib/algorithms/bc learning tests)."""
+    from ray_tpu.rl import BC, write_dataset
+    ds = _expert_dataset()
+    path = str(tmp_path / "expert.npz")
+    write_dataset(ds, path)   # exercise the path-input route
+    bc = (BC.get_default_config().environment("CartPole-v1")
+          .training(input_=path, n_updates_per_iter=64)
+          .debugging(seed=0).build())
+    try:
+        for _ in range(10):
+            r = bc.step()
+        assert r["dataset_size"] == len(ds)
+        assert bc.evaluate(n_episodes=3) >= 300.0
+    finally:
+        bc.stop()
+
+
+def test_cql_learns_from_offline_data():
+    """CQL (TD + conservative penalty) on the same dataset also recovers
+    a balancing policy without any environment interaction."""
+    from ray_tpu.rl import CQL
+    cql = (CQL.get_default_config().environment("CartPole-v1")
+           .training(input_=_expert_dataset(), n_updates_per_iter=64,
+                     cql_alpha=1.0)
+           .debugging(seed=0).build())
+    try:
+        for _ in range(15):
+            r = cql.step()
+        assert r["cql_penalty"] < 2.0   # OOD gap driven down
+        assert cql.evaluate(n_episodes=3) >= 300.0
+    finally:
+        cql.stop()
