@@ -70,6 +70,47 @@ def load_native_library(name: str) -> Optional[ctypes.CDLL]:
         return lib
 
 
+def build_cpp_worker_demo() -> str:
+    """Build the C++ worker-API demo driver (``cpp_worker.cc``): the
+    cross-language client that joins a cluster, round-trips the KV and
+    invokes Python named functions with JSON args."""
+    proto_dir = os.path.normpath(os.path.join(_DIR, os.pardir, "protocol"))
+    proto = os.path.join(proto_dir, "raytpu.proto")
+    src = os.path.join(_DIR, "cpp_worker.cc")
+    gen_dir = os.path.join(_DIR, "gen")
+    pb_cc = os.path.join(gen_dir, "raytpu.pb.cc")
+    exe = os.path.join(_DIR, f"raytpu_cpp_demo{_artifact_suffix()}")
+    with _LOCK:
+        try:
+            src_mtime = max(os.path.getmtime(src), os.path.getmtime(proto))
+            if os.path.exists(exe) and os.path.getmtime(exe) >= src_mtime:
+                return exe
+            os.makedirs(gen_dir, exist_ok=True)
+            if (not os.path.exists(pb_cc)
+                    or os.path.getmtime(pb_cc) < os.path.getmtime(proto)):
+                subprocess.run(
+                    ["protoc", f"--proto_path={proto_dir}",
+                     f"--cpp_out={gen_dir}", proto],
+                    check=True, capture_output=True, text=True)
+            import tempfile
+            fd, tmp = tempfile.mkstemp(prefix="raytpu_cpp_demo_", dir=_DIR)
+            os.close(fd)
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-DRAYTPU_CPP_DEMO_MAIN",
+                 *_sanitize_flags(), "-o", tmp, src, pb_cc,
+                 f"-I{gen_dir}", "-lprotobuf", "-lpthread"],
+                check=True, capture_output=True, text=True)
+            os.chmod(tmp, 0o755)
+            os.replace(tmp, exe)
+        except subprocess.CalledProcessError as e:
+            raise NativeBuildError(
+                f"cpp worker demo build failed:\n{e.stderr}") from e
+        except OSError as e:
+            raise NativeBuildError(
+                f"cpp worker demo build failed: {e}") from e
+        return exe
+
+
 def build_state_service() -> str:
     """Build the C++ state-service binary (protoc gen + g++ + libprotobuf);
     returns the executable path. Cached until sources change."""

@@ -1,0 +1,291 @@
+// C++ worker/driver API for the ray_tpu cluster.
+//
+// The role of the reference's C++ worker API (src/ray/core_worker C++
+// bindings + cpp/ frontend), shaped for this runtime's cross-language
+// contract: a C++ program joins an existing cluster as a DRIVER — it
+// discovers daemons through the state service, submits tasks that invoke
+// Python functions registered by name (register_named_function), passes
+// arguments as JSON, and receives JSON results inline in the task reply
+// (reply-as-completion, so no C++ unpickler is needed anywhere).
+//
+// Speaks the native wire protocol: 4-byte big-endian frame length +
+// raytpu.Envelope, with the AUTH first-frame handshake. Link with the
+// protoc-generated raytpu.pb.cc (see build.py build_cpp_worker_demo).
+//
+// The library surface (RayTpuClient) is header-free on purpose: this file
+// compiles either into the demo binary (RAYTPU_CPP_DEMO_MAIN) or can be
+// #included / linked into a user's C++ program.
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <random>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "raytpu.pb.h"
+
+namespace raytpu_cpp {
+
+class Connection {
+ public:
+  Connection(const std::string& host, int port, const std::string& token) {
+    // getaddrinfo: cluster addresses are routinely hostnames, not
+    // numeric IPs (e.g. the autoscaler's --address=head:6379)
+    struct addrinfo hints = {};
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    std::string port_s = std::to_string(port);
+    if (getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) != 0 ||
+        res == nullptr)
+      throw std::runtime_error("cannot resolve " + host);
+    int err = -1;
+    for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+      fd_ = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+      if (fd_ < 0) continue;
+      if (connect(fd_, ai->ai_addr, ai->ai_addrlen) == 0) {
+        err = 0;
+        break;
+      }
+      close(fd_);
+      fd_ = -1;
+    }
+    freeaddrinfo(res);
+    if (err != 0 || fd_ < 0)
+      throw std::runtime_error("connect to " + host + " failed");
+    int one = 1;
+    setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    if (!token.empty()) {
+      raytpu::Envelope auth;
+      auth.set_seq(0);
+      auth.set_method(raytpu::AUTH);
+      auth.set_body(token);
+      SendEnvelope(auth);
+    }
+  }
+
+  ~Connection() {
+    if (fd_ >= 0) close(fd_);
+  }
+
+  raytpu::Envelope Call(raytpu::Method method, const std::string& body) {
+    raytpu::Envelope req;
+    req.set_seq(++seq_);
+    req.set_method(method);
+    req.set_body(body);
+    SendEnvelope(req);
+    // replies can interleave with pushes on this protocol; a plain driver
+    // connection sees only its own replies (no subscriptions) — read
+    // frames until our seq answers
+    while (true) {
+      raytpu::Envelope rep = ReadEnvelope();
+      if (rep.seq() == req.seq()) {
+        if (!rep.error().empty())
+          throw std::runtime_error("rpc error: " + rep.error());
+        return rep;
+      }
+    }
+  }
+
+ private:
+  void SendEnvelope(const raytpu::Envelope& env) {
+    std::string payload;
+    env.SerializeToString(&payload);
+    uint32_t len = htonl(static_cast<uint32_t>(payload.size()));
+    std::string frame(reinterpret_cast<char*>(&len), 4);
+    frame += payload;
+    WriteExact(frame.data(), frame.size());
+  }
+
+  raytpu::Envelope ReadEnvelope() {
+    uint8_t hdr[4];
+    ReadExact(hdr, 4);
+    uint32_t len = (uint32_t(hdr[0]) << 24) | (uint32_t(hdr[1]) << 16) |
+                   (uint32_t(hdr[2]) << 8) | uint32_t(hdr[3]);
+    std::string buf(len, '\0');
+    ReadExact(buf.data(), len);
+    raytpu::Envelope env;
+    if (!env.ParseFromString(buf))
+      throw std::runtime_error("bad envelope frame");
+    return env;
+  }
+
+  void WriteExact(const void* data, size_t n) {
+    const char* p = static_cast<const char*>(data);
+    while (n > 0) {
+      ssize_t r = write(fd_, p, n);
+      if (r <= 0) throw std::runtime_error("connection write failed");
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+  }
+
+  void ReadExact(void* data, size_t n) {
+    char* p = static_cast<char*>(data);
+    while (n > 0) {
+      ssize_t r = read(fd_, p, n);
+      if (r <= 0) throw std::runtime_error("connection closed");
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+  }
+
+  int fd_ = -1;
+  uint64_t seq_ = 0;
+};
+
+struct HostPort {
+  std::string host;
+  int port;
+};
+
+inline HostPort SplitAddr(const std::string& addr) {
+  auto pos = addr.rfind(':');
+  if (pos == std::string::npos)
+    throw std::runtime_error("address must be host:port: " + addr);
+  return {addr.substr(0, pos), std::stoi(addr.substr(pos + 1))};
+}
+
+class RayTpuClient {
+ public:
+  RayTpuClient(const std::string& state_addr, const std::string& token)
+      : token_(token), rng_(std::random_device{}()) {
+    auto hp = SplitAddr(state_addr);
+    state_ = std::make_unique<Connection>(hp.host, hp.port, token_);
+    job_id_ = RandomBytes(4);
+  }
+
+  // -- cluster introspection ------------------------------------------
+  std::vector<raytpu::NodeInfo> ListNodes() {
+    raytpu::Envelope rep = state_->Call(raytpu::LIST_NODES, "");
+    raytpu::ListNodesReply nodes;
+    nodes.ParseFromString(rep.body());
+    std::vector<raytpu::NodeInfo> out;
+    for (const auto& n : nodes.nodes()) out.push_back(n);
+    return out;
+  }
+
+  // -- KV (cross-language shared state) -------------------------------
+  bool KvPut(const std::string& key, const std::string& value) {
+    raytpu::KvPutRequest req;
+    req.set_key(key);
+    req.set_value(value);
+    req.set_overwrite(true);
+    std::string body;
+    req.SerializeToString(&body);
+    raytpu::KvPutReply kp;
+    kp.ParseFromString(state_->Call(raytpu::KV_PUT, body).body());
+    return kp.added();
+  }
+
+  std::string KvGet(const std::string& key) {
+    raytpu::KvGetRequest req;
+    req.set_key(key);
+    std::string body;
+    req.SerializeToString(&body);
+    raytpu::KvGetReply kg;
+    kg.ParseFromString(state_->Call(raytpu::KV_GET, body).body());
+    return kg.found() ? kg.value() : "";
+  }
+
+  // -- cross-language task submission ---------------------------------
+  // Invoke a Python function registered via register_named_function with
+  // JSON positional args; returns the JSON-encoded result. Throws on task
+  // error (message from the daemon's language-neutral error_message).
+  std::string SubmitTask(const std::string& function_name,
+                         const std::string& args_json) {
+    // pick an alive daemon
+    std::string daemon_addr;
+    for (const auto& n : ListNodes()) {
+      if (n.alive() && !n.address().empty() && !n.is_head()) {
+        daemon_addr = n.address();
+        break;
+      }
+    }
+    if (daemon_addr.empty())
+      for (const auto& n : ListNodes())
+        if (n.alive() && !n.address().empty()) daemon_addr = n.address();
+    if (daemon_addr.empty())
+      throw std::runtime_error("no alive daemons in the cluster");
+
+    auto hp = SplitAddr(daemon_addr);
+    Connection daemon(hp.host, hp.port, token_);
+    raytpu::TaskSpecMsg spec;
+    std::string task_id = RandomBytes(16);
+    spec.set_task_id(task_id);
+    spec.set_job_id(job_id_);
+    spec.set_function_name(function_name);
+    spec.set_named_function(function_name);
+    spec.set_args_json(args_json);
+    spec.set_json_results(true);
+    spec.set_num_returns(1);
+    // return id: task_id(16) + little-endian index 0 (ids.py ObjectID)
+    std::string rid = task_id + std::string(4, '\0');
+    spec.add_return_ids(rid);
+    (*spec.mutable_resources()->mutable_amounts())["CPU"] = 1.0;
+    std::string body;
+    spec.SerializeToString(&body);
+    raytpu::Envelope rep = daemon.Call(raytpu::PUSH_TASK, body);
+    raytpu::PushTaskReply out;
+    out.ParseFromString(rep.body());
+    if (out.status() != "ok")
+      throw std::runtime_error("task not admitted: " + out.status());
+    if (!out.error_message().empty())
+      throw std::runtime_error("task failed: " + out.error_message());
+    if (out.inline_results_size() > 0 && out.inline_(0))
+      return out.inline_results(0);
+    throw std::runtime_error("no inline result (json_results expected)");
+  }
+
+ private:
+  std::string RandomBytes(size_t n) {
+    std::string out(n, '\0');
+    std::uniform_int_distribution<int> d(0, 255);
+    for (size_t i = 0; i < n; ++i)
+      out[i] = static_cast<char>(d(rng_));
+    return out;
+  }
+
+  std::string token_;
+  std::string job_id_;
+  std::unique_ptr<Connection> state_;
+  std::mt19937_64 rng_;
+};
+
+}  // namespace raytpu_cpp
+
+#ifdef RAYTPU_CPP_DEMO_MAIN
+// Demo driver: raytpu_cpp_demo <state_addr> [token]
+//   - lists nodes
+//   - round-trips the KV
+//   - calls the Python-registered named function "cpp_add" with [2, 3]
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <state_addr> [token]\n", argv[0]);
+    return 2;
+  }
+  std::string token = argc > 2 ? argv[2] : "";
+  try {
+    raytpu_cpp::RayTpuClient client(argv[1], token);
+    auto nodes = client.ListNodes();
+    printf("nodes=%zu\n", nodes.size());
+    client.KvPut("cpp-kv-key", "from-cpp");
+    printf("kv=%s\n", client.KvGet("cpp-kv-key").c_str());
+    std::string result = client.SubmitTask("cpp_add", "[2, 3]");
+    printf("cpp_add(2,3)=%s\n", result.c_str());
+    return 0;
+  } catch (const std::exception& e) {
+    fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+}
+#endif

@@ -75,6 +75,7 @@ logger = logging.getLogger("ray_tpu")
 INLINE_RESULT_MAX = 256 * 1024  # results below this ride in the reply
 FETCH_CHUNK = 8 * 1024 * 1024
 FN_NS = b"fun"  # KV namespace of the function table
+NAMED_FN_NS = b"namedfn"  # cross-language named-function registry
 
 
 def _fn_key(payload: bytes) -> bytes:
@@ -1064,6 +1065,30 @@ class DistributedRuntime(Runtime):
             self._exported_fns[key] = payload
         return key
 
+    def register_named_function(self, name: str, fn) -> None:
+        """Publish ``fn`` under ``name`` for cross-language callers (the
+        reference's cross-language function registration: a C++/Java
+        driver names the function, the Python worker executes it).
+
+        The registry maps the MUTABLE name to the content hash; payloads
+        live content-addressed in the function table. Daemons cache by
+        hash only, so re-registering a name takes effect on the next call
+        cluster-wide (a name-keyed cache would pin the stale version)."""
+        payload = cloudpickle.dumps(fn)
+        key = _fn_key(payload)
+        self.state.kv_put(key, payload, overwrite=False, namespace=FN_NS)
+        self._fn_cache[key] = fn
+        self.state.kv_put(name.encode(), key, overwrite=True,
+                          namespace=NAMED_FN_NS)
+
+    def _load_named_function(self, name: str):
+        key = self.state.kv_get(name.encode(), namespace=NAMED_FN_NS)
+        if key is None:
+            raise exc.RayTpuError(
+                f"named function {name!r} is not registered "
+                f"(register_named_function)")
+        return self._load_callable(bytes(key))
+
     def _load_callable(self, key: bytes):
         fn = self._fn_cache.get(key)
         if fn is None:
@@ -1894,7 +1919,13 @@ class DistributedRuntime(Runtime):
             ctx.reply_error(f"unhandled method {method}")
 
     def _msg_to_spec(self, msg: pb.TaskSpecMsg) -> TaskSpec:
-        args, kwargs = cloudpickle.loads(msg.args_pickle)
+        if msg.named_function:
+            # cross-language submission (C++ worker API): function by
+            # registry name, language-neutral JSON positional args
+            args = tuple(json.loads(bytes(msg.args_json).decode() or "[]"))
+            kwargs = {}
+        else:
+            args, kwargs = cloudpickle.loads(msg.args_pickle)
         retry_exceptions: Any = False
         if msg.retry_exceptions_pickle:
             retry_exceptions = cloudpickle.loads(msg.retry_exceptions_pickle)
@@ -1917,6 +1948,9 @@ class DistributedRuntime(Runtime):
         if msg.actor_id:
             spec.actor_id = ActorID(msg.actor_id)
             spec.method_name = msg.method_name
+        elif msg.named_function:
+            spec.function = self._load_named_function(msg.named_function)
+            spec._json_results = bool(msg.json_results)
         else:
             spec.function = self._load_callable(bytes(msg.fn_hash))
         if msg.pg_id:
@@ -1988,6 +2022,9 @@ class DistributedRuntime(Runtime):
                                        exc.RayTpuError(
                                            f"task deserialization failed: "
                                            f"{type(e).__name__}: {e}")))
+            if msg.json_results:
+                # cross-language caller: it cannot unpickle the error
+                rep.error_message = f"{type(e).__name__}: {e}"
             ctx.reply(rep.SerializeToString())
             return
         if not self._admission_check(spec.options.resources):
@@ -2039,6 +2076,7 @@ class DistributedRuntime(Runtime):
                 err = e
                 break
         if err is not None:
+            rep.error_message = f"{type(err).__name__}: {err}"
             try:
                 rep.error_pickle = cloudpickle.dumps(err)
             except Exception:
@@ -2048,10 +2086,35 @@ class DistributedRuntime(Runtime):
             for rid in spec.return_ids:
                 store.free(rid)
         else:
+            json_results = getattr(spec, "_json_results", False)
             for rid in spec.return_ids:
                 payload: Optional[bytes] = None
                 try:
                     value = store.get(rid, timeout=0)
+                    if json_results:
+                        # cross-language caller: language-neutral result,
+                        # always inline (it cannot unpickle a fetch) — and
+                        # an unserializable result must surface as an
+                        # error, not linger unreachable in the store
+                        try:
+                            payload = json.dumps(value).encode()
+                        except (TypeError, ValueError):
+                            rep.error_message = (
+                                f"task result of type "
+                                f"{type(value).__name__} is not "
+                                f"JSON-serializable (cross-language "
+                                f"callers require JSON results)")
+                            for r2 in spec.return_ids:
+                                store.free(r2)
+                            del rep.inline[:]
+                            del rep.inline_results[:]
+                            break
+                        rep.inline.append(True)
+                        rep.inline_results.append(payload)
+                        store.free(rid)
+                        with self.lock:
+                            self.object_locations.pop(rid, None)
+                        continue
                     payload = cloudpickle.dumps(value)
                 except Exception:
                     payload = None

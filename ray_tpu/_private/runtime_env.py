@@ -111,7 +111,9 @@ class _EnvGate:
             if self.count == 0:
                 self._restore()
                 self.active_key = None
-                self.cv.notify_all()
+            # notify on EVERY decrement: nested-env entrants wait for
+            # count <= 1, not just 0
+            self.cv.notify_all()
 
     def _apply(self, env: "MaterializedEnv", save: bool):
         if save:

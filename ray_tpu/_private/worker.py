@@ -284,3 +284,24 @@ def timeline(filename: Optional[str] = None):
     ``state.py:419``)."""
     from ray_tpu._private.profiling import dump_timeline
     return dump_timeline(filename)
+
+
+def register_named_function(name: str, fn=None):
+    """Publish a function for cross-language callers (the C++ worker API
+    submits by name with JSON args). Usable as a decorator::
+
+        @ray_tpu.register_named_function("add")
+        def add(a, b): return a + b
+    """
+    if fn is None:
+        def deco(f):
+            register_named_function(name, f)
+            return f
+        return deco
+    runtime = global_worker().runtime
+    reg = getattr(runtime, "register_named_function", None)
+    if reg is None:
+        raise RuntimeError("named functions need a cluster runtime "
+                           "(init(address=...) or a daemon)")
+    reg(name, fn)
+    return fn

@@ -222,9 +222,11 @@ class XLAGroupShared:
                 # and transiently materialize world_size x the tensor;
                 # ppermute cannot express one-to-many)
                 root = op_desc[1]
+                # astype: psum converts bool inputs to integers — the
+                # broadcast result must keep the input dtype
                 body = lambda x: jax.lax.psum(  # noqa: E731
                     jnp.where(jax.lax.axis_index(axis) == root, x,
-                              jnp.zeros_like(x)), axis)
+                              jnp.zeros_like(x)), axis).astype(x.dtype)
                 out_spec = P("ranks")
             else:
                 raise ValueError(kind)
