@@ -70,16 +70,17 @@ def load_native_library(name: str) -> Optional[ctypes.CDLL]:
         return lib
 
 
-def build_cpp_worker_demo() -> str:
-    """Build the C++ worker-API demo driver (``cpp_worker.cc``): the
-    cross-language client that joins a cluster, round-trips the KV and
-    invokes Python named functions with JSON args."""
+def _build_proto_binary(src_name: str, exe_prefix: str,
+                        extra_flags: list) -> str:
+    """Shared recipe for the protobuf-linked C++ binaries (state service,
+    cpp worker demo): protoc gen + g++, mtime-cached, sanitizer-aware,
+    tmp-file atomic replace (concurrent builders must not interleave)."""
     proto_dir = os.path.normpath(os.path.join(_DIR, os.pardir, "protocol"))
     proto = os.path.join(proto_dir, "raytpu.proto")
-    src = os.path.join(_DIR, "cpp_worker.cc")
+    src = os.path.join(_DIR, src_name)
     gen_dir = os.path.join(_DIR, "gen")
     pb_cc = os.path.join(gen_dir, "raytpu.pb.cc")
-    exe = os.path.join(_DIR, f"raytpu_cpp_demo{_artifact_suffix()}")
+    exe = os.path.join(_DIR, f"{exe_prefix}{_artifact_suffix()}")
     with _LOCK:
         try:
             src_mtime = max(os.path.getmtime(src), os.path.getmtime(proto))
@@ -93,63 +94,33 @@ def build_cpp_worker_demo() -> str:
                      f"--cpp_out={gen_dir}", proto],
                     check=True, capture_output=True, text=True)
             import tempfile
-            fd, tmp = tempfile.mkstemp(prefix="raytpu_cpp_demo_", dir=_DIR)
+            fd, tmp = tempfile.mkstemp(prefix=f"{exe_prefix}_", dir=_DIR)
             os.close(fd)
             subprocess.run(
-                ["g++", "-O2", "-std=c++17", "-DRAYTPU_CPP_DEMO_MAIN",
+                ["g++", "-O2", "-std=c++17", *extra_flags,
                  *_sanitize_flags(), "-o", tmp, src, pb_cc,
-                 f"-I{gen_dir}", "-lprotobuf", "-lpthread"],
+                 f"-I{gen_dir}", f"-I{_DIR}", "-lprotobuf", "-lpthread"],
                 check=True, capture_output=True, text=True)
             os.chmod(tmp, 0o755)
             os.replace(tmp, exe)
         except subprocess.CalledProcessError as e:
             raise NativeBuildError(
-                f"cpp worker demo build failed:\n{e.stderr}") from e
+                f"{exe_prefix} build failed:\n{e.stderr}") from e
         except OSError as e:
-            raise NativeBuildError(
-                f"cpp worker demo build failed: {e}") from e
+            raise NativeBuildError(f"{exe_prefix} build failed: {e}") from e
         return exe
+
+
+def build_cpp_worker_demo() -> str:
+    """Build the C++ worker-API demo driver (``cpp_worker.cc``): the
+    cross-language client that joins a cluster, round-trips the KV and
+    invokes Python named functions with JSON args."""
+    return _build_proto_binary("cpp_worker.cc", "raytpu_cpp_demo",
+                               ["-DRAYTPU_CPP_DEMO_MAIN"])
 
 
 def build_state_service() -> str:
     """Build the C++ state-service binary (protoc gen + g++ + libprotobuf);
     returns the executable path. Cached until sources change."""
-    proto_dir = os.path.normpath(
-        os.path.join(_DIR, os.pardir, "protocol"))
-    proto = os.path.join(proto_dir, "raytpu.proto")
-    src = os.path.join(_DIR, "state_service.cc")
-    gen_dir = os.path.join(_DIR, "gen")
-    pb_cc = os.path.join(gen_dir, "raytpu.pb.cc")
-    exe = os.path.join(_DIR, f"raytpu_state_service{_artifact_suffix()}")
-    with _LOCK:
-        try:
-            src_mtime = max(os.path.getmtime(src), os.path.getmtime(proto))
-            if os.path.exists(exe) and os.path.getmtime(exe) >= src_mtime:
-                return exe
-            os.makedirs(gen_dir, exist_ok=True)
-            if (not os.path.exists(pb_cc)
-                    or os.path.getmtime(pb_cc) < os.path.getmtime(proto)):
-                subprocess.run(
-                    ["protoc", f"--proto_path={proto_dir}",
-                     f"--cpp_out={gen_dir}", proto],
-                    check=True, capture_output=True, text=True)
-            # Unique tmp name: concurrent builders (parallel test workers)
-            # must not interleave writes into one file.
-            import tempfile
-            fd, tmp = tempfile.mkstemp(prefix="raytpu_state_service_",
-                                       dir=_DIR)
-            os.close(fd)
-            subprocess.run(
-                ["g++", "-O2", "-std=c++17", *_sanitize_flags(),
-                 "-o", tmp, src, pb_cc,
-                 f"-I{_DIR}", "-lprotobuf", "-lpthread"],
-                check=True, capture_output=True, text=True)
-            os.chmod(tmp, 0o755)
-            os.replace(tmp, exe)
-        except subprocess.CalledProcessError as e:
-            raise NativeBuildError(
-                f"state service build failed:\n{e.stderr}") from e
-        except OSError as e:
-            raise NativeBuildError(
-                f"state service build failed: {e}") from e
-        return exe
+    return _build_proto_binary("state_service.cc", "raytpu_state_service",
+                               [])

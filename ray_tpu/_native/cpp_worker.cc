@@ -203,22 +203,21 @@ class RayTpuClient {
   // error (message from the daemon's language-neutral error_message).
   std::string SubmitTask(const std::string& function_name,
                          const std::string& args_json) {
-    // pick an alive daemon
-    std::string daemon_addr;
-    for (const auto& n : ListNodes()) {
-      if (n.alive() && !n.address().empty() && !n.is_head()) {
-        daemon_addr = n.address();
-        break;
-      }
-    }
-    if (daemon_addr.empty())
-      for (const auto& n : ListNodes())
-        if (n.alive() && !n.address().empty()) daemon_addr = n.address();
-    if (daemon_addr.empty())
+    // One node-list fetch; prefer non-head daemons, fall back to any.
+    // "spillback" is a routine scheduling reply (the daemon's resources
+    // are momentarily busy), not a failure: rotate through candidate
+    // daemons like the Python client does.
+    auto nodes = ListNodes();
+    std::vector<std::string> candidates;
+    for (const auto& n : nodes)
+      if (n.alive() && !n.address().empty() && !n.is_head())
+        candidates.push_back(n.address());
+    for (const auto& n : nodes)
+      if (n.alive() && !n.address().empty() && n.is_head())
+        candidates.push_back(n.address());
+    if (candidates.empty())
       throw std::runtime_error("no alive daemons in the cluster");
 
-    auto hp = SplitAddr(daemon_addr);
-    Connection daemon(hp.host, hp.port, token_);
     raytpu::TaskSpecMsg spec;
     std::string task_id = RandomBytes(16);
     spec.set_task_id(task_id);
@@ -234,16 +233,30 @@ class RayTpuClient {
     (*spec.mutable_resources()->mutable_amounts())["CPU"] = 1.0;
     std::string body;
     spec.SerializeToString(&body);
-    raytpu::Envelope rep = daemon.Call(raytpu::PUSH_TASK, body);
-    raytpu::PushTaskReply out;
-    out.ParseFromString(rep.body());
-    if (out.status() != "ok")
-      throw std::runtime_error("task not admitted: " + out.status());
-    if (!out.error_message().empty())
-      throw std::runtime_error("task failed: " + out.error_message());
-    if (out.inline_results_size() > 0 && out.inline_(0))
-      return out.inline_results(0);
-    throw std::runtime_error("no inline result (json_results expected)");
+
+    const int kRounds = 20;  // ~10s of retries over a busy cluster
+    for (int attempt = 0; attempt < kRounds; ++attempt) {
+      const std::string& daemon_addr =
+          candidates[attempt % candidates.size()];
+      auto hp = SplitAddr(daemon_addr);
+      Connection daemon(hp.host, hp.port, token_);
+      raytpu::Envelope rep = daemon.Call(raytpu::PUSH_TASK, body);
+      raytpu::PushTaskReply out;
+      out.ParseFromString(rep.body());
+      if (out.status() == "spillback") {
+        usleep(500 * 1000);
+        continue;
+      }
+      if (out.status() != "ok")
+        throw std::runtime_error("task not admitted: " + out.status());
+      if (!out.error_message().empty())
+        throw std::runtime_error("task failed: " + out.error_message());
+      if (out.inline_results_size() > 0 && out.inline_(0))
+        return out.inline_results(0);
+      throw std::runtime_error("no inline result (json_results expected)");
+    }
+    throw std::runtime_error("cluster busy: task spilled back "
+                             "repeatedly");
   }
 
  private:

@@ -1846,6 +1846,8 @@ class DistributedRuntime(Runtime):
             self._handle_fetch_object(ctx)
         elif method == pb.PUSH_OBJECT:
             self._handle_push_object(ctx)
+        elif method == pb.GET_TIMELINE:
+            self._handle_get_timeline(ctx)
         elif method == pb.RESERVE_BUNDLE:
             req = pb.BundleRequest()
             req.ParseFromString(ctx.body)
@@ -2097,7 +2099,11 @@ class DistributedRuntime(Runtime):
                         # an unserializable result must surface as an
                         # error, not linger unreachable in the store
                         try:
-                            payload = json.dumps(value).encode()
+                            # allow_nan=False: Python would emit the
+                            # non-standard NaN/Infinity tokens, which
+                            # strict parsers in other languages reject
+                            payload = json.dumps(
+                                value, allow_nan=False).encode()
                         except (TypeError, ValueError):
                             rep.error_message = (
                                 f"task result of type "
@@ -2252,6 +2258,59 @@ class DistributedRuntime(Runtime):
             while len(self._fetch_cache) > 8:
                 self._fetch_cache.pop(next(iter(self._fetch_cache)))
         return payload
+
+    def _handle_get_timeline(self, ctx: RpcContext):
+        """Span-buffer fetch/control (cross-process trace propagation:
+        the driver's ``ray_tpu.timeline()`` merges every daemon's spans
+        into one chrome-tracing file, the reference's ``ray timeline``
+        over GCS-aggregated profile events)."""
+        from ray_tpu._private.profiling import get_profiler
+        req = pb.TimelineRequest()
+        req.ParseFromString(ctx.body)
+        if req.set_enabled:
+            _config.set("profiling_enabled", bool(req.enabled))
+        prof = get_profiler()
+        spans = prof.chrome_trace()
+        if req.clear:
+            prof.clear()
+        ctx.reply(pb.TimelineReply(
+            spans_json=json.dumps(spans).encode()).SerializeToString())
+
+    def set_cluster_profiling(self, enabled: bool) -> None:
+        """Flip profiling on the driver AND every alive daemon."""
+        _config.set("profiling_enabled", bool(enabled))
+        for addr in self._alive_daemon_addrs():
+            try:
+                self.pool.get(addr).call(
+                    pb.GET_TIMELINE, pb.TimelineRequest(
+                        set_enabled=True,
+                        enabled=bool(enabled)).SerializeToString(),
+                    timeout=10)
+            except Exception:
+                pass
+
+    def cluster_timeline(self) -> list:
+        """Local spans + every alive daemon's (distinct pids per node)."""
+        from ray_tpu._private.profiling import get_profiler
+        spans = list(get_profiler().chrome_trace())
+        for addr in self._alive_daemon_addrs():
+            try:
+                rep = pb.TimelineReply()
+                rep.ParseFromString(self.pool.get(addr).call(
+                    pb.GET_TIMELINE,
+                    pb.TimelineRequest().SerializeToString(),
+                    timeout=30).body)
+                spans.extend(json.loads(bytes(rep.spans_json).decode()))
+            except Exception:
+                pass
+        return spans
+
+    def _alive_daemon_addrs(self) -> List[str]:
+        with self._view_lock:
+            return [a for nid, a in self._addr_by_node.items()
+                    if a and a != self.address
+                    and (self._view.get(nid) is None
+                         or self._view[nid].alive)]
 
     def _handle_push_object(self, ctx: RpcContext):
         """Receiver half of the push path: chunks accumulate per object;

@@ -281,9 +281,31 @@ def nodes() -> List[dict]:
 def timeline(filename: Optional[str] = None):
     """Chrome-tracing dump of task/actor spans (reference: ``ray timeline``
     CLI ``scripts.py:1755`` → ``GlobalState.chrome_tracing_dump``
-    ``state.py:419``)."""
+    ``state.py:419``). On a cluster, spans from EVERY daemon process are
+    merged (cross-process trace propagation)."""
+    rt = try_global_runtime()
+    cluster_fetch = getattr(rt, "cluster_timeline", None)
+    if cluster_fetch is not None:
+        import json as _json
+        trace = cluster_fetch()
+        if filename is None:
+            return trace
+        with open(filename, "w") as f:
+            _json.dump(trace, f)
+        return filename
     from ray_tpu._private.profiling import dump_timeline
     return dump_timeline(filename)
+
+
+def set_profiling_enabled(enabled: bool) -> None:
+    """Switch span recording on/off — cluster-wide when connected (the
+    daemons' buffers feed ``timeline()``)."""
+    rt = try_global_runtime()
+    cluster_set = getattr(rt, "set_cluster_profiling", None)
+    if cluster_set is not None:
+        cluster_set(enabled)
+        return
+    _config.set("profiling_enabled", bool(enabled))
 
 
 def register_named_function(name: str, fn=None):

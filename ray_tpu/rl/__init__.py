@@ -26,6 +26,7 @@ from ray_tpu.rl.offline import (BC, BCConfig, CQL, CQLConfig,
                                 collect_dataset, read_dataset,
                                 write_dataset)
 from ray_tpu.rl.sac import SAC, SACConfig
+from ray_tpu.rl.td3 import TD3, TD3Config
 from ray_tpu.rl.replay_buffer import (PrioritizedReplayBuffer, ReplayBuffer)
 from ray_tpu.rl.rollout_worker import (RolloutWorker, WorkerSet,
                                        synchronous_parallel_sample)
@@ -36,7 +37,7 @@ __all__ = [
     "RolloutWorker", "WorkerSet", "synchronous_parallel_sample",
     "ReplayBuffer", "PrioritizedReplayBuffer",
     "PPO", "PPOConfig", "DQN", "DQNConfig", "Impala", "ImpalaConfig",
-    "SAC", "SACConfig",
+    "SAC", "SACConfig", "TD3", "TD3Config",
     "BC", "BCConfig", "CQL", "CQLConfig",
     "collect_dataset", "read_dataset", "write_dataset",
     "MultiAgentEnv", "MultiAgentBatch", "MultiAgentRolloutWorker",

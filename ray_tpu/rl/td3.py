@@ -1,0 +1,290 @@
+"""Twin Delayed DDPG (TD3) for continuous control.
+
+Parity with ``rllib/algorithms/td3`` (DDPG with the three TD3 fixes:
+twin critics with min-target, target policy smoothing, delayed policy
+updates). Shares SAC's runtime shape (``sac.py``): replay-driven
+training with the critic/actor/target updates fused into one jitted
+step — the delayed actor update is a ``lax.cond`` inside the program,
+not a host-side branch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl import models as _models
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.env import Box
+from ray_tpu.rl.policy import Policy
+from ray_tpu.rl.replay_buffer import ReplayBuffer
+from ray_tpu.rl.rollout_worker import synchronous_parallel_sample
+from ray_tpu.rl.sac import _squash
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+class DeterministicPolicy(Policy):
+    """tanh-squashed deterministic actor with additive Gaussian
+    exploration noise (DDPG/TD3 behavior policy)."""
+
+    def __init__(self, spec, config=None, seed: int = 0):
+        self.spec = spec
+        self.config = dict(config or {})
+        if not isinstance(spec.action_space, Box):
+            raise ValueError("TD3 requires a continuous (Box) action space")
+        self.continuous = True
+        obs_dim = int(np.prod(spec.observation_space.shape))
+        self.action_dim = int(np.prod(spec.action_space.shape))
+        hidden = tuple(self.config.get("fcnet_hiddens", (256, 256)))
+        lo = np.broadcast_to(np.asarray(spec.action_space.low,
+                                        np.float32).reshape(-1),
+                             (self.action_dim,))
+        hi = np.broadcast_to(np.asarray(spec.action_space.high,
+                                        np.float32).reshape(-1),
+                             (self.action_dim,))
+        self._scale = jnp.asarray((hi - lo) / 2.0, jnp.float32)
+        self._center = jnp.asarray((hi + lo) / 2.0, jnp.float32)
+        self.explore_noise = float(self.config.get("explore_noise", 0.1))
+        self.params = {"actor": _models.mlp_init(
+            jax.random.key(seed), obs_dim, hidden, self.action_dim,
+            out_scale=0.01)}
+        self._rng = jax.random.key(seed + 1)
+        scale, center = self._scale, self._center
+        noise_std = self.explore_noise
+
+        def _act(params, rng, obs, explore):
+            u = _models.mlp_apply(params["actor"], obs, activation="relu")
+            a = _squash(u, scale, center)
+            noise = noise_std * scale * jax.random.normal(rng, a.shape)
+            lo_b, hi_b = center - scale, center + scale
+            noisy = jnp.clip(a + noise, lo_b, hi_b)
+            return jnp.where(explore, noisy, a)
+
+        self._act = jax.jit(_act)
+
+    def compute_actions(self, obs, explore: bool = True):
+        self._rng, key = jax.random.split(self._rng)
+        actions = self._act(self.params, key,
+                            jnp.asarray(obs, jnp.float32),
+                            jnp.asarray(explore))
+        n = len(np.asarray(actions))
+        zeros = np.zeros(n, np.float32)
+        return np.asarray(actions), zeros, zeros
+
+    def value(self, obs):
+        return np.zeros(len(np.asarray(obs)), np.float32)
+
+
+class TD3Config(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or TD3)
+        # original-paper values (lr 1e-3); tau doubled because targets
+        # advance only on delayed (every policy_delay-th) steps here
+        self.lr = 1e-3
+        self.tau = 0.01
+        self.policy_delay = 2          # critic steps per actor step
+        self.target_noise = 0.2        # target policy smoothing std
+        self.target_noise_clip = 0.5
+        self.explore_noise = 0.1
+        self.train_batch_size = 256
+        self.replay_buffer_capacity = 100_000
+        self.num_steps_sampled_before_learning_starts = 500
+        self.n_updates_per_iter = 16
+        self.rollout_fragment_length = 8
+        self.grad_clip = 40.0
+        self.model = {"fcnet_hiddens": (256, 256)}
+
+
+class TD3Learner:
+    """Twin critics + delayed deterministic actor, one jitted step."""
+
+    def __init__(self, actor_params, obs_dim: int, action_dim: int,
+                 scale: np.ndarray, center: np.ndarray, cfg: TD3Config):
+        self.cfg = cfg
+        hidden = tuple(cfg.model.get("fcnet_hiddens", (256, 256)))
+        kq1, kq2 = jax.random.split(jax.random.key(cfg.seed + 17), 2)
+        q_in = obs_dim + action_dim
+        self.cparams = {
+            "q1": _models.mlp_init(kq1, q_in, hidden, 1, out_scale=1.0),
+            "q2": _models.mlp_init(kq2, q_in, hidden, 1, out_scale=1.0),
+        }
+        self.aparams = {"actor": jax.tree_util.tree_map(
+            jnp.asarray, actor_params["actor"])}
+        self.target = jax.tree_util.tree_map(
+            jnp.array, {**self.cparams, **self.aparams})
+        # separate optimizers: the delayed actor update must not advance
+        # any optimizer state on critic-only steps (a shared Adam would
+        # keep moving the actor on decayed momentum)
+        self.critic_opt = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip), optax.adam(cfg.lr))
+        self.actor_opt = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip), optax.adam(cfg.lr))
+        self.copt_state = self.critic_opt.init(self.cparams)
+        self.aopt_state = self.actor_opt.init(self.aparams)
+        self.rng = jax.random.key(cfg.seed + 5077)
+        self._step_count = 0
+        gamma, tau = cfg.gamma, cfg.tau
+        tn, tn_clip = cfg.target_noise, cfg.target_noise_clip
+        scale_a = jnp.asarray(scale, jnp.float32)
+        center_a = jnp.asarray(center, jnp.float32)
+
+        def q_apply(qp, obs, act):
+            return _models.mlp_apply(
+                qp, jnp.concatenate([obs, act], axis=-1),
+                activation="relu")[..., 0]
+
+        def actor_apply(ap, obs):
+            return _squash(
+                _models.mlp_apply(ap, obs, activation="relu"),
+                scale_a, center_a)
+
+        def update(cparams, aparams, target, copt, aopt, rng, batch,
+                   do_actor: bool):
+            # ``do_actor`` is STATIC: two compiled variants — the
+            # critic-only one never touches actor params, actor optimizer
+            # state, or targets (TD3's delayed update, exactly)
+            obs = batch[SampleBatch.OBS]
+            acts = batch[SampleBatch.ACTIONS]
+            rews = batch[SampleBatch.REWARDS]
+            nxt = batch[SampleBatch.NEXT_OBS]
+            not_done = 1.0 - batch[SampleBatch.TERMINATEDS].astype(
+                jnp.float32)
+            rng, knoise = jax.random.split(rng)
+            # target policy smoothing: clipped noise on the target action
+            ta = actor_apply(target["actor"], nxt)
+            noise = jnp.clip(
+                tn * scale_a * jax.random.normal(knoise, ta.shape),
+                -tn_clip * scale_a, tn_clip * scale_a)
+            lo_b, hi_b = center_a - scale_a, center_a + scale_a
+            ta = jnp.clip(ta + noise, lo_b, hi_b)
+            tq = jnp.minimum(q_apply(target["q1"], nxt, ta),
+                             q_apply(target["q2"], nxt, ta))
+            y = rews + gamma * not_done * jax.lax.stop_gradient(tq)
+
+            def critic_loss_fn(cp):
+                q1 = q_apply(cp["q1"], obs, acts)
+                q2 = q_apply(cp["q2"], obs, acts)
+                loss = jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2)
+                return loss, jnp.mean(q1)
+
+            (closs, q_mean), cgrads = jax.value_and_grad(
+                critic_loss_fn, has_aux=True)(cparams)
+            cupdates, copt = self.critic_opt.update(cgrads, copt, cparams)
+            cparams = optax.apply_updates(cparams, cupdates)
+            aloss = jnp.zeros(())
+            if do_actor:
+                def actor_loss_fn(ap):
+                    pi_a = actor_apply(ap["actor"], obs)
+                    return -jnp.mean(q_apply(cparams["q1"], obs, pi_a))
+
+                aloss, agrads = jax.value_and_grad(actor_loss_fn)(aparams)
+                aupdates, aopt = self.actor_opt.update(agrads, aopt,
+                                                       aparams)
+                aparams = optax.apply_updates(aparams, aupdates)
+                # targets advance only on delayed steps (original TD3)
+                target = jax.tree_util.tree_map(
+                    lambda t, o: (1 - tau) * t + tau * o, target,
+                    {**cparams, **aparams})
+            aux = {"critic_loss": closs, "actor_loss": aloss,
+                   "q_mean": q_mean}
+            return cparams, aparams, target, copt, aopt, rng, aux
+
+        self._update = jax.jit(update, static_argnums=(7,),
+                               donate_argnums=(0, 1, 2, 3, 4))
+        self._delay = cfg.policy_delay
+
+    def train(self, batch: SampleBatch) -> Dict[str, float]:
+        self._step_count += 1
+        do_actor = self._step_count % self._delay == 0
+        arrays = {k: jnp.asarray(np.asarray(v)) for k, v in batch.items()
+                  if k in (SampleBatch.OBS, SampleBatch.ACTIONS,
+                           SampleBatch.REWARDS, SampleBatch.NEXT_OBS,
+                           SampleBatch.TERMINATEDS)}
+        (self.cparams, self.aparams, self.target, self.copt_state,
+         self.aopt_state, self.rng, aux) = self._update(
+            self.cparams, self.aparams, self.target, self.copt_state,
+            self.aopt_state, self.rng, arrays, do_actor)
+        return {k: float(v) for k, v in aux.items()}
+
+    def actor_weights(self):
+        return {"actor": jax.device_get(self.aparams["actor"])}
+
+    def state(self):
+        return jax.device_get((self.cparams, self.aparams, self.target,
+                               self.copt_state, self.aopt_state,
+                               self._step_count))
+
+    def set_state(self, state):
+        cp, ap, t, co, ao, c = state
+        self.cparams = jax.tree_util.tree_map(jnp.asarray, cp)
+        self.aparams = jax.tree_util.tree_map(jnp.asarray, ap)
+        self.target = jax.tree_util.tree_map(jnp.asarray, t)
+        self.copt_state = jax.tree_util.tree_map(jnp.asarray, co)
+        self.aopt_state = jax.tree_util.tree_map(jnp.asarray, ao)
+        self._step_count = c
+
+
+class TD3(Algorithm):
+    _config_cls = TD3Config
+
+    @classmethod
+    def get_default_config(cls) -> TD3Config:
+        return TD3Config(cls)
+
+    def _needs_advantages(self) -> bool:
+        return False
+
+    def _worker_kwargs(self):
+        kw = super()._worker_kwargs()
+        kw["policy_cls"] = DeterministicPolicy
+        cfg = dict(kw.get("policy_config") or {})
+        cfg.setdefault("explore_noise", self.algo_config.explore_noise)
+        kw["policy_config"] = cfg
+        return kw
+
+    def _make_learner(self) -> TD3Learner:
+        cfg = self.algo_config
+        lw = self.workers.local_worker
+        spec = lw.get_spec()
+        self.replay = ReplayBuffer(cfg.replay_buffer_capacity,
+                                   seed=cfg.seed)
+        obs_dim = int(np.prod(spec.observation_space.shape))
+        action_dim = int(np.prod(spec.action_space.shape))
+        pol = lw.policy
+        return TD3Learner(lw.get_weights(), obs_dim, action_dim,
+                          np.asarray(pol._scale), np.asarray(pol._center),
+                          cfg)
+
+    def training_step(self) -> Dict[str, Any]:
+        from ray_tpu.rl.postprocessing import add_next_obs
+        cfg = self.algo_config
+        self.workers.sync_weights()
+        batch = synchronous_parallel_sample(self.workers, max_env_steps=1)
+        batch = add_next_obs(batch)
+        self.replay.add(batch)
+        self._timesteps_total += len(batch)
+        metrics: Dict[str, Any] = {"timesteps_this_iter": len(batch)}
+        if (self._timesteps_total
+                < cfg.num_steps_sampled_before_learning_starts):
+            metrics["learning"] = False
+            return metrics
+        auxes = []
+        for _ in range(cfg.n_updates_per_iter):
+            auxes.append(self.learner.train(
+                self.replay.sample(cfg.train_batch_size)))
+        self.workers.local_worker.set_weights(self.learner.actor_weights())
+        metrics.update(learning=True, replay_size=len(self.replay),
+                       **{k: float(np.mean([a[k] for a in auxes]))
+                          for k in auxes[-1]})
+        return metrics
+
+    def _learner_state(self):
+        return {"learner": self.learner.state()}
+
+    def _set_learner_state(self, state):
+        if state:
+            self.learner.set_state(state["learner"])

@@ -14,8 +14,9 @@ from ray_tpu.tune.sample import (choice, grid_search, lograndint, loguniform,
 from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
                                      HyperBandScheduler, MedianStoppingRule,
                                      PopulationBasedTraining, TrialScheduler)
-from ray_tpu.tune.search import (BasicVariantGenerator, ConcurrencyLimiter,
-                                 Repeater, Searcher)
+from ray_tpu.tune.search import (BasicVariantGenerator, BayesOptSearch,
+                                 ConcurrencyLimiter, HyperOptSearch,
+                                 OptunaSearch, Repeater, Searcher)
 from ray_tpu.tune.tpe import TPESearcher
 from ray_tpu.tune.session import get_checkpoint, get_trial_id, report
 from ray_tpu.tune.trainable import FunctionTrainable, Trainable, wrap_function
@@ -30,6 +31,6 @@ __all__ = [
     "FIFOScheduler", "AsyncHyperBandScheduler", "HyperBandScheduler",
     "MedianStoppingRule", "PopulationBasedTraining", "TrialScheduler",
     "BasicVariantGenerator", "ConcurrencyLimiter", "Repeater", "Searcher",
-    "TPESearcher",
+    "TPESearcher", "OptunaSearch", "HyperOptSearch", "BayesOptSearch",
     "ExperimentAnalysis", "ResultGrid",
 ]

@@ -187,3 +187,32 @@ class Repeater(Searcher):
             avg = sum(self._group_results[gid]) / self.repeat
             self.searcher.on_trial_complete(
                 trial_id, {metric: avg} if metric else None, error)
+
+
+def _external_searcher(lib_name: str, cls_name: str):
+    """Import-gated adapter factory (reference: ``tune/search/optuna``,
+    ``hyperopt``, ``bayesopt`` adapters). The external libraries are not
+    in this image; the native ``TPESearcher`` covers the Bayesian-search
+    role without them."""
+
+    class _Adapter(Searcher):
+        def __init__(self, *a, **kw):
+            try:
+                __import__(lib_name)
+            except ImportError as e:
+                raise ImportError(
+                    f"{cls_name} needs the '{lib_name}' package, which is "
+                    f"not installed. ray_tpu ships a dependency-free "
+                    f"Bayesian searcher with the same role: "
+                    f"ray_tpu.tune.TPESearcher") from e
+            raise NotImplementedError(
+                f"{cls_name}: external-library adapters are stubs in this "
+                f"build; use ray_tpu.tune.TPESearcher")
+
+    _Adapter.__name__ = _Adapter.__qualname__ = cls_name
+    return _Adapter
+
+
+OptunaSearch = _external_searcher("optuna", "OptunaSearch")
+HyperOptSearch = _external_searcher("hyperopt", "HyperOptSearch")
+BayesOptSearch = _external_searcher("bayes_opt", "BayesOptSearch")

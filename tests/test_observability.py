@@ -236,3 +236,32 @@ def test_state_server_and_cli(capsys):
         assert json.loads(capsys.readouterr().out) == []
     finally:
         ray_tpu.shutdown()
+
+
+def test_cluster_timeline_merges_daemon_spans():
+    """Cross-process trace propagation: timeline() on a cluster merges
+    spans recorded inside daemon processes (reference: `ray timeline`
+    over GCS-aggregated profile events)."""
+    from ray_tpu.cluster_utils import ProcessCluster
+    ray_tpu.shutdown()
+    c = ProcessCluster(num_daemons=2, num_cpus=2)
+    try:
+        ray_tpu.init(address=c.address)
+        ray_tpu.set_profiling_enabled(True)
+
+        @ray_tpu.remote
+        def traced(x):
+            return x + 1
+
+        assert ray_tpu.get([traced.remote(i) for i in range(8)],
+                           timeout=60) == list(range(1, 9))
+        trace = ray_tpu.timeline()
+        task_spans = [s for s in trace
+                      if s.get("name", "").endswith(".traced")]
+        assert len(task_spans) == 8, trace[:3]
+        # spans come from the DAEMON processes (driver runs nothing)
+        assert all(s["pid"].startswith("node:") for s in task_spans)
+        ray_tpu.set_profiling_enabled(False)
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()

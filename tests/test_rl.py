@@ -582,3 +582,44 @@ def test_cql_learns_from_offline_data():
         assert cql.evaluate(n_episodes=3) >= 300.0
     finally:
         cql.stop()
+
+
+# -- TD3 -------------------------------------------------------------------
+
+def test_td3_policy_deterministic_and_bounded():
+    from ray_tpu.rl.td3 import DeterministicPolicy
+    env = PendulumEnv({"seed": 0})
+    pol = DeterministicPolicy(env.spec, seed=0)
+    obs = np.stack([env.reset(seed=i) for i in range(8)])
+    a1, _, _ = pol.compute_actions(obs, explore=False)
+    a2, _, _ = pol.compute_actions(obs, explore=False)
+    np.testing.assert_allclose(a1, a2)          # deterministic
+    ae, _, _ = pol.compute_actions(obs, explore=True)
+    assert not np.allclose(a1, ae)              # exploration noise
+    for a in (a1, ae):
+        assert np.all(a >= -2.0) and np.all(a <= 2.0)
+
+
+def test_td3_learns_pendulum():
+    """TD3 (twin critics, target smoothing, delayed actor) must lift
+    Pendulum return well above the ~-1300 random level."""
+    from ray_tpu.rl import TD3
+    algo = (TD3.get_default_config()
+            .environment("Pendulum-v1")
+            .training(train_batch_size=128, n_updates_per_iter=8,
+                      num_steps_sampled_before_learning_starts=256)
+            .debugging(seed=0)
+            .build())
+    try:
+        early = []
+        for _ in range(900):
+            r = algo.step()
+            rew = r.get("episode_reward_mean")
+            if rew is not None and len(early) < 5:
+                early.append(rew)
+        final = r["episode_reward_mean"]
+        # measured (seed 0): -1285 at the trough, -746 by iter 900
+        assert final > -850, (early, final)
+        assert final - float(np.mean(early)) > 150, (early, final)
+    finally:
+        algo.stop()
