@@ -92,19 +92,45 @@ def bench_put_get(prefix: str):
 
 
 def bench_remote_fetch(prefix: str, mb: int = 32):
-    """Cross-daemon object pull: a large result produced on a daemon,
-    fetched by the driver over FETCH_OBJECT chunks."""
+    """Cross-daemon object pull, both transfer planes: the shared host
+    arena (fd-passed memfd pages, zero-copy decode) and chunked TCP
+    (the cross-host path / fallback)."""
     import ray_tpu
 
     @ray_tpu.remote
     def produce():
         return np.zeros((mb, 1024, 1024), np.uint8)
 
-    ray_tpu.get(produce.remote(), timeout=120)  # warm
-    t0 = time.perf_counter()
-    out = ray_tpu.get(produce.remote(), timeout=120)
-    el = time.perf_counter() - t0
-    emit(f"{prefix}_remote_fetch_gbps", out.nbytes / el / 1e9, "GB/s")
+    rt = ray_tpu._private.worker.global_worker().runtime
+    ref = produce.remote()
+    warm = ray_tpu.get(ref, timeout=120)
+    nbytes = warm.nbytes
+    del warm
+
+    def measure():
+        # re-fetch the SAME sealed object (producer keeps the primary
+        # copy): timing covers the transfer plane only, not the task
+        rates = []
+        for _ in range(3):
+            rt.local_node.store.free(ref.id())
+            rt._location_hints.pop(ref.id(), None)
+            t0 = time.perf_counter()
+            out = ray_tpu.get(ref, timeout=120)
+            el = time.perf_counter() - t0
+            del out
+            rates.append(nbytes / el / 1e9)
+        return sorted(rates)[1]
+
+    arena = getattr(rt, "host_arena", None)
+    if arena is not None:
+        emit(f"{prefix}_remote_fetch_shm_gbps", measure(), "GB/s")
+        rt.host_arena = None  # force the TCP plane
+        try:
+            emit(f"{prefix}_remote_fetch_tcp_gbps", measure(), "GB/s")
+        finally:
+            rt.host_arena = arena
+    else:
+        emit(f"{prefix}_remote_fetch_gbps", measure(), "GB/s")
 
 
 def run_inproc():

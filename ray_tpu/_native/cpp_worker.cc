@@ -244,7 +244,9 @@ class RayTpuClient {
       raytpu::PushTaskReply out;
       out.ParseFromString(rep.body());
       if (out.status() == "spillback") {
-        usleep(500 * 1000);
+        // rotate to the next daemon immediately; sleep only after a
+        // full fruitless round through every candidate
+        if ((attempt + 1) % candidates.size() == 0) usleep(500 * 1000);
         continue;
       }
       if (out.status() != "ok")

@@ -2268,7 +2268,12 @@ class DistributedRuntime(Runtime):
         req = pb.TimelineRequest()
         req.ParseFromString(ctx.body)
         if req.set_enabled:
+            # pure toggle: the caller discards the reply — don't JSON a
+            # potentially multi-MB span buffer for nothing
             _config.set("profiling_enabled", bool(req.enabled))
+            ctx.reply(pb.TimelineReply(
+                spans_json=b"[]").SerializeToString())
+            return
         prof = get_profiler()
         spans = prof.chrome_trace()
         if req.clear:
@@ -2306,11 +2311,13 @@ class DistributedRuntime(Runtime):
         return spans
 
     def _alive_daemon_addrs(self) -> List[str]:
+        # membership in the CURRENT view is required: _addr_by_node is an
+        # append-only address cache, and treating its stale entries as
+        # alive would aim RPCs (with long timeouts) at dead daemons
         with self._view_lock:
             return [a for nid, a in self._addr_by_node.items()
                     if a and a != self.address
-                    and (self._view.get(nid) is None
-                         or self._view[nid].alive)]
+                    and nid in self._view and self._view[nid].alive]
 
     def _handle_push_object(self, ctx: RpcContext):
         """Receiver half of the push path: chunks accumulate per object;

@@ -9,6 +9,7 @@ sharded over the mesh's data axis and XLA inserts the gradient ``psum`` —
 the tower logic is a sharding annotation, not an engine.
 """
 
+from ray_tpu.rl.a2c import A2C, A2CConfig
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rl.dqn import DQN, DQNConfig
 from ray_tpu.rl.env import (CartPoleEnv, EnvSpec, PendulumEnv, VectorEnv,
@@ -36,7 +37,8 @@ __all__ = [
     "Algorithm", "AlgorithmConfig", "Policy", "SampleBatch", "concat_samples",
     "RolloutWorker", "WorkerSet", "synchronous_parallel_sample",
     "ReplayBuffer", "PrioritizedReplayBuffer",
-    "PPO", "PPOConfig", "DQN", "DQNConfig", "Impala", "ImpalaConfig",
+    "PPO", "PPOConfig", "A2C", "A2CConfig", "DQN", "DQNConfig",
+    "Impala", "ImpalaConfig",
     "SAC", "SACConfig", "TD3", "TD3Config",
     "BC", "BCConfig", "CQL", "CQLConfig",
     "collect_dataset", "read_dataset", "write_dataset",

@@ -197,17 +197,21 @@ def _external_searcher(lib_name: str, cls_name: str):
 
     class _Adapter(Searcher):
         def __init__(self, *a, **kw):
+            # Honest in BOTH branches: the adapter is a stub regardless
+            # of whether the library is installed — never send the user
+            # off to pip-install something that won't help.
+            hint = ("ray_tpu ships a dependency-free Bayesian searcher "
+                    "with the same role: ray_tpu.tune.TPESearcher")
             try:
                 __import__(lib_name)
             except ImportError as e:
                 raise ImportError(
-                    f"{cls_name} needs the '{lib_name}' package, which is "
-                    f"not installed. ray_tpu ships a dependency-free "
-                    f"Bayesian searcher with the same role: "
-                    f"ray_tpu.tune.TPESearcher") from e
+                    f"{cls_name} is an adapter stub in this build and the "
+                    f"'{lib_name}' package is not installed anyway. "
+                    f"{hint}") from e
             raise NotImplementedError(
-                f"{cls_name}: external-library adapters are stubs in this "
-                f"build; use ray_tpu.tune.TPESearcher")
+                f"{cls_name} is an adapter stub in this build (the "
+                f"'{lib_name}' package is present but not wired). {hint}")
 
     _Adapter.__name__ = _Adapter.__qualname__ = cls_name
     return _Adapter

@@ -623,3 +623,25 @@ def test_td3_learns_pendulum():
         assert final - float(np.mean(early)) > 150, (early, final)
     finally:
         algo.stop()
+
+
+def test_a2c_learns_cartpole():
+    """A2C (single-pass vanilla PG with baseline) improves CartPole —
+    the PPO program evaluated at its ratio=1 fixed point."""
+    from ray_tpu.rl import A2C
+    algo = (A2C.get_default_config()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=0, num_envs_per_worker=8,
+                      rollout_fragment_length=25)
+            .debugging(seed=0).build())
+    try:
+        first = None
+        for _ in range(200):
+            r = algo.train()
+            if first is None and "episode_reward_mean" in r:
+                first = r["episode_reward_mean"]
+        final = r["episode_reward_mean"]
+        assert final > 100, (first, final)   # measured: 16 -> 164 (seed 0)
+        assert final > first + 50
+    finally:
+        algo.stop()
