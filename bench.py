@@ -26,6 +26,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 import json
+import os
 import time
 
 import jax
@@ -159,26 +160,43 @@ def bench_ppo():
     The RL analogue of the reference's tuned-example throughput tracking
     (``rllib/tuned_examples/ppo/``): in-repo CartPole over 8 vector envs,
     whole sgd schedule compiled as one XLA program (``rl/ppo.py``).
+
+    Runs in a CPU-pinned SUBPROCESS: the RL design is CPU rollout actors
+    feeding a compiled learner, and per-env-step policy dispatch through
+    the axon TPU relay would measure tunnel latency, not the framework
+    (~25 ms/step observed).
     """
-    from ray_tpu.rl import PPO
-    algo = (PPO.get_default_config()
-            .environment("CartPole-v1")
-            .rollouts(num_rollout_workers=0, num_envs_per_worker=8,
-                      rollout_fragment_length=100)
-            .training(train_batch_size=800, sgd_minibatch_size=256,
-                      num_sgd_iter=8, lr=3e-4)
-            .debugging(seed=0)
-            .build())
-    try:
-        algo.step()  # warmup: compiles the train program
-        t0 = time.perf_counter()
-        steps = 0
-        for _ in range(3):
-            r = algo.step()
-            steps += r.get("timesteps_this_iter", 0)
-        return steps / (time.perf_counter() - t0)
-    finally:
-        algo.stop()
+    import subprocess
+    import sys
+    code = r"""
+import time
+import jax
+jax.config.update("jax_platforms", "cpu")
+from ray_tpu.rl import PPO
+algo = (PPO.get_default_config()
+        .environment("CartPole-v1")
+        .rollouts(num_rollout_workers=0, num_envs_per_worker=8,
+                  rollout_fragment_length=100)
+        .training(train_batch_size=800, sgd_minibatch_size=256,
+                  num_sgd_iter=8, lr=3e-4)
+        .debugging(seed=0)
+        .build())
+algo.step()  # warmup: compiles the train program
+t0 = time.perf_counter()
+steps = 0
+for _ in range(3):
+    r = algo.step()
+    steps += r.get("timesteps_this_iter", 0)
+print("PPO_SPS", steps / (time.perf_counter() - t0))
+algo.stop()
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=600)
+    for line in proc.stdout.splitlines():
+        if line.startswith("PPO_SPS"):
+            return float(line.split()[1])
+    raise RuntimeError(f"ppo bench failed: {proc.stderr[-300:]}")
 
 
 def _wait_for_backend(retries: int = 6, delay_s: float = 30.0):

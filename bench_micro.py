@@ -124,11 +124,15 @@ def bench_remote_fetch(prefix: str, mb: int = 32):
     arena = getattr(rt, "host_arena", None)
     if arena is not None:
         emit(f"{prefix}_remote_fetch_shm_gbps", measure(), "GB/s")
-        rt.host_arena = None  # force the TCP plane
+        # force the TCP plane: clear BOTH the client handle and the key —
+        # a lingering key would still negotiate in_arena and pay an extra
+        # miss round-trip the real cross-host path never executes
+        saved_key = rt.host_arena_key
+        rt.host_arena, rt.host_arena_key = None, ""
         try:
             emit(f"{prefix}_remote_fetch_tcp_gbps", measure(), "GB/s")
         finally:
-            rt.host_arena = arena
+            rt.host_arena, rt.host_arena_key = arena, saved_key
     else:
         emit(f"{prefix}_remote_fetch_gbps", measure(), "GB/s")
 

@@ -23,6 +23,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <chrono>
 #include <cstdint>
 #include <cstring>
 #include <memory>
@@ -234,8 +235,13 @@ class RayTpuClient {
     std::string body;
     spec.SerializeToString(&body);
 
-    const int kRounds = 20;  // ~10s of retries over a busy cluster
-    for (int attempt = 0; attempt < kRounds; ++attempt) {
+    // time-based budget: rotate immediately within a round, sleep 100ms
+    // after each fruitless full round, give up after ~10s wall clock
+    // regardless of the candidate count
+    const auto deadline =
+        std::chrono::steady_clock::now() + std::chrono::seconds(10);
+    for (size_t attempt = 0;
+         std::chrono::steady_clock::now() < deadline; ++attempt) {
       const std::string& daemon_addr =
           candidates[attempt % candidates.size()];
       auto hp = SplitAddr(daemon_addr);
@@ -244,9 +250,7 @@ class RayTpuClient {
       raytpu::PushTaskReply out;
       out.ParseFromString(rep.body());
       if (out.status() == "spillback") {
-        // rotate to the next daemon immediately; sleep only after a
-        // full fruitless round through every candidate
-        if ((attempt + 1) % candidates.size() == 0) usleep(500 * 1000);
+        if ((attempt + 1) % candidates.size() == 0) usleep(100 * 1000);
         continue;
       }
       if (out.status() != "ok")

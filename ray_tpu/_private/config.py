@@ -110,6 +110,10 @@ _config.define("heartbeat_interval_ms", int, 100, "node heartbeat period")
 _config.define("num_heartbeats_timeout", int, 30, "missed heartbeats before a node is dead")
 _config.define("health_check_period_ms", int, 1000, "actor health check period")
 
+_config.define("daemon_admission_queue_limit", int, 1000,
+               "pending tasks a daemon accepts before spilling back "
+               "(backpressure: one daemon must not absorb the cluster)")
+
 # -- Host-shared object plane ---------------------------------------------------
 _config.define("arena_enabled", bool, True,
                "share one shm arena per host between daemons (fd-passing)")

@@ -1963,10 +1963,18 @@ class DistributedRuntime(Runtime):
         """Could this request EVER fit here (totals, not availability)?"""
         return resources.is_subset_of(self.local_node.resources.total)
 
-    def _spillback_reply(self, ctx: RpcContext):
+    def _spillback_reply(self, ctx: RpcContext, saturated: bool = False):
+        """``saturated``: admission-queue spillback. The raw resource
+        snapshot would not explain the rejection (CPUs may be free), and
+        advertising it makes the caller re-select this daemon in a hot
+        loop — advertise ZERO availability instead, so the caller's view
+        deprioritizes us until the next heartbeat refresh (~0.5s), a
+        natural backoff."""
         rep = pb.PushTaskReply(status="spillback")
-        for k, v in self.local_node.resources.available.to_dict().items():
-            rep.available.amounts[k] = v
+        if not saturated:
+            for k, v in (self.local_node.resources.available
+                         .to_dict().items()):
+                rep.available.amounts[k] = v
         ctx.reply(rep.SerializeToString())
 
     def _dedupe_pushed_task(self, ctx: RpcContext, msg: pb.TaskSpecMsg
@@ -2031,6 +2039,15 @@ class DistributedRuntime(Runtime):
             return
         if not self._admission_check(spec.options.resources):
             self._spillback_reply(ctx)
+            return
+        # Bounded admission (push_manager/backpressure half of the
+        # reference's lease policy): a daemon whose pending queue is deep
+        # spills back instead of absorbing unbounded work — the caller's
+        # scheduler re-routes or retries with its grace period.
+        with self._pending_cv:
+            depth = len(self._pending) + self._dispatch_pass_n
+        if depth >= _config.get("daemon_admission_queue_limit"):
+            self._spillback_reply(ctx, saturated=True)
             return
         with self.lock:
             self.completion_hooks.setdefault(spec.task_id, []).append(

@@ -220,6 +220,10 @@ class Runtime:
 
         # Pending queue of tasks waiting for resources / dependencies.
         self._pending: List[dict] = []
+        # items the dispatcher is CURRENTLY iterating (it swaps _pending
+        # to a local list per pass); admission depth checks must count
+        # both, or the cap is porous exactly when the backlog is deepest
+        self._dispatch_pass_n = 0
         self._pending_cv = threading.Condition()
         self._dispatch_dirty = False  # kick arrived while loop was busy
         # Per-task completion hooks, fired once when a task reaches a final
@@ -464,6 +468,7 @@ class Runtime:
                 if not self._pending:
                     self._pending_cv.wait(timeout=0.05)
                 pending, self._pending = self._pending, []
+                self._dispatch_pass_n = len(pending)
             still_waiting = []
             for item in pending:
                 try:
@@ -508,6 +513,7 @@ class Runtime:
             if still_waiting:
                 with self._pending_cv:
                     self._pending.extend(still_waiting)
+                    self._dispatch_pass_n = 0
                     # Event-driven backoff: a seal/submit kick wakes the
                     # loop immediately instead of paying a fixed sleep per
                     # dependency-chain hop; the dirty flag covers kicks
@@ -515,6 +521,9 @@ class Runtime:
                     if not self._dispatch_dirty:
                         self._pending_cv.wait(timeout=0.02)
                     self._dispatch_dirty = False
+            else:
+                with self._pending_cv:
+                    self._dispatch_pass_n = 0
 
     def _kick(self):
         with self._pending_cv:

@@ -319,3 +319,27 @@ def test_push_path_streams_object_to_peer():
         from ray_tpu._private.config import _config
         _config.set("arena_enabled", True)
         _config.set("object_push_threshold_bytes", 256 * 1024)
+
+
+def test_daemon_admission_backpressure_liveness():
+    """A daemon with a tiny admission queue spills back instead of
+    absorbing unbounded work — and the submitter's retry machinery still
+    completes everything (liveness under backpressure)."""
+    ray_tpu.shutdown()
+    os.environ["RAY_TPU_DAEMON_ADMISSION_QUEUE_LIMIT"] = "4"
+    c = ProcessCluster(num_daemons=2, num_cpus=2)
+    try:
+        ray_tpu.init(address=c.address)
+
+        @ray_tpu.remote
+        def slowish(i):
+            time.sleep(0.05)
+            return i
+
+        refs = [slowish.remote(i) for i in range(60)]
+        out = ray_tpu.get(refs, timeout=120)
+        assert out == list(range(60))
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+        os.environ.pop("RAY_TPU_DAEMON_ADMISSION_QUEUE_LIMIT", None)
