@@ -7,7 +7,8 @@ device arrays directly onto a mesh.
 """
 
 from ray_tpu.data.block import BlockAccessor
-from ray_tpu.data.dataset import (ActorPoolStrategy, Dataset, GroupedData,
+from ray_tpu.data.dataset import (ActorPoolStrategy, DataIterator,
+                                  Dataset, GroupedData,
                                   TaskPoolStrategy)
 from ray_tpu.data.dataset_pipeline import DatasetPipeline
 from ray_tpu.data.read_api import (from_arrow, from_items, from_numpy,
@@ -16,7 +17,7 @@ from ray_tpu.data.read_api import (from_arrow, from_items, from_numpy,
                                    read_numpy, read_parquet, read_text)
 
 __all__ = [
-    "Dataset", "DatasetPipeline", "GroupedData", "BlockAccessor",
+    "Dataset", "DataIterator", "DatasetPipeline", "GroupedData", "BlockAccessor",
     "ActorPoolStrategy", "TaskPoolStrategy",
     "from_items", "from_pandas", "from_arrow", "from_numpy",
     "range", "range_table", "read_csv", "read_parquet", "read_json",

@@ -404,21 +404,34 @@ class Dataset:
                      batch_format: str = "default",
                      drop_last: bool = False,
                      local_shuffle_buffer_size: Optional[int] = None,
-                     local_shuffle_seed: Optional[int] = None
+                     local_shuffle_seed: Optional[int] = None,
+                     prefetch_batches: int = 0
                      ) -> Iterator[Any]:
+        """``prefetch_batches > 0`` prepares that many batches ahead on a
+        background thread (reference ``iter_batches(prefetch_batches=)``):
+        host-side batch assembly overlaps the consumer's device step — the
+        input-pipeline overlap that keeps a TPU step from waiting on
+        pandas."""
         fmt = "pandas" if batch_format == "default" else batch_format
-        rows_iter = self.iter_rows()
-        if local_shuffle_buffer_size:
-            rows_iter = _shuffling_iterator(
-                rows_iter, local_shuffle_buffer_size, local_shuffle_seed)
-        while True:
-            chunk = list(itertools.islice(rows_iter, batch_size or 256))
-            if not chunk:
-                return
-            if drop_last and batch_size and len(chunk) < batch_size:
-                return
-            block = _rows_to_block(chunk)
-            yield BlockAccessor.for_block(block).to_batch(fmt)
+
+        def gen():
+            rows_iter = self.iter_rows()
+            if local_shuffle_buffer_size:
+                rows_iter = _shuffling_iterator(
+                    rows_iter, local_shuffle_buffer_size,
+                    local_shuffle_seed)
+            while True:
+                chunk = list(itertools.islice(rows_iter, batch_size or 256))
+                if not chunk:
+                    return
+                if drop_last and batch_size and len(chunk) < batch_size:
+                    return
+                block = _rows_to_block(chunk)
+                yield BlockAccessor.for_block(block).to_batch(fmt)
+
+        if prefetch_batches > 0:
+            return _prefetching_iterator(gen(), prefetch_batches)
+        return gen()
 
     def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
                            drop_last: bool = False, **kw) -> Iterator[Any]:
@@ -490,21 +503,79 @@ class Dataset:
     def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
         refs = self._execute()
         if equal:
+            # row counts differ by at most 1 across shards: a worker group
+            # running per-batch collectives over its shards must not have
+            # one member running extra rounds (a silent distributed hang)
             total = self.count()
-            per = total // n
-            idx = [per * (i + 1) for i in range(n - 1)]
-            return self.split_at_indices(idx)
+            sizes = [total // n + (1 if i < total % n else 0)
+                     for i in range(n)]
+            cuts = []
+            acc = 0
+            for s in sizes[:-1]:
+                acc += s
+                cuts.append(acc)
+            return self.split_at_indices(cuts)
         out: List[List] = [[] for _ in range(n)]
         for i, r in enumerate(refs):
             out[i % n].append(r)
         return [Dataset(refs) for refs in out]
 
+    def streaming_split(self, n: int, *, equal: bool = False
+                        ) -> List["DataIterator"]:
+        """``n`` iterators that partition this dataset for concurrent
+        consumers (reference ``Dataset.streaming_split`` feeding Train
+        workers). Blocks are assigned round-robin up front (this engine's
+        plans are materialized-block based, not a streaming executor);
+        ``equal=True`` rebalances by rows instead."""
+        return [DataIterator(shard) for shard in self.split(n, equal=equal)]
+
+    def iterator(self) -> "DataIterator":
+        """A single-consumer ``DataIterator`` over the whole dataset
+        (reference ``Dataset.iterator``)."""
+        return DataIterator(self)
+
     def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
-        rows = self.take_all()
-        bounds = [0] + list(indices) + [len(rows)]
-        out = []
+        """Blocks are assigned to output shards by cumulative row count and
+        sliced IN PLACE (remote per-block tasks) where a cut falls inside
+        a block — the driver never materializes rows, so splitting scales
+        to datasets larger than driver memory."""
+        refs = self._execute()
+
+        @ray_tpu.remote
+        def _block_rows(block) -> int:
+            return BlockAccessor.for_block(block).num_rows()
+
+        @ray_tpu.remote
+        def _block_slice(block, a: int, b: int):
+            return BlockAccessor.for_block(block).slice(a, b)
+
+        counts = ray_tpu.get([_block_rows.remote(r) for r in refs])
+        total = sum(counts)
+        bounds = [0] + sorted(int(i) for i in indices) + [total]
+        out: List[List] = []
+        block_i, offset = 0, 0  # offset: rows of block_i already consumed
         for a, b in zip(bounds[:-1], bounds[1:]):
-            out.append(Dataset([ray_tpu.put(_rows_to_block(rows[a:b]))]))
+            want = b - a
+            shard_refs: List = []
+            while want > 0 and block_i < len(refs):
+                avail = counts[block_i] - offset
+                if avail <= 0:
+                    block_i += 1
+                    offset = 0
+                    continue
+                take = min(want, avail)
+                if offset == 0 and take == counts[block_i]:
+                    shard_refs.append(refs[block_i])  # whole block, no copy
+                else:
+                    shard_refs.append(_block_slice.remote(
+                        refs[block_i], offset, offset + take))
+                offset += take
+                want -= take
+                if offset >= counts[block_i]:
+                    block_i += 1
+                    offset = 0
+            out.append(Dataset(shard_refs
+                               or [ray_tpu.put(_rows_to_block([]))]))
         return out
 
     def train_test_split(self, test_size: float,
@@ -716,6 +787,88 @@ class GroupedData:
 # --------------------------------------------------------------------------- #
 # helpers
 # --------------------------------------------------------------------------- #
+
+
+class DataIterator:
+    """Consumer-facing iteration handle over one dataset shard
+    (reference ``ray.data.DataIterator``, what ``streaming_split``
+    hands each Train worker)."""
+
+    def __init__(self, ds: "Dataset"):
+        self._ds = ds
+
+    def iter_batches(self, **kw) -> Iterator[Any]:
+        return self._ds.iter_batches(**kw)
+
+    def iter_torch_batches(self, **kw) -> Iterator[Any]:
+        return self._ds.iter_torch_batches(**kw)
+
+    def iter_jax_batches(self, **kw) -> Iterator[Any]:
+        return self._ds.iter_jax_batches(**kw)
+
+    def iter_rows(self) -> Iterator[Any]:
+        return self._ds.iter_rows()
+
+    def materialize(self) -> "Dataset":
+        return self._ds.materialize()
+
+    def count(self) -> int:
+        return self._ds.count()
+
+    def __repr__(self):
+        return f"DataIterator({self._ds!r})"
+
+
+def _prefetching_iterator(it: Iterator, n: int) -> Iterator:
+    """Run ``it`` on a daemon thread, buffering up to ``n`` items ahead.
+
+    Producer exceptions re-raise at the consumer's next pull. A consumer
+    that ABANDONS the iterator early (break / close / GC) releases the
+    producer: the generator's finally sets a stop flag and drains one
+    slot, so the thread never stays parked on a full queue holding the
+    buffered blocks alive."""
+    import queue as _queue
+    import threading
+
+    q: "_queue.Queue" = _queue.Queue(maxsize=max(1, n))
+    stop = threading.Event()
+    _END = object()
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def fill():
+        try:
+            for item in it:
+                if not _put((None, item)):
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised at consumer
+            _put((e, None))
+            return
+        _put((None, _END))
+
+    threading.Thread(target=fill, daemon=True,
+                     name="data-prefetch").start()
+    try:
+        while True:
+            err, item = q.get()
+            if err is not None:
+                raise err
+            if item is _END:
+                return
+            yield item
+    finally:
+        stop.set()
+        try:
+            q.get_nowait()  # free a blocked producer immediately
+        except _queue.Empty:
+            pass
 
 
 def _rows_to_block(rows: List[Any]):

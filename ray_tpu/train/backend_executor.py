@@ -35,7 +35,8 @@ class RayTrainWorker:
         self.thread = None
 
     def start_training(self, train_loop: Callable, config: Dict[str, Any],
-                       checkpoint=None, group_name: Optional[str] = None):
+                       checkpoint=None, group_name: Optional[str] = None,
+                       dataset_shards=None):
         from ray_tpu.train import session as session_mod
         mesh = None
         try:
@@ -71,7 +72,8 @@ class RayTrainWorker:
         self.session = session_mod._init_session(
             world_rank=self.rank, world_size=self.world_size,
             checkpoint=checkpoint, mesh=mesh, config=config,
-            collective_group_name=group_name)
+            collective_group_name=group_name,
+            dataset_shards=dataset_shards)
         sess = self.session
         # Collective groups and task context are thread-local; hand the actor
         # thread's bindings to the training-loop thread.
@@ -175,12 +177,13 @@ class BackendExecutor:
                 backend=self.collective_backend, group_name=self.group_name)
 
     def start_training(self, train_loop: Callable, config: Dict[str, Any],
-                       checkpoint=None):
+                       checkpoint=None, dataset_shards=None):
         self._finished = set()
         ray_tpu.get([
-            w.start_training.remote(train_loop, config, checkpoint,
-                                    self.group_name)
-            for w in self.workers])
+            w.start_training.remote(
+                train_loop, config, checkpoint, self.group_name,
+                dataset_shards[i] if dataset_shards else None)
+            for i, w in enumerate(self.workers)])
 
     def get_next_results(self, timeout: Optional[float] = None):
         """One result per still-running worker, or None once all finished.

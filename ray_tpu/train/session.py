@@ -19,7 +19,9 @@ class _TrainSession:
     def __init__(self, world_rank: int, world_size: int,
                  local_rank: int = 0,
                  checkpoint=None, mesh=None, config=None,
-                 collective_group_name: Optional[str] = None):
+                 collective_group_name: Optional[str] = None,
+                 dataset_shards=None):
+        self.dataset_shards = dataset_shards or {}
         self.world_rank = world_rank
         self.world_size = world_size
         self.local_rank = local_rank
@@ -63,6 +65,15 @@ def report(metrics: Dict[str, Any], checkpoint=None) -> None:
 def get_checkpoint():
     s = _get_session()
     return s.checkpoint if s else None
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's split of ``JaxTrainer(datasets={name: ds})`` — a
+    ``DataIterator`` (reference ``session.get_dataset_shard``)."""
+    s = _get_session()
+    if s is None or name not in s.dataset_shards:
+        return None
+    return s.dataset_shards[name]
 
 
 def get_world_rank() -> int:

@@ -30,7 +30,8 @@ class JaxTrainer:
                  run_config: Optional[RunConfig] = None,
                  collective_backend: Optional[str] = "xla",
                  resume_from_checkpoint: Optional[Checkpoint] = None,
-                 results_timeout_s: Optional[float] = None):
+                 results_timeout_s: Optional[float] = None,
+                 datasets: Optional[Dict[str, Any]] = None):
         self._train_loop = train_loop_per_worker
         self._config = train_loop_config or {}
         self.scaling_config = scaling_config or ScalingConfig()
@@ -38,6 +39,20 @@ class JaxTrainer:
         self._collective_backend = collective_backend
         self._resume_from = resume_from_checkpoint
         self._results_timeout_s = results_timeout_s
+        # name -> ray_tpu.data.Dataset; each is streaming_split across the
+        # worker group and handed out via session.get_dataset_shard
+        # (reference: DataParallelTrainer datasets= + DataConfig)
+        self._datasets = datasets or {}
+
+    def _dataset_shards(self):
+        if not self._datasets:
+            return None
+        n = self.scaling_config.num_workers
+        shard_sets: list = [{} for _ in range(n)]
+        for name, ds in self._datasets.items():
+            for i, it in enumerate(ds.streaming_split(n, equal=True)):
+                shard_sets[i][name] = it
+        return shard_sets
 
     def fit(self) -> Result:
         if not ray_tpu.is_initialized():
@@ -58,7 +73,8 @@ class JaxTrainer:
             try:
                 executor.start()
                 executor.start_training(self._train_loop, self._config,
-                                        checkpoint)
+                                        checkpoint,
+                                        dataset_shards=self._dataset_shards())
                 while True:
                     round_results = executor.get_next_results()
                     if round_results is None:

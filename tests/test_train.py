@@ -165,3 +165,31 @@ def test_trainer_persists_checkpoints_with_pruning(ray_start_regular,
     restored = Checkpoint.from_directory(
         str(tmp_path / "exp" / kept[-1])).to_dict()
     assert restored["epoch"] == 4
+
+
+def test_trainer_dataset_shards(ray_start_regular):
+    """datasets= splits across the worker group; each worker reads its own
+    shard via session.get_dataset_shard (DataParallelTrainer contract)."""
+    import ray_tpu.data as rd
+
+    ds = rd.from_items([{"x": float(i)} for i in range(40)])
+
+    def loop(config):
+        shard = session.get_dataset_shard("train")
+        total, rows = 0.0, 0
+        for batch in shard.iter_batches(batch_size=8, batch_format="numpy",
+                                        prefetch_batches=1):
+            total += float(batch["x"].sum())
+            rows += len(batch["x"])
+        session.report({"total": total, "rows": rows})
+
+    result = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2),
+        collective_backend=None,
+        datasets={"train": ds}).fit()
+    assert result.error is None
+    rows = [m["rows"] for m in result.metrics_history]
+    totals = [m["total"] for m in result.metrics_history]
+    assert sum(rows) == 40          # full partition, no overlap/loss
+    assert abs(max(rows) - min(rows)) <= 1
+    assert sum(totals) == float(sum(range(40)))
