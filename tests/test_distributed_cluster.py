@@ -343,3 +343,21 @@ def test_daemon_admission_backpressure_liveness():
         ray_tpu.shutdown()
         c.shutdown()
         os.environ.pop("RAY_TPU_DAEMON_ADMISSION_QUEUE_LIMIT", None)
+
+
+def test_arena_owner_death_degrades_to_tcp(cluster):
+    """SIGKILL the arena owner (first daemon): same-host transfers must
+    degrade to the TCP plane and the cluster keeps serving objects."""
+    rt = ray_tpu._private.worker.global_worker().runtime
+    if rt.host_arena is None:
+        pytest.skip("native arena unavailable")
+
+    @ray_tpu.remote(max_retries=2)
+    def produce(i):
+        return np.full((300, 300), float(i))
+
+    assert float(ray_tpu.get(produce.remote(1), timeout=60)[0, 0]) == 1.0
+    cluster.kill_daemon(0)  # daemon 0 started first: owns the arena
+    time.sleep(4)           # NODE_DEAD
+    out = ray_tpu.get([produce.remote(i) for i in range(2, 6)], timeout=120)
+    assert [float(v[0, 0]) for v in out] == [2.0, 3.0, 4.0, 5.0]
