@@ -14,7 +14,8 @@ from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rl.dqn import DQN, DQNConfig
 from ray_tpu.rl.env import (CartPoleEnv, EnvSpec, PendulumEnv, VectorEnv,
                             make_env, register_env)
-from ray_tpu.rl.impala import Impala, ImpalaConfig
+from ray_tpu.rl.impala import (APPO, APPOConfig, Impala,
+                               ImpalaConfig)
 from ray_tpu.rl.policy import Policy
 from ray_tpu.rl.ppo import PPO, PPOConfig
 from ray_tpu.rl.multi_agent import (CoordinationGameEnv, MultiAgentBatch,
@@ -38,7 +39,7 @@ __all__ = [
     "RolloutWorker", "WorkerSet", "synchronous_parallel_sample",
     "ReplayBuffer", "PrioritizedReplayBuffer",
     "PPO", "PPOConfig", "A2C", "A2CConfig", "DQN", "DQNConfig",
-    "Impala", "ImpalaConfig",
+    "Impala", "ImpalaConfig", "APPO", "APPOConfig",
     "SAC", "SACConfig", "TD3", "TD3Config",
     "BC", "BCConfig", "CQL", "CQLConfig",
     "collect_dataset", "read_dataset", "write_dataset",

@@ -35,6 +35,10 @@ class ImpalaConfig(AlgorithmConfig):
         self.rollout_fragment_length = 50
         self.max_sample_requests_in_flight_per_worker = 2
         self.broadcast_interval = 1
+        # None = vanilla V-trace PG; a float enables APPO's clipped
+        # surrogate (declared here so .training(clip_param=) binds
+        # instead of falling into the extras dict)
+        self.clip_param = None
 
 
 def vtrace(behavior_logp, target_logp, rewards, values, bootstrap_value,
@@ -66,7 +70,12 @@ def vtrace(behavior_logp, target_logp, rewards, values, bootstrap_value,
 
 
 class ImpalaLearner:
-    def __init__(self, init_params, cfg: ImpalaConfig, continuous: bool):
+    """V-trace learner. ``clip_param`` switches the policy term from the
+    vanilla V-trace PG estimator to APPO's clipped surrogate over the
+    same V-trace advantages (``rllib/algorithms/appo``)."""
+
+    def __init__(self, init_params, cfg: ImpalaConfig, continuous: bool,
+                 clip_param: float = None):
         self.cfg = cfg
         self.optimizer = optax.chain(
             optax.clip_by_global_norm(cfg.grad_clip),
@@ -105,7 +114,16 @@ class ImpalaLearner:
                     jax.lax.stop_gradient(boot_values), discounts,
                     cfg.vtrace_clip_rho_threshold,
                     cfg.vtrace_clip_c_threshold)
-                pg_loss = -jnp.mean(target_logp * pg_adv)
+                if clip_param is not None:
+                    # APPO: PPO's clipped surrogate with the importance
+                    # ratio against the BEHAVIOR policy, advantages from
+                    # V-trace (off-policy corrected)
+                    ratio = jnp.exp(target_logp
+                                    - batch[SampleBatch.ACTION_LOGP])
+                    pg_loss = -jnp.mean(_models.clipped_surrogate(
+                        ratio, pg_adv, clip_param))
+                else:
+                    pg_loss = -jnp.mean(target_logp * pg_adv)
                 vf_loss = 0.5 * jnp.mean((vs - values) ** 2)
                 total = (pg_loss + cfg.vf_loss_coeff * vf_loss
                          - cfg.entropy_coeff * entropy)
@@ -151,7 +169,8 @@ class Impala(Algorithm):
         lw = self.workers.local_worker
         self._in_flight: Dict[Any, Any] = {}
         self._broadcast_countdown = 0
-        return ImpalaLearner(lw.get_weights(), cfg, lw.policy.continuous)
+        return ImpalaLearner(lw.get_weights(), cfg, lw.policy.continuous,
+                             clip_param=cfg.clip_param)
 
     def _to_time_major(self, batch: SampleBatch) -> Dict[str, np.ndarray]:
         T = self.algo_config.rollout_fragment_length
@@ -229,3 +248,25 @@ class Impala(Algorithm):
     def _set_learner_state(self, state):
         if state:
             self.learner.set_state(state["learner"])
+
+
+class APPOConfig(ImpalaConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or APPO)
+        self.clip_param = 0.3
+        self.lr = 3e-3          # rmsprop, small async batches
+        self.entropy_coeff = 0.005
+
+
+class APPO(Impala):
+    """Asynchronous PPO (``rllib/algorithms/appo``): IMPALA's
+    architecture — asynchronous rollout workers, V-trace off-policy
+    correction — with PPO's clipped surrogate as the policy objective.
+    Pure configuration of the IMPALA learner (the clipped term is a
+    branch inside the same compiled update)."""
+
+    _config_cls = APPOConfig
+
+    @classmethod
+    def get_default_config(cls) -> APPOConfig:
+        return APPOConfig(cls)

@@ -122,5 +122,14 @@ def make_distribution(params: Dict[str, Any], dist_inputs: jax.Array,
     return Categorical(dist_inputs)
 
 
+def clipped_surrogate(ratio: jax.Array, advantages: jax.Array,
+                      clip_param: float) -> jax.Array:
+    """PPO's pessimistic clipped objective, elementwise (shared by the
+    PPO and APPO learners so the two cannot drift)."""
+    return jnp.minimum(
+        ratio * advantages,
+        jnp.clip(ratio, 1 - clip_param, 1 + clip_param) * advantages)
+
+
 def num_params(params: Any) -> int:
     return int(sum(np.prod(p.shape) for p in jax.tree_util.tree_leaves(params)))

@@ -76,10 +76,8 @@ class PPOLearner:
             logp = dist.logp(batch[SampleBatch.ACTIONS])
             ratio = jnp.exp(logp - batch[SampleBatch.ACTION_LOGP])
             adv = batch[SampleBatch.ADVANTAGES]
-            surrogate = jnp.minimum(
-                ratio * adv,
-                jnp.clip(ratio, 1 - cfg.clip_param,
-                         1 + cfg.clip_param) * adv)
+            surrogate = _models.clipped_surrogate(ratio, adv,
+                                                  cfg.clip_param)
             targets = batch[SampleBatch.VALUE_TARGETS]
             vf_err = jnp.minimum((values - targets) ** 2,
                                  cfg.vf_clip_param ** 2)

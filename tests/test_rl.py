@@ -645,3 +645,26 @@ def test_a2c_learns_cartpole():
         assert final > first + 50
     finally:
         algo.stop()
+
+
+def test_appo_learns_cartpole(ray_start_regular):
+    """APPO: async workers + V-trace + PPO clipped surrogate improves
+    CartPole within a small budget."""
+    from ray_tpu.rl import APPO
+    algo = (APPO.get_default_config()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=4,
+                      rollout_fragment_length=50)
+            .debugging(seed=0).build())
+    try:
+        first = None
+        for _ in range(200):
+            r = algo.train()
+            if first is None and "episode_reward_mean" in r:
+                first = r["episode_reward_mean"]
+        final = r["episode_reward_mean"]
+        # measured (seed 0): 21.9 -> 159 over 200 async rounds
+        assert final > first + 40, (first, final)
+        assert final > 80, (first, final)
+    finally:
+        algo.stop()
