@@ -155,11 +155,35 @@ class ClientServer:
 
     @staticmethod
     def _restore_refs(session: _ClientSession, args, kwargs):
+        """RefMarkers can appear at ANY depth: ClientObjectRef.__reduce__
+        turns nested refs into markers wherever they sit, so restoration
+        must recurse through containers (a top-level-only pass would hand
+        the task a bare RefMarker)."""
         from ray_tpu.util.client.protocol import RefMarker
 
         def restore(v):
             if isinstance(v, RefMarker):
-                return session.refs[v.ref_id]
+                try:
+                    return session.refs[v.ref_id]
+                except KeyError:
+                    raise ValueError(
+                        f"client ref {v.ref_id[:8]} is unknown to this "
+                        f"session (freed or from another session)")
+            if isinstance(v, list):
+                return [restore(x) for x in v]
+            if isinstance(v, tuple):
+                items = [restore(x) for x in v]
+                if type(v) is tuple:
+                    return tuple(items)
+                # namedtuples and tuple subclasses keep their type
+                try:
+                    return type(v)(*items)
+                except TypeError:
+                    return type(v)(items)
+            if isinstance(v, dict):
+                return {restore(k): restore(x) for k, x in v.items()}
+            if isinstance(v, (set, frozenset)):
+                return type(v)(restore(x) for x in v)
             return v
 
         return (tuple(restore(a) for a in args),

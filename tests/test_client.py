@@ -132,3 +132,41 @@ def test_client_from_separate_process(ray_start_regular):
                          capture_output=True, text=True, timeout=120)
     assert "RESULT 42" in out.stdout, (out.stdout, out.stderr)
     server.stop()
+
+
+def test_client_nested_refs_in_containers(client_pair):
+    """Refs nested inside lists/dicts restore at any depth on the server
+    (regression: top-level-only restoration handed tasks bare markers)."""
+    api = client_pair
+    a = api.put(10)
+    b = api.put(32)
+
+    def add_nested(payload):
+        import ray_tpu
+        return ray_tpu.get(payload["left"]) + ray_tpu.get(
+            payload["rights"][0][0])
+
+    f = api.remote(add_nested)
+    out = api.get(f.remote({"left": a, "rights": [[b]]}), timeout=30)
+    assert out == 42
+
+
+def test_client_refs_in_exotic_containers(client_pair):
+    """Namedtuples keep their type; refs restore in dict keys and
+    frozensets too (regression trio from review)."""
+    import collections
+    api = client_pair
+    Point = collections.namedtuple("Point", "x y")
+    r = api.put(5)
+
+    def probe(pt, keyed, frozen):
+        import ray_tpu
+        assert type(pt).__name__ == "Point" and pt.x == 1
+        (ref_key, label), = keyed.items()
+        (f_ref,), = [tuple(frozen)]
+        return ray_tpu.get(ref_key) + ray_tpu.get(f_ref) + pt.y
+
+    f = api.remote(probe)
+    out = api.get(f.remote(Point(1, 2), {r: "lbl"}, frozenset({r})),
+                  timeout=30)
+    assert out == 12
