@@ -7,6 +7,7 @@ request carries an ``op`` and gets exactly one response frame:
 
 from __future__ import annotations
 
+import contextvars
 import socket
 import struct
 
@@ -14,14 +15,29 @@ import cloudpickle
 
 MAX_FRAME = 1 << 30
 
+# Server-side: set to a ``ref_id -> ObjectRef`` resolver around request
+# decoding, so markers are swapped for real refs DURING unpickling — at
+# any depth of any object graph (lists, dict keys, dataclass attributes,
+# ...), with no post-hoc container walk to keep complete.
+_RESTORE_RESOLVER: "contextvars.ContextVar" = contextvars.ContextVar(
+    "refmarker_resolver", default=None)
+
 
 class RefMarker:
     """Wire stand-in for a ClientObjectRef inside pickled args: carries
-    only the server-side ref id; the server swaps in the real ObjectRef."""
+    only the server-side ref id; the server swaps in the real ObjectRef
+    (at reconstruction time when ``_RESTORE_RESOLVER`` is set)."""
 
     __slots__ = ("ref_id",)
 
+    def __new__(cls, ref_id: str):
+        resolver = _RESTORE_RESOLVER.get()
+        if resolver is not None:
+            return resolver(ref_id)  # replaces the marker in-place
+        return super().__new__(cls)
+
     def __init__(self, ref_id: str):
+        # skipped automatically when __new__ returned a non-RefMarker
         self.ref_id = ref_id
 
 

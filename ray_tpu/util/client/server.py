@@ -38,11 +38,27 @@ class ClientServer:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                from ray_tpu.util.client.protocol import _RESTORE_RESOLVER
                 session = _ClientSession()
                 sock = self.request
+
+                def resolve(ref_id: str):
+                    try:
+                        return session.refs[ref_id]
+                    except KeyError:
+                        raise ValueError(
+                            f"client ref {ref_id[:8]} is unknown to this "
+                            f"session (freed or from another session)")
+
                 try:
                     while True:
-                        req = recv_msg(sock)
+                        # markers anywhere in the request swap for real
+                        # refs DURING unpickling (protocol.RefMarker)
+                        token = _RESTORE_RESOLVER.set(resolve)
+                        try:
+                            req = recv_msg(sock)
+                        finally:
+                            _RESTORE_RESOLVER.reset(token)
                         if req is None:
                             break
                         try:
@@ -100,9 +116,7 @@ class ClientServer:
             fn = session.functions[req["fn_id"]]
             if req.get("options"):
                 fn = fn.options(**req["options"])
-            args, kwargs = self._restore_refs(session, req["args"],
-                                              req["kwargs"])
-            out = fn.remote(*args, **kwargs)
+            out = fn.remote(*req["args"], **req["kwargs"])
             refs = out if isinstance(out, list) else [out]
             ids = self._track(session, refs)
             return ids if isinstance(out, list) else ids[0]
@@ -114,17 +128,14 @@ class ClientServer:
             cls = session.classes[req["cls_id"]]
             if req.get("options"):
                 cls = cls.options(**req["options"])
-            args, kwargs = self._restore_refs(session, req["args"],
-                                              req["kwargs"])
-            handle = cls.remote(*args, **kwargs)
+            handle = cls.remote(*req["args"], **req["kwargs"])
             actor_key = uuid.uuid4().hex
             session.actors[actor_key] = handle
             return actor_key
         if op == "actor_call":
             handle = session.actors[req["actor_key"]]
-            args, kwargs = self._restore_refs(session, req["args"],
-                                              req["kwargs"])
-            ref = getattr(handle, req["method"]).remote(*args, **kwargs)
+            ref = getattr(handle, req["method"]).remote(
+                *req["args"], **req["kwargs"])
             return self._track(session, [ref])[0]
         if op == "get_actor":
             handle = ray_tpu.get_actor(req["name"],
@@ -152,42 +163,6 @@ class ClientServer:
             session.refs[rid] = ref
             ids.append(rid)
         return ids
-
-    @staticmethod
-    def _restore_refs(session: _ClientSession, args, kwargs):
-        """RefMarkers can appear at ANY depth: ClientObjectRef.__reduce__
-        turns nested refs into markers wherever they sit, so restoration
-        must recurse through containers (a top-level-only pass would hand
-        the task a bare RefMarker)."""
-        from ray_tpu.util.client.protocol import RefMarker
-
-        def restore(v):
-            if isinstance(v, RefMarker):
-                try:
-                    return session.refs[v.ref_id]
-                except KeyError:
-                    raise ValueError(
-                        f"client ref {v.ref_id[:8]} is unknown to this "
-                        f"session (freed or from another session)")
-            if isinstance(v, list):
-                return [restore(x) for x in v]
-            if isinstance(v, tuple):
-                items = [restore(x) for x in v]
-                if type(v) is tuple:
-                    return tuple(items)
-                # namedtuples and tuple subclasses keep their type
-                try:
-                    return type(v)(*items)
-                except TypeError:
-                    return type(v)(items)
-            if isinstance(v, dict):
-                return {restore(k): restore(x) for k, x in v.items()}
-            if isinstance(v, (set, frozenset)):
-                return type(v)(restore(x) for x in v)
-            return v
-
-        return (tuple(restore(a) for a in args),
-                {k: restore(v) for k, v in kwargs.items()})
 
     def stop(self):
         self._server.shutdown()

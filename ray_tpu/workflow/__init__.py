@@ -1,11 +1,12 @@
 """ray_tpu.workflow — durable DAG execution (reference: python/ray/workflow/)."""
 
-from ray_tpu.workflow.api import (delete, get_output, get_status,  # noqa: F401
+from ray_tpu.workflow.api import (Continuation, continuation,  # noqa: F401
+                                  delete, get_output, get_status,
                                   init, list_all, resume, run, run_async,
                                   wait_for_event)
 from ray_tpu.workflow.executor import WorkflowExecutionError  # noqa: F401
 from ray_tpu.workflow.storage import WorkflowStorage  # noqa: F401
 
 __all__ = ["init", "run", "run_async", "resume", "get_status", "get_output",
-           "list_all", "delete", "wait_for_event", "WorkflowStorage",
+           "list_all", "delete", "wait_for_event", "continuation", "Continuation", "WorkflowStorage",
            "WorkflowExecutionError"]

@@ -134,6 +134,22 @@ def delete(workflow_id: str) -> None:
     WorkflowStorage(workflow_id, _base_dir).delete()
 
 
+class Continuation:
+    """Marker a step returns to hand execution to a sub-DAG (reference
+    ``workflow.continuation``): the sub-DAG's result replaces the step's
+    result, enabling recursive/dynamic workflows."""
+
+    def __init__(self, dag):
+        self.dag = dag
+
+
+def continuation(dag) -> Continuation:
+    """Wrap a ``.bind()`` DAG so returning it from a workflow step
+    CONTINUES the workflow with that DAG instead of finishing with the
+    node object itself."""
+    return Continuation(dag)
+
+
 def wait_for_event(poll_fn, *, poll_interval_s: float = 0.5,
                    timeout_s: Optional[float] = None):
     """Durable event task (reference ``event_listener.py``): returns a DAG

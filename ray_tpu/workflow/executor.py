@@ -85,11 +85,50 @@ class WorkflowExecutor:
         independent branches run concurrently on the cluster; results are
         then gathered and persisted in topological order. Crash-safety is
         unchanged — an unpersisted task is simply re-run on resume.
+
+        The workflow's ROOT step may return ``workflow.continuation(dag)``:
+        the sub-DAG runs in its place (the reference's dynamic-workflow
+        core, supporting recursive tail chains of unbounded length). The
+        chain is driven by a LOOP — one stack frame and one id segment
+        total, regardless of length — and every link's result (including
+        the continuation markers themselves) is persisted, so a resume
+        replays completed links and re-runs only the unfinished tail.
+        Non-root steps may not return continuations in this engine: their
+        dependents are submitted eagerly and would consume the marker.
         """
-        import ray_tpu
-        order = topo_order(root)
-        ids = assign_task_ids(root)
+        from ray_tpu.workflow.api import Continuation
         self.storage.save_status("RUNNING")
+        try:
+            result, top_id = self._run_level(root, prefix="")
+            depth = 0
+            while isinstance(result, Continuation):
+                result, _ = self._run_level(result.dag,
+                                            prefix=f"{top_id}/c{depth}/")
+                depth += 1
+            if depth:
+                # expose the chain's FINAL value under the root id so
+                # get_output/resume read a value, not a marker
+                self.storage.save_task_result(top_id, result)
+        except Exception as e:
+            self.storage.save_status("FAILED", error=repr(e))
+            raise WorkflowExecutionError(self.workflow_id, e) from e
+        except BaseException as e:
+            # KeyboardInterrupt/SystemExit: persist FAILED (resumable) but
+            # let the interrupt propagate unwrapped.
+            self.storage.save_status("FAILED", error=repr(e))
+            raise
+        self.storage.save_status("SUCCESS", root_task_id=top_id)
+        return result
+
+    def _run_level(self, root: dag_mod.DAGNode, prefix: str):
+        """One DAG level; returns (value, root_task_id). The root's value
+        may be a ``Continuation`` marker (persisted as such — a replayed
+        marker resumes the chain exactly where it left off); the caller's
+        loop drives the chain."""
+        import ray_tpu
+        from ray_tpu.workflow.api import Continuation
+        order = topo_order(root)
+        ids = {k: prefix + t for k, t in assign_task_ids(root).items()}
 
         refs: Dict[int, Any] = {}      # submitted this run
         memo: Dict[int, Any] = {}      # replayed from storage
@@ -100,39 +139,34 @@ class WorkflowExecutor:
                 return memo[k] if k in memo else refs[k]
             return v
 
-        try:
-            for node in order:
-                key = id(node)
-                task_id = ids[key]
-                if self.storage.has_task_result(task_id):
-                    logger.info("workflow %s: task %s replayed from storage",
-                                self.workflow_id, task_id)
-                    memo[key] = self.storage.load_task_result(task_id)
-                    continue
-                if not isinstance(node, dag_mod.FunctionNode):
-                    # InputNode included: workflows take no runtime input,
-                    # so an InputNode in the DAG is a user error.
+        for node in order:
+            key = id(node)
+            task_id = ids[key]
+            if self.storage.has_task_result(task_id):
+                logger.info("workflow %s: task %s replayed from storage",
+                            self.workflow_id, task_id)
+                memo[key] = self.storage.load_task_result(task_id)
+                continue
+            if not isinstance(node, dag_mod.FunctionNode):
+                # InputNode included: workflows take no runtime input,
+                # so an InputNode in the DAG is a user error.
+                raise TypeError(
+                    f"Workflows support function nodes, got "
+                    f"{type(node)}; wrap stateful steps in tasks")
+            args = tuple(resolve(a) for a in node._bound_args)
+            kwargs = {k: resolve(v)
+                      for k, v in node._bound_kwargs.items()}
+            refs[key] = node._remote_fn.remote(*args, **kwargs)
+        for node in order:
+            key = id(node)
+            if key in refs:
+                value = ray_tpu.get(refs[key])
+                if isinstance(value, Continuation) and node is not root:
                     raise TypeError(
-                        f"Workflows support function nodes, got "
-                        f"{type(node)}; wrap stateful steps in tasks")
-                args = tuple(resolve(a) for a in node._bound_args)
-                kwargs = {k: resolve(v)
-                          for k, v in node._bound_kwargs.items()}
-                refs[key] = node._remote_fn.remote(*args, **kwargs)
-            for node in order:
-                key = id(node)
-                if key in refs:
-                    value = ray_tpu.get(refs[key])
-                    self.storage.save_task_result(ids[key], value)
-                    memo[key] = value
-            result = memo[id(root)]
-        except Exception as e:
-            self.storage.save_status("FAILED", error=repr(e))
-            raise WorkflowExecutionError(self.workflow_id, e) from e
-        except BaseException as e:
-            # KeyboardInterrupt/SystemExit: persist FAILED (resumable) but
-            # let the interrupt propagate unwrapped.
-            self.storage.save_status("FAILED", error=repr(e))
-            raise
-        self.storage.save_status("SUCCESS", root_task_id=ids[id(root)])
-        return result
+                        f"step {ids[key]} returned a continuation but is "
+                        f"not the (sub-)workflow root; this engine "
+                        f"supports continuations only as the final step "
+                        f"of a DAG (tail recursion)")
+                self.storage.save_task_result(ids[key], value)
+                memo[key] = value
+        return memo[id(root)], ids[id(root)]

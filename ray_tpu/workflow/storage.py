@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import json
 import os
-import pickle
+import cloudpickle as pickle
 import tempfile
 import time
 from typing import Any, Dict, List, Optional
@@ -64,10 +64,14 @@ class WorkflowStorage:
     # -- task results ------------------------------------------------------
 
     def _task_path(self, task_id: str) -> str:
+        # continuation task ids are namespaced with "/" — they become
+        # nested directories under tasks_dir
         return os.path.join(self.tasks_dir, f"{task_id}.pkl")
 
     def save_task_result(self, task_id: str, result: Any) -> None:
-        _atomic_write(self._task_path(task_id), pickle.dumps(result))
+        path = self._task_path(task_id)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        _atomic_write(path, pickle.dumps(result))
 
     def has_task_result(self, task_id: str) -> bool:
         return os.path.exists(self._task_path(task_id))

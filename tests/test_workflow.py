@@ -143,3 +143,81 @@ def test_wait_for_event(wf):
 
     dag = add.bind(1, unpack.bind(ev))
     assert workflow.run(dag, workflow_id="w6") == 43
+
+
+# -- continuations ----------------------------------------------------------
+
+def test_workflow_continuation_recursive_factorial(wf):
+    """A step returning workflow.continuation(dag) hands execution to the
+    sub-DAG (the reference's dynamic-workflow core): recursive factorial."""
+    from ray_tpu import workflow
+
+    @ray_tpu.remote
+    def fact(n, acc):
+        if n <= 1:
+            return acc
+        return workflow.continuation(fact.bind(n - 1, acc * n))
+
+    assert workflow.run(fact.bind(5, 1), workflow_id="wf-cont") == 120
+    assert workflow.get_status("wf-cont") == "SUCCESS"
+    # replay: result comes from storage, steps are not re-run
+    assert workflow.resume("wf-cont") == 120
+
+
+def test_workflow_continuation_resume_midway(wf, tmp_path):
+    """Crash inside a continuation chain: resume replays persisted
+    sub-steps and completes the rest."""
+    from ray_tpu import workflow
+    import os
+    marker = str(tmp_path / "crashed")
+
+    @ray_tpu.remote
+    def countdown(n):
+        if n == 2 and not os.path.exists(marker):
+            open(marker, "w").close()
+            raise RuntimeError("boom at n=2")
+        if n <= 0:
+            return "done"
+        return workflow.continuation(countdown.bind(n - 1))
+
+    import pytest as _pytest
+    with _pytest.raises(Exception):
+        workflow.run(countdown.bind(4), workflow_id="wf-crash")
+    assert workflow.get_status("wf-crash") == "FAILED"
+    assert workflow.resume("wf-crash") == "done"
+    assert workflow.get_status("wf-crash") == "SUCCESS"
+
+
+def test_workflow_deep_continuation_chain(wf):
+    """1500 continuation links: the chain is loop-driven (one stack frame,
+    bounded id length) — the recursive form would blow the interpreter's
+    recursion limit (regression)."""
+    from ray_tpu import workflow
+
+    @ray_tpu.remote
+    def step(n):
+        if n <= 0:
+            return "bottom"
+        return workflow.continuation(step.bind(n - 1))
+
+    assert workflow.run(step.bind(1500), workflow_id="wf-deep") == "bottom"
+
+
+def test_workflow_nonroot_continuation_rejected(wf):
+    from ray_tpu import workflow
+
+    @ray_tpu.remote
+    def inner():
+        return workflow.continuation(leaf.bind())
+
+    @ray_tpu.remote
+    def leaf():
+        return 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return x
+
+    with pytest.raises(Exception) as ei:
+        workflow.run(outer.bind(inner.bind()), workflow_id="wf-nonroot")
+    assert "not the (sub-)workflow root" in str(ei.value)
