@@ -103,7 +103,20 @@ def init(num_cpus: Optional[float] = None,
             runtime = DistributedRuntime(
                 state_addr=address, resources=ResourceSet(amounts),
                 is_driver=True, namespace=namespace or "default")
-            _global = Worker(runtime, namespace or "default")
+            worker = Worker(runtime, namespace or "default")
+            if include_dashboard:
+                from ray_tpu.dashboard import start_dashboard
+                try:
+                    head = start_dashboard(address, port=dashboard_port)
+                except BaseException:
+                    # a failed dashboard must not leave a live runtime
+                    # behind a half-initialized worker (retrying init()
+                    # would then raise "called twice")
+                    runtime.shutdown()
+                    raise
+                worker.dashboard_head = head
+                worker.dashboard_port = head.port
+            _global = worker
             return _global
         runtime = Runtime()
         if _create_default_node:
@@ -135,7 +148,13 @@ def shutdown():
             pass
     with _global_lock:
         if _global is not None:
-            if getattr(_global, "dashboard_port", None) is not None:
+            head = getattr(_global, "dashboard_head", None)
+            if head is not None:
+                try:
+                    head.stop()
+                except Exception:
+                    pass
+            elif getattr(_global, "dashboard_port", None) is not None:
                 from ray_tpu._private.state_server import stop_state_server
                 stop_state_server()
             _global.runtime.shutdown()

@@ -81,3 +81,20 @@ def test_dashboard_actor_and_job_tables(cluster):
         assert "ray_tpu cluster" in page and "/api/cluster" in page
     finally:
         head.stop()
+
+
+def test_init_include_dashboard_on_cluster():
+    """init(address=..., include_dashboard=True) serves the full dashboard
+    head for cluster drivers (not just the local state server)."""
+    ray_tpu.shutdown()
+    c = ProcessCluster(num_daemons=1, num_cpus=2)
+    try:
+        w = ray_tpu.init(address=c.address, include_dashboard=True)
+        nodes = _get(w.dashboard_port, "/api/cluster")["nodes"]
+        assert any(n["alive"] for n in nodes)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{w.dashboard_port}/", timeout=10) as r:
+            assert "ray_tpu cluster" in r.read().decode()
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
