@@ -199,17 +199,35 @@ algo.stop()
     raise RuntimeError(f"ppo bench failed: {proc.stderr[-300:]}")
 
 
-def _wait_for_backend(retries: int = 6, delay_s: float = 30.0):
+def _wait_for_backend(retries: int = 10, delay_s: float = 60.0):
     """The axon TPU tunnel is transiently unavailable at times; retry
-    backend init rather than failing the whole bench run."""
+    backend init rather than failing the whole bench run. The probe runs
+    on a daemon thread with a timeout: a dead tunnel makes jax.devices()
+    BLOCK (not raise), and a hung probe must count as a failed attempt."""
+    import threading
+
+    def probe() -> bool:
+        out = [False]
+
+        def run():
+            try:
+                out[0] = len(jax.devices()) > 0
+            except Exception:
+                out[0] = False
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        t.join(timeout=45.0)
+        return out[0] and not t.is_alive()
+
     for attempt in range(retries):
-        try:
-            jax.devices()
+        if probe():
             return
-        except RuntimeError:
-            if attempt == retries - 1:
-                raise
-            time.sleep(delay_s)
+        if attempt == retries - 1:
+            raise RuntimeError(
+                "TPU backend unavailable after "
+                f"{retries} probes over ~{retries * delay_s / 60:.0f} min")
+        time.sleep(delay_s)
 
 
 def main():
