@@ -55,24 +55,119 @@ def start_state_service(port: int = 0, host: str = "127.0.0.1",
 
 
 class StateClient:
+    """GCS-fault-tolerant client: a state-service restart (new process,
+    journal-recovered tables) breaks the TCP connections — calls
+    transparently reconnect and retry once, and the pubsub connection
+    re-subscribes its channels, so daemons and drivers SURVIVE a state
+    service restart instead of wedging (the reference's GCS FT contract:
+    raylets reconnect and re-register, which the heartbeat loop's
+    unrecognized-node re-registration then completes)."""
+
     def __init__(self, address: str, auth_token=None):
         self.address = address
         self._auth_token = auth_token
         self._client = RpcClient(address, auth_token=auth_token)
+        self._client_lock = threading.Lock()
         self._sub_client: Optional[RpcClient] = None
-        self._sub_lock = threading.Lock()
+        self._sub_lock = threading.Lock()      # subscription connection
+        self._sub_channels: List[str] = []
+        # handlers have their OWN lock: _on_push runs on the subscription
+        # connection's reader thread, and blocking it on _sub_lock while a
+        # SUBSCRIBE call awaits its reply on that same thread would stall
+        # resubscription for the full call timeout
+        self._handlers_lock = threading.Lock()
         self._handlers: Dict[str, List[Callable[[pb.Event], None]]] = {}
+        self._closed = False
 
     # ------------------------------------------------------------------ core
 
-    def _call(self, method: int, msg=None, timeout: float = 30.0) -> bytes:
+    def _call(self, method: int, msg=None, timeout: float = 30.0,
+              retry: bool = True) -> bytes:
+        """``retry``: reconnect and re-send once on a connection error —
+        at-least-once semantics. The state service's mutating handlers
+        are upserts and its subscribers handle duplicate events
+        idempotently, so the retry is safe EXCEPT for compare-and-set
+        writes (``kv_put(overwrite=False)``), which pass retry=False: a
+        replayed CAS would misreport the original success as a loss."""
         body = msg.SerializeToString() if msg is not None else b""
-        return self._client.call(method, body, timeout=timeout).body
+        try:
+            return self._client.call(method, body, timeout=timeout).body
+        except RpcConnectionError:
+            if self._closed or not retry:
+                raise
+            self._reconnect()
+            return self._client.call(method, body, timeout=timeout).body
+
+    def _reconnect(self):
+        """Replace the dead request connection (single flight: concurrent
+        failers share one fresh connection) and revive pubsub."""
+        with self._client_lock:
+            if self._closed:
+                raise RpcConnectionError("state client closed")
+            try:
+                # another thread may have already reconnected: probe
+                self._client.call(pb.PING, b"", timeout=5.0)
+                return
+            except Exception:
+                pass
+            old = self._client
+            self._client = RpcClient(self.address,
+                                     auth_token=self._auth_token)
+            try:
+                old.close()
+            except Exception:
+                pass
+        with self._sub_lock:
+            self._ensure_subscribed_locked(fresh=True)
+
+    def _ensure_subscribed_locked(self, fresh: bool = False):
+        """(Re)establish the pubsub connection for ``_sub_channels``.
+        Invariant on exit: ``_sub_client`` is either a connection that
+        acknowledged SUBSCRIBE, or None (a later subscribe()/_reconnect
+        retries). Callers hold ``_sub_lock``."""
+        if self._closed or not self._sub_channels:
+            return
+        if fresh and self._sub_client is not None:
+            try:
+                self._sub_client.close()
+            except Exception:
+                pass
+            self._sub_client = None
+        if self._sub_client is None:
+            try:
+                self._sub_client = RpcClient(
+                    self.address, on_push=self._on_push,
+                    auth_token=self._auth_token)
+            except Exception:
+                logger.warning(
+                    "pubsub reconnect to %s failed; events degrade to "
+                    "view-refresh reconciliation until the next retry",
+                    self.address)
+                return
+        try:
+            self._sub_client.call(
+                pb.SUBSCRIBE, pb.SubscribeRequest(
+                    channels=list(self._sub_channels)).SerializeToString(),
+                timeout=10.0)
+        except Exception:
+            try:
+                self._sub_client.close()
+            except Exception:
+                pass
+            self._sub_client = None
+            logger.warning(
+                "pubsub resubscribe to %s failed; events degrade to "
+                "view-refresh reconciliation until the next retry",
+                self.address)
 
     def close(self):
-        self._client.close()
-        if self._sub_client is not None:
-            self._sub_client.close()
+        with self._client_lock:
+            self._closed = True
+            self._client.close()
+        with self._sub_lock:
+            if self._sub_client is not None:
+                self._sub_client.close()
+                self._sub_client = None
 
     def ping(self) -> float:
         rep = pb.PingReply()
@@ -118,8 +213,13 @@ class StateClient:
     def kv_put(self, key: bytes, value: bytes, overwrite: bool = True,
                namespace: bytes = b"") -> bool:
         rep = pb.KvPutReply()
-        rep.ParseFromString(self._call(pb.KV_PUT, pb.KvPutRequest(
-            ns=namespace, key=key, value=value, overwrite=overwrite)))
+        # CAS (overwrite=False) must not auto-retry: a replayed request
+        # whose original landed would report added=False to the winner
+        rep.ParseFromString(self._call(
+            pb.KV_PUT,
+            pb.KvPutRequest(ns=namespace, key=key, value=value,
+                            overwrite=overwrite),
+            retry=overwrite))
         return rep.added
 
     def kv_get(self, key: bytes, namespace: bytes = b"") -> Optional[bytes]:
@@ -145,24 +245,30 @@ class StateClient:
     def subscribe(self, channels: List[str],
                   handler: Callable[[pb.Event], None]):
         """Register a handler for pushed events on the given channels."""
-        with self._sub_lock:
+        with self._handlers_lock:
             for ch in channels:
                 self._handlers.setdefault(ch, []).append(handler)
+        with self._sub_lock:
+            for ch in channels:
+                if ch not in self._sub_channels:
+                    self._sub_channels.append(ch)
+            self._ensure_subscribed_locked()
             if self._sub_client is None:
-                self._sub_client = RpcClient(
-                    self.address, on_push=self._on_push,
-                    auth_token=self._auth_token)
-            self._sub_client.call(
-                pb.SUBSCRIBE,
-                pb.SubscribeRequest(channels=channels).SerializeToString(),
-                timeout=10.0)
+                # one immediate retry: the dead connection may predate a
+                # completed state-service restart
+                self._ensure_subscribed_locked()
+            if self._sub_client is None:
+                raise RpcConnectionError(
+                    f"subscribe to {self.address} failed (service "
+                    f"unreachable); channels are recorded and will "
+                    f"resubscribe on the next reconnect")
 
     def _on_push(self, env: pb.Envelope):
         if env.method != pb.PUBLISH:
             return
         ev = pb.Event()
         ev.ParseFromString(env.body)
-        with self._sub_lock:
+        with self._handlers_lock:
             handlers = list(self._handlers.get(ev.channel, []))
         for h in handlers:
             try:

@@ -79,6 +79,8 @@ class ProcessCluster:
         import time as _time
         from ray_tpu._private.state_client import start_state_service
         self._subprocess = subprocess
+        self._data_dir = data_dir
+        self._heartbeat_timeout_ms = heartbeat_timeout_ms
         self.state_proc, self.address = start_state_service(
             data_dir=data_dir, heartbeat_timeout_ms=heartbeat_timeout_ms)
         self.daemons = []
@@ -88,6 +90,21 @@ class ProcessCluster:
                                  tp_cpu_devices=tp_cpu_devices)
         for _ in range(num_daemons):
             self.add_daemon()
+
+    def restart_state_service(self):
+        """SIGKILL the state service and restart it on the SAME port
+        (journal-recovered when ``data_dir`` was set) — the GCS
+        fault-tolerance chaos scenario: daemons and drivers must
+        reconnect and re-register, not wedge."""
+        from ray_tpu._private.state_client import start_state_service
+        port = int(self.address.rsplit(":", 1)[1])
+        if self.state_proc.poll() is None:
+            self.state_proc.kill()
+            self.state_proc.wait(timeout=10)
+        self.state_proc, addr = start_state_service(
+            port=port, data_dir=self._data_dir,
+            heartbeat_timeout_ms=self._heartbeat_timeout_ms)
+        assert addr == self.address, (addr, self.address)
 
     def add_daemon(self, num_cpus: Optional[float] = None,
                    resources: Optional[Dict[str, float]] = None,
