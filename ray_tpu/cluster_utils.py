@@ -91,6 +91,13 @@ class ProcessCluster:
         for _ in range(num_daemons):
             self.add_daemon()
 
+    def node_provider(self, node_types: Dict[str, Dict[str, float]]
+                      ) -> "ProcessClusterNodeProvider":
+        """An autoscaler NodeProvider whose "cloud" is THIS cluster:
+        create_node spawns a real daemon process (the multi-process
+        analogue of the reference's fake_multi_node provider)."""
+        return ProcessClusterNodeProvider(self, node_types)
+
     def restart_state_service(self):
         """SIGKILL the state service and restart it on the SAME port
         (journal-recovered when ``data_dir`` was set) — the GCS
@@ -147,3 +154,95 @@ class ProcessCluster:
                 self.state_proc.wait(timeout=10)
             except Exception:
                 self.state_proc.kill()
+
+
+class ProcessClusterNodeProvider:
+    """Autoscaler NodeProvider over a live ``ProcessCluster``: launching
+    a node spawns a real host-daemon PROCESS that registers with the
+    state service (the reference's ``fake_multi_node`` provider, at
+    process rather than in-process granularity). Lets the autoscaler
+    loop drive an actual multi-process cluster in tests."""
+
+    def __init__(self, cluster: "ProcessCluster",
+                 node_types: Dict[str, Dict[str, float]]):
+        import threading
+        self._cluster = cluster
+        self._node_types = dict(node_types)
+        # the autoscaler's monitor thread drives this concurrently with
+        # the test thread: all map access is locked (FakeNodeProvider
+        # does the same)
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, int] = {}   # provider id -> daemon index
+        self._types: Dict[str, str] = {}
+        self._addrs: Dict[str, str] = {}   # provider id -> daemon address
+        self._node_ids: Dict[str, object] = {}  # provider id -> NodeID
+
+    def non_terminated_nodes(self):
+        with self._lock:
+            items = list(self._nodes.items())
+        return [pid for pid, idx in items
+                if self._cluster.daemons[idx]["proc"].poll() is None]
+
+    def create_node(self, node_type: str, count: int = 1):
+        import uuid as _uuid
+        if node_type not in self._node_types:
+            raise ValueError(f"unknown node type {node_type!r}")
+        res = dict(self._node_types[node_type])
+        created = []
+        for _ in range(count):
+            cpus = res.get("CPU", 1)
+            extra = {k: v for k, v in res.items()
+                     if k not in ("CPU", "TPU")}
+            with self._lock:
+                addr = self._cluster.add_daemon(
+                    num_cpus=cpus, resources=extra,
+                    num_tpus=res.get("TPU", 0))
+                idx = next(i for i, d in enumerate(self._cluster.daemons)
+                           if d["address"] == addr)
+                pid = f"proc-{node_type}-{_uuid.uuid4().hex[:6]}"
+                self._nodes[pid] = idx
+                self._types[pid] = node_type
+                self._addrs[pid] = addr
+            created.append(pid)
+        return created
+
+    def terminate_node(self, provider_node_id: str):
+        with self._lock:
+            idx = self._nodes.pop(provider_node_id, None)
+            self._types.pop(provider_node_id, None)
+            self._addrs.pop(provider_node_id, None)
+            self._node_ids.pop(provider_node_id, None)
+        if idx is not None:
+            self._cluster.kill_daemon(idx)
+
+    def node_resources(self, provider_node_id: str):
+        with self._lock:
+            t = self._types.get(provider_node_id)
+        return dict(self._node_types.get(t, {}))
+
+    def node_type(self, provider_node_id: str) -> str:
+        with self._lock:
+            return self._types[provider_node_id]
+
+    def runtime_node_id(self, provider_node_id: str):
+        """Runtime NodeID of the daemon (resolved from the state service
+        by address) — _scale_down matches it against node utilization to
+        find idle nodes; without it scale-down would silently no-op."""
+        with self._lock:
+            cached = self._node_ids.get(provider_node_id)
+            addr = self._addrs.get(provider_node_id)
+        if cached is not None:
+            return cached
+        from ray_tpu._private.ids import NodeID
+        from ray_tpu._private.state_client import StateClient
+        state = StateClient(self._cluster.address)
+        try:
+            for info in state.list_nodes():
+                if info.address == addr:
+                    nid = NodeID(info.node_id)
+                    with self._lock:
+                        self._node_ids[provider_node_id] = nid
+                    return nid
+        finally:
+            state.close()
+        raise KeyError(provider_node_id)

@@ -412,3 +412,49 @@ def test_state_service_restart_cluster_survives(tmp_path):
     finally:
         ray_tpu.shutdown()
         c.shutdown()
+
+
+def test_autoscaler_scales_up_process_cluster():
+    """The autoscaler drives a REAL multi-process cluster: an infeasible
+    task becomes unmet demand, the provider spawns a daemon process, and
+    the task runs there (cluster-level scale-up end to end)."""
+    from ray_tpu.autoscaler.autoscaler import (AutoscalerConfig,
+                                               StandardAutoscaler)
+    ray_tpu.shutdown()
+    c = ProcessCluster(num_daemons=1, num_cpus=2)
+    try:
+        ray_tpu.init(address=c.address)
+        rt = ray_tpu._private.worker.global_worker().runtime
+        provider = c.node_provider({"big": {"CPU": 8}})
+        scaler = StandardAutoscaler(
+            AutoscalerConfig(min_workers=0, max_workers=2,
+                             idle_timeout_s=1.0,
+                             node_types={"big": {"CPU": 8}}),
+            provider, runtime=rt)
+
+        @ray_tpu.remote(num_cpus=6)
+        def heavy():
+            return os.getpid()
+
+        ref = heavy.remote()   # infeasible on the 2-CPU daemon
+        deadline = time.monotonic() + 60
+        launched = 0
+        while time.monotonic() < deadline and not launched:
+            launched = scaler.update()["launched"]
+            time.sleep(0.3)
+        assert launched == 1, "autoscaler never saw the unmet demand"
+        pid = ray_tpu.get(ref, timeout=90)
+        assert pid == c.daemons[-1]["proc"].pid  # ran on the new daemon
+
+        # scale DOWN: the big daemon goes idle; past idle_timeout_s the
+        # autoscaler terminates it (runtime_node_id resolution path)
+        deadline = time.monotonic() + 60
+        terminated = 0
+        while time.monotonic() < deadline and not terminated:
+            terminated = scaler.update()["terminated"]
+            time.sleep(0.3)
+        assert terminated == 1, "idle daemon never terminated"
+        assert provider.non_terminated_nodes() == []
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
