@@ -7,6 +7,7 @@ device arrays directly onto a mesh.
 """
 
 from ray_tpu.data.block import BlockAccessor
+from ray_tpu.data.random_access import RandomAccessDataset  # noqa: F401
 from ray_tpu.data.dataset import (ActorPoolStrategy, DataIterator,
                                   Dataset, GroupedData,
                                   TaskPoolStrategy)
@@ -17,7 +18,7 @@ from ray_tpu.data.read_api import (from_arrow, from_items, from_numpy,
                                    read_numpy, read_parquet, read_text)
 
 __all__ = [
-    "Dataset", "DataIterator", "DatasetPipeline", "GroupedData", "BlockAccessor",
+    "Dataset", "DataIterator", "RandomAccessDataset", "DatasetPipeline", "GroupedData", "BlockAccessor",
     "ActorPoolStrategy", "TaskPoolStrategy",
     "from_items", "from_pandas", "from_arrow", "from_numpy",
     "range", "range_table", "read_csv", "read_parquet", "read_json",

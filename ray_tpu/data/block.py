@@ -97,13 +97,19 @@ class BlockAccessor:
 
     @staticmethod
     def combine(blocks: List[Any]):
-        if not blocks:
+        # Empty partitions (e.g. a sort/shuffle range that received no
+        # rows) materialize as [] regardless of the dataset's block type;
+        # they carry no type information and must not decide — or break —
+        # the concat (pd.concat rejects a bare list mixed with frames).
+        nonempty = [b for b in blocks
+                    if BlockAccessor.for_block(b).num_rows() > 0]
+        if not nonempty:
             return []
-        if _is_tabular(blocks[0]):
+        if _is_tabular(nonempty[0]):
             import pandas as pd
-            return pd.concat(blocks, ignore_index=True)
+            return pd.concat(nonempty, ignore_index=True)
         out: List[Any] = []
-        for b in blocks:
+        for b in nonempty:
             out.extend(b)
         return out
 

@@ -353,3 +353,46 @@ def test_prefetch_iterator_abandonment_releases_producer(ray_start_regular):
             break
         _time.sleep(0.05)
     assert not lingering, f"{len(lingering)} prefetch threads leaked"
+
+
+def test_random_access_dataset(ray_start_regular):
+    """Point lookups + batched multiget over a sorted, actor-partitioned
+    dataset (reference RandomAccessDataset semantics)."""
+    import ray_tpu.data as rd
+    from ray_tpu.data import RandomAccessDataset
+    rows = [{"id": i, "val": i * 10} for i in range(200)]
+    import random as _r
+    _r.Random(0).shuffle(rows)
+    ds = rd.from_items(rows).repartition(6)
+    rad = RandomAccessDataset(ds, "id", num_workers=3)
+
+    assert rad.get(0)["val"] == 0
+    assert rad.get(199)["val"] == 1990
+    assert rad.get(123)["val"] == 1230
+    assert rad.get(777) is None          # absent key
+
+    keys = [5, 150, 42, 999, 63]
+    got = rad.multiget(keys)
+    assert [g["val"] if g else None for g in got] == [50, 1500, 420,
+                                                      None, 630]
+    stats = rad.stats()
+    assert sum(s["rows"] for s in stats) == 200
+
+
+def test_random_access_skewed_and_empty(ray_start_regular):
+    """Skewed keys (empty sort ranges) and empty datasets must not crash
+    construction (regression: empty partitions are typeless [] blocks)."""
+    import ray_tpu.data as rd
+    from ray_tpu.data import RandomAccessDataset
+    # 10 distinct keys over 100 rows across 5 blocks: some sort ranges empty
+    rows = [{"id": i // 10, "val": i} for i in range(100)]
+    ds = rd.from_items(rows).repartition(5)
+    rad = RandomAccessDataset(ds, "id", num_workers=4)
+    assert rad.get(0) is not None
+    assert rad.get(9) is not None
+    assert rad.get(10) is None
+    assert sum(s["rows"] for s in rad.stats()) == 100
+
+    empty = RandomAccessDataset(rd.from_items([]), "id", num_workers=2)
+    assert empty.get(1) is None
+    assert empty.multiget([1, 2]) == [None, None]
