@@ -1617,14 +1617,29 @@ class DistributedRuntime(Runtime):
         rec = self.remote_actors.get(actor_id)
         state = self.actors.get(actor_id)
         if rec is None and state is None:
-            # Maybe a named/foreign actor we learned about from the table.
-            info = self.state.get_actor(actor_id.binary())
-            if info is not None and info.address and \
-                    info.address != self.address and info.state != "DEAD":
-                rec = _RemoteActorRecord(
-                    actor_id, info.class_name, info.address,
-                    info.node_id, None, info.name, info.namespace)
-                self.remote_actors[actor_id] = rec
+            # Maybe a named/foreign actor we learned about from the table
+            # (e.g. a handle created by ANOTHER process, like a serve
+            # controller's replica). A table entry that is still being
+            # PLACED has no address yet — that is "not scheduled yet",
+            # not "dead": wait (bounded) for placement instead of
+            # sealing an ActorDiedError.
+            deadline = (time.monotonic()
+                        + _config.get("worker_lease_timeout_s"))
+            while True:
+                info = self.state.get_actor(actor_id.binary())
+                if info is None or info.state == "DEAD":
+                    break
+                if info.address and info.address != self.address:
+                    rec = _RemoteActorRecord(
+                        actor_id, info.class_name, info.address,
+                        info.node_id, None, info.name, info.namespace)
+                    self.remote_actors[actor_id] = rec
+                    break
+                if info.address == self.address and info.address:
+                    break  # ours after all; local path below
+                if time.monotonic() > deadline:
+                    break
+                self._placement_wait(0.05)
         if rec is not None and rec.address != self.address:
             return self._submit_actor_remote(rec, actor_id, spec)
         ids = super().submit_actor_task(actor_id, spec)

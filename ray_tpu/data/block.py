@@ -97,19 +97,19 @@ class BlockAccessor:
 
     @staticmethod
     def combine(blocks: List[Any]):
-        # Empty partitions (e.g. a sort/shuffle range that received no
-        # rows) materialize as [] regardless of the dataset's block type;
-        # they carry no type information and must not decide — or break —
-        # the concat (pd.concat rejects a bare list mixed with frames).
-        nonempty = [b for b in blocks
-                    if BlockAccessor.for_block(b).num_rows() > 0]
-        if not nonempty:
+        # Empty LIST partitions (e.g. a sort/shuffle range that received
+        # no rows) are typeless and must not decide — or break — the
+        # concat (pd.concat rejects a bare list mixed with frames).
+        # Empty DataFrames are different: they CARRY the schema and must
+        # be kept so an all-empty tabular combine preserves its columns.
+        typed = [b for b in blocks if not (isinstance(b, list) and not b)]
+        if not typed:
             return []
-        if _is_tabular(nonempty[0]):
+        if _is_tabular(typed[0]):
             import pandas as pd
-            return pd.concat(nonempty, ignore_index=True)
+            return pd.concat(typed, ignore_index=True)
         out: List[Any] = []
-        for b in nonempty:
+        for b in typed:
             out.extend(b)
         return out
 

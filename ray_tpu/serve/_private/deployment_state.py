@@ -23,6 +23,8 @@ _replica_counter = itertools.count()
 
 
 class ReplicaInfo:
+    healthy = False  # flips on the first successful health probe
+
     def __init__(self, tag: str, handle, version: str):
         self.tag = tag
         self.handle = handle
@@ -130,6 +132,7 @@ class DeploymentState:
                 continue
             try:
                 ray_tpu.get(ref, timeout=0.1)
+                info.healthy = True   # answered a probe: READY to serve
                 live.append(info)
             except Exception:
                 logger.warning("replica %s died; replacing", info.tag)
@@ -190,6 +193,10 @@ class DeploymentState:
             "name": self.name,
             "target_replicas": self.target_replicas,
             "running_replicas": len(self.replicas),
+            # replicas that have ANSWERED a health probe — running counts
+            # only started handles, whose actors may still be placing or
+            # initializing (serve.run readiness waits on this)
+            "ready_replicas": sum(1 for r in self.replicas if r.healthy),
             "version": self.target_version,
             "deleting": self.deleting,
         }

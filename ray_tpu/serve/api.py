@@ -176,7 +176,33 @@ def run(target, name: str = "default",
         ray_tpu.get(controller.deploy.remote(
             dep.name, dep.func_or_class, init_args, init_kwargs,
             cfg_dict, prefix))
+    # Reference semantics: serve.run blocks until the application is
+    # ready — returning earlier hands out a handle whose first requests
+    # race replica placement (observed on multi-process clusters, where
+    # actor placement is not instantaneous).
+    _wait_ready(controller, [n.deployment.name for n in ordered])
     return DeploymentHandle(root.deployment.name, controller)
+
+
+def _wait_ready(controller, names: List[str],
+                timeout_s: float = 60.0) -> None:
+    """Block until every deployment's replicas have ANSWERED a health
+    probe (``ready_replicas``) — ``running_replicas`` counts only started
+    actor handles, which are satisfied synchronously at deploy time while
+    placement and __init__ still run in the background."""
+    import time as _time
+    deadline = _time.monotonic() + timeout_s
+    pending = list(names)
+    while _time.monotonic() < deadline:
+        statuses = ray_tpu.get(controller.list_deployments.remote())
+        pending = [n for n in names
+                   if statuses.get(n, {}).get("ready_replicas", 0)
+                   < statuses.get(n, {}).get("target_replicas", 1)]
+        if not pending:
+            return
+        _time.sleep(0.1)
+    raise TimeoutError(
+        f"deployments not ready within {timeout_s}s: {pending}")
 
 
 def get_deployment_handle(name: str) -> DeploymentHandle:

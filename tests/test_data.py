@@ -396,3 +396,15 @@ def test_random_access_skewed_and_empty(ray_start_regular):
     empty = RandomAccessDataset(rd.from_items([]), "id", num_workers=2)
     assert empty.get(1) is None
     assert empty.multiget([1, 2]) == [None, None]
+
+
+def test_all_empty_tabular_combine_preserves_schema(ray_start_regular):
+    """Filtering everything out must keep the schema: empty DataFrames
+    carry type information and must not collapse to typeless [] blocks
+    (regression from the empty-partition combine fix)."""
+    import ray_tpu.data as rd
+    ds = (rd.from_items([{"id": i, "val": i} for i in range(10)])
+          .filter(lambda r: False).repartition(2))
+    df = ds.to_pandas()
+    assert list(df.columns) == ["id", "val"], list(df.columns)
+    assert len(df) == 0

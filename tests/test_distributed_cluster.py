@@ -458,3 +458,25 @@ def test_autoscaler_scales_up_process_cluster():
     finally:
         ray_tpu.shutdown()
         c.shutdown()
+
+
+def test_serve_replicas_across_daemon_processes(cluster):
+    """Serve on a REAL multi-process cluster: the controller and replicas
+    are actors on daemon processes; serve.run blocks until ready so the
+    first request cannot race replica placement."""
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    def who(req):
+        return {"pid": os.getpid()}
+
+    try:
+        h = serve.run(who.bind(), name="who")
+        pids = {h.remote(None).result(timeout=30)["pid"]
+                for _ in range(12)}
+        daemon_pids = {d["proc"].pid for d in cluster.daemons}
+        # replicas live in daemon processes (pack placement may co-locate
+        # them on one daemon, so >= 1 distinct pid)
+        assert pids and pids <= daemon_pids, (pids, daemon_pids)
+    finally:
+        serve.shutdown()
