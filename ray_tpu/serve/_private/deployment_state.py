@@ -144,8 +144,13 @@ class DeploymentState:
         import time as _time
         changed = False
 
-        # Replace dead replicas (failure recovery) on the configured cadence.
-        if (self.replicas and _time.monotonic() - self._last_health_check
+        # Replace dead replicas (failure recovery) on the configured
+        # cadence — but while any replica has never answered a probe
+        # (still placing / initializing), probe EVERY tick so readiness
+        # (serve.run's wait) resolves promptly.
+        if self.replicas and (
+                any(not r.healthy for r in self.replicas)
+                or _time.monotonic() - self._last_health_check
                 >= self.config.health_check_period_s):
             live = self._check_health()
             if len(live) != len(self.replicas):
