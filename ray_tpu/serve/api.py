@@ -137,7 +137,8 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
 
 
 def run(target, name: str = "default",
-        route_prefix: Optional[str] = "/") -> DeploymentHandle:
+        route_prefix: Optional[str] = "/",
+        ready_timeout_s: float = 300.0) -> DeploymentHandle:
     """Deploy an application (a bound deployment graph) and return a handle
     to its ingress deployment."""
     if isinstance(target, Application):
@@ -180,12 +181,13 @@ def run(target, name: str = "default",
     # ready — returning earlier hands out a handle whose first requests
     # race replica placement (observed on multi-process clusters, where
     # actor placement is not instantaneous).
-    _wait_ready(controller, [n.deployment.name for n in ordered])
+    _wait_ready(controller, [n.deployment.name for n in ordered],
+                timeout_s=ready_timeout_s)
     return DeploymentHandle(root.deployment.name, controller)
 
 
 def _wait_ready(controller, names: List[str],
-                timeout_s: float = 60.0) -> None:
+                timeout_s: float = 300.0) -> None:
     """Block until every deployment's replicas have ANSWERED a health
     probe (``ready_replicas``) — ``running_replicas`` counts only started
     actor handles, which are satisfied synchronously at deploy time while
