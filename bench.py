@@ -207,6 +207,20 @@ algo.stop()
     raise RuntimeError(f"ppo bench failed: {proc.stderr[-300:]}")
 
 
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
 def _wait_for_backend() -> bool:
     """The axon TPU tunnel is transiently unavailable at times; retry
     backend init rather than failing the whole bench run. The probe runs
@@ -219,8 +233,8 @@ def _wait_for_backend() -> bool:
     """
     import threading
 
-    retries = int(os.environ.get("BENCH_PROBE_RETRIES", "20"))
-    delay_s = float(os.environ.get("BENCH_PROBE_DELAY_S", "60"))
+    retries = max(1, _env_int("BENCH_PROBE_RETRIES", 20))
+    delay_s = _env_float("BENCH_PROBE_DELAY_S", 60.0)
 
     def probe() -> bool:
         out = [False]
@@ -275,6 +289,7 @@ def _section(name, fn, results, timeout_s=900.0):
     t.join(timeout=timeout_s)
     if t.is_alive():
         box["error"] = f"timeout after {timeout_s:.0f}s"
+        box["timed_out"] = True
     results[name] = box
     _emit({"metric": f"section_{name}", "unit": "progress",
            "value": None if "error" in box else "ok",
@@ -284,11 +299,20 @@ def _section(name, fn, results, timeout_s=900.0):
 
 
 def main():
-    backend_ok = _wait_for_backend()
+    try:
+        backend_ok = _wait_for_backend()
+    except Exception as exc:  # noqa: BLE001 - even the probe must not kill us
+        _emit({"metric": "backend_probe_error", "value": str(exc),
+               "unit": "error"})
+        backend_ok = False
     results = {}
     kind, peak = ("", None)
     if backend_ok:
-        kind, peak = _chip_peak_flops()
+        try:
+            kind, peak = _chip_peak_flops()
+        except Exception as exc:  # noqa: BLE001
+            _emit({"metric": "chip_detect_error", "value": str(exc),
+                   "unit": "error"})
         r50 = lm = r18 = None
         # A TIMEOUT (vs an exception) means the tunnel hung mid-section;
         # later device sections would each eat their full budget too, so
@@ -304,7 +328,7 @@ def main():
                 lm = val
             else:
                 r18 = val
-            if "timeout" in results[name].get("error", ""):
+            if results[name].get("timed_out"):
                 _emit({"metric": "device_sections_aborted", "value": name,
                        "unit": "hung_section"})
                 break
@@ -348,5 +372,12 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as exc:  # noqa: BLE001 - the driver parses the last line
+        _emit({"metric": "resnet50_train_images_per_sec_per_chip",
+               "value": None, "unit": "images/sec", "vs_baseline": None,
+               "mfu_pct": None, "backend_available": False,
+               "errors": {"harness": f"{type(exc).__name__}: {exc}"},
+               "extras": {}})
     sys.exit(0)

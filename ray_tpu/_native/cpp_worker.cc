@@ -107,11 +107,17 @@ class Connection {
     WriteExact(frame.data(), frame.size());
   }
 
+  // Matches rpc.py MAX_FRAME: reject oversized declared lengths BEFORE
+  // allocating, so a corrupt/malicious peer cannot drive huge allocations.
+  static constexpr uint32_t kMaxFrame = 1u << 31;
+
   raytpu::Envelope ReadEnvelope() {
     uint8_t hdr[4];
     ReadExact(hdr, 4);
     uint32_t len = (uint32_t(hdr[0]) << 24) | (uint32_t(hdr[1]) << 16) |
                    (uint32_t(hdr[2]) << 8) | uint32_t(hdr[3]);
+    if (len > kMaxFrame)
+      throw std::runtime_error("frame exceeds MAX_FRAME");
     std::string buf(len, '\0');
     ReadExact(buf.data(), len);
     raytpu::Envelope env;

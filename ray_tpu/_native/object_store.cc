@@ -364,14 +364,19 @@ class StoreServer {
     addr.sun_family = AF_UNIX;
     std::strncpy(addr.sun_path, path, sizeof(addr.sun_path) - 1);
     unlink(path);
-    if (bind(listen_fd_, reinterpret_cast<struct sockaddr*>(&addr),
-             sizeof(addr)) != 0 ||
-        listen(listen_fd_, 64) != 0) {
+    // Same-user only from the first instant: the arena socket hands out
+    // the memfd mapping ALL host object memory, so the socket must never
+    // be world-connectable, not even between bind() and a later chmod().
+    mode_t prev_umask = umask(0077);
+    int rc = bind(listen_fd_, reinterpret_cast<struct sockaddr*>(&addr),
+                  sizeof(addr));
+    umask(prev_umask);
+    if (rc != 0 || listen(listen_fd_, 64) != 0) {
       close(listen_fd_);
       listen_fd_ = -1;
       return;
     }
-    chmod(path, 0600);  // same-user only: the arena is all of host memory
+    chmod(path, 0600);  // belt-and-braces on filesystems ignoring umask
     accept_thread_ = std::thread([this] { AcceptLoop(); });
   }
 

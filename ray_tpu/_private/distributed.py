@@ -291,15 +291,14 @@ class DistributedRuntime(Runtime):
 
     def _setup_host_arena(self, is_driver: bool, _retry: bool = True):
         """Own or join this host's shared arena, brokered through the
-        state-service KV (namespace ``arena``, key = hostname). Daemons
+        state-service KV (namespace ``arena``, key = machine id). Daemons
         race to own (CAS put); losers and drivers connect as clients. A
         stale entry (owner crashed, socket dead) is repaired: the joiner
         deletes it and re-runs the race so a healthy daemon can take over."""
-        import socket as _socket
         from ray_tpu._native import NativeObjectStore, NativeStoreClient
         if not NativeObjectStore.available():
             return
-        host_key = _socket.gethostname().encode()
+        host_key = self._machine_id().encode()
         ns = b"arena"
         if not is_driver:
             path = (f"/tmp/ray_tpu_arena_{os.getpid()}_"
@@ -333,15 +332,72 @@ class DistributedRuntime(Runtime):
                 self.host_arena_key = existing.decode()
                 logger.debug("joined host arena at %s", self.host_arena_key)
             except Exception:
+                self.host_arena = None
+                if not self._arena_owner_dead(existing.decode()):
+                    # The claimed owner still looks alive: the connect
+                    # failure is transient (or a cross-container /tmp).
+                    # Deleting a healthy owner's claim would thrash
+                    # ownership, so keep it and fall back to TCP — loudly.
+                    logger.warning(
+                        "host arena at %s unreachable but its owner "
+                        "appears alive; falling back to TCP object "
+                        "transfer", existing.decode())
+                    return
                 # stale entry from a dead owner: clear it and re-race once
                 # (a daemon may now win ownership; a driver re-joins)
-                self.host_arena = None
                 try:
                     self.state.kv_del(host_key, namespace=ns)
                 except Exception:
                     return
                 if _retry:
                     self._setup_host_arena(is_driver, _retry=False)
+
+    @staticmethod
+    def _machine_id() -> str:
+        """Arena claim key, unique per "set of processes that can share an
+        arena socket": hostname alone collides across containers/pods that
+        clone hostnames, and a cross-machine joiner must never usurp a
+        healthy owner's claim (advisor r4). boot_id disambiguates
+        machines; /tmp's (dev, inode) disambiguates same-kernel containers
+        with isolated /tmp mounts — those cannot reach each other's
+        sockets, so each must run its own arena under its own key."""
+        import socket as _socket
+        try:
+            with open("/proc/sys/kernel/random/boot_id") as f:
+                boot = f.read().strip()
+        except OSError:
+            boot = ""
+        try:
+            st = os.stat("/tmp")
+            tmp_id = f"{st.st_dev}:{st.st_ino}"
+        except OSError:
+            tmp_id = ""
+        return f"{_socket.gethostname()}|{boot}|{tmp_id}"
+
+    @staticmethod
+    def _arena_owner_dead(path: str) -> bool:
+        """Is the claimed arena owner verifiably dead? The signal is a
+        fresh connect to the claimed socket — a listener means a live
+        owner (whatever made the join fail was past accept), and
+        ENOENT/ECONNREFUSED mean no listener, i.e. a dead owner. This is
+        immune to pid recycling AND to pid namespaces (a same-/tmp
+        joiner in another pid namespace cannot see the owner's pid, so a
+        pid probe would misjudge a healthy owner). Anything ambiguous
+        (e.g. connect timeout under load) counts as alive: a dead
+        owner's socket refuses instantly on the next attempt, while a
+        wrongly-deleted healthy claim causes ownership thrash."""
+        import socket as _socket
+        s = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+        s.settimeout(1.0)
+        try:
+            s.connect(path)
+            return False
+        except (FileNotFoundError, ConnectionRefusedError):
+            return True
+        except OSError:
+            return False
+        finally:
+            s.close()
 
     @staticmethod
     def _arena_payload_key(oid: ObjectID, payload: bytes) -> bytes:

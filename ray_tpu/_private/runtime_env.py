@@ -43,6 +43,11 @@ class _EnvGate:
         self.cv = threading.Condition()
         self.active_key: Optional[str] = None
         self.count = 0
+        # While a nested DIFFERENT env has mutated the process env, new
+        # same-outer-env entrants must be held out too — otherwise they
+        # run with the nested env's env_vars visible (the silent bleed
+        # the exclusivity wait exists to prevent).
+        self.nested_active = 0
         self._saved: Dict[str, Optional[str]] = {}
         self._inserted: List[str] = []
         self._depth = threading.local()  # nested applied() on one thread
@@ -58,7 +63,7 @@ class _EnvGate:
             # than refusing, so it requires exclusivity.
             with self.cv:
                 if env.key == self.active_key:
-                    self._push_nested(({}, []))
+                    self._push_nested(({}, [], False))
                     return
                 if not self.cv.wait_for(lambda: self.count <= 1,
                                         timeout=5.0):
@@ -76,10 +81,12 @@ class _EnvGate:
                     if p not in sys.path:
                         sys.path.insert(0, p)
                         inserted.append(p)
-                self._push_nested((saved, inserted))
+                self.nested_active += 1
+                self._push_nested((saved, inserted, True))
             return
         with self.cv:
-            while self.active_key not in (None, env.key):
+            while (self.active_key not in (None, env.key)
+                   or self.nested_active > 0):
                 self.cv.wait(timeout=1.0)
             if self.active_key is None:
                 self.active_key = env.key
@@ -95,7 +102,7 @@ class _EnvGate:
     def exit(self, env: "MaterializedEnv"):
         self._depth.n = getattr(self._depth, "n", 1) - 1
         if self._depth.n > 0:
-            saved, inserted = self._depth.stack.pop()
+            saved, inserted, mutated = self._depth.stack.pop()
             with self.cv:
                 for k, v in saved.items():
                     if v is None:
@@ -105,6 +112,9 @@ class _EnvGate:
                 for p in inserted:
                     with contextlib.suppress(ValueError):
                         sys.path.remove(p)
+                if mutated:
+                    self.nested_active -= 1
+                    self.cv.notify_all()
             return
         with self.cv:
             self.count -= 1
