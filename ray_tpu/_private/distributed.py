@@ -165,6 +165,7 @@ class DistributedRuntime(Runtime):
         self.address = self.server.address
 
         # Cluster view: node_id bytes -> (pb.NodeInfo, NodeResources view).
+        self._states_memo = None  # (monotonic_ts, [NodeState]) micro-TTL
         self._view_lock = threading.Lock()
         self._view: Dict[bytes, pb.NodeInfo] = {}
         self._view_avail: Dict[bytes, NodeResources] = {}
@@ -180,9 +181,14 @@ class DistributedRuntime(Runtime):
         # (the reference keys TaskManager bookkeeping by attempt_number,
         # task_manager.h:152).
         self._exported_fns: Dict[bytes, bytes] = {}  # hash -> payload
+        self._fn_key_by_identity = weakref.WeakKeyDictionary()
         self._fn_cache: Dict[bytes, Any] = {}  # hash -> callable/class
         self._inflight_lock = threading.Lock()
         self._inflight_remote: Dict[Tuple[TaskID, int], dict] = {}
+        # Reverse index return-oid -> inflight info: get() probes this per
+        # poll, and a linear scan over all in-flight pushes is O(n^2)
+        # across a driver gathering n results.
+        self._inflight_by_return: Dict[ObjectID, dict] = {}
         self._completed_returns: set = set()  # return oids known done
         # Nodes whose death we already processed (signals arrive from both
         # the pubsub push and the view refresh; handling must be idempotent).
@@ -837,10 +843,21 @@ class DistributedRuntime(Runtime):
 
     def _inflight_for_return(self, oid: ObjectID) -> Optional[dict]:
         with self._inflight_lock:
-            for info in self._inflight_remote.values():
-                if oid in info["returns"]:
-                    return info
-        return None
+            return self._inflight_by_return.get(oid)
+
+    def _index_inflight(self, info: dict) -> None:
+        """Under _inflight_lock."""
+        for rid in info["returns"]:
+            self._inflight_by_return[rid] = info
+
+    def _unindex_inflight(self, info: Optional[dict]) -> None:
+        """Under _inflight_lock. Identity-checked: a retry attempt may
+        have re-registered the same return ids with a newer info."""
+        if info is None:
+            return
+        for rid in info["returns"]:
+            if self._inflight_by_return.get(rid) is info:
+                del self._inflight_by_return[rid]
 
     def _task_finalized(self, task_id: TaskID) -> bool:
         with self.lock:
@@ -887,20 +904,28 @@ class DistributedRuntime(Runtime):
     def _fetch_from(self, addr: str, oid: ObjectID):
         """Pull of a pickled object. Same-host owners serve through the
         shared arena (one shm read, zero payload bytes on the wire);
-        otherwise chunked TCP. Returns (value | _FETCH_MISS,
-        error_or_none)."""
+        otherwise chunked TCP with ALL remaining chunks requested
+        concurrently after the first reply reveals total_size (the
+        reference chunk-parallelizes transfers the same way,
+        ``object_manager.cc`` pull chunking) — sequential
+        request-per-chunk pays a full round trip of dead air per 8 MB.
+        Returns (value | _FETCH_MISS, error_or_none)."""
         client = self.pool.get(addr)
-        buf = io.BytesIO()
-        offset = 0
         arena_key = self.host_arena_key
+        first_box: Dict[str, bytearray] = {}
+
+        def _first_sink(n):
+            first_box["buf"] = bytearray(n)
+            return memoryview(first_box["buf"])
+
         while True:
             rep = pb.FetchObjectReply()
             rep.ParseFromString(client.call(
                 pb.FETCH_OBJECT, pb.FetchObjectRequest(
-                    object_id=oid.binary(), offset=offset,
+                    object_id=oid.binary(), offset=0,
                     max_bytes=FETCH_CHUNK,
                     arena_key=arena_key).SerializeToString(),
-                timeout=120).body)
+                timeout=120, raw_sink=_first_sink).body)
             if not rep.found:
                 return _FETCH_MISS, None
             if rep.error_pickle:
@@ -911,12 +936,65 @@ class DistributedRuntime(Runtime):
                     return value, None
                 # raced an eviction: retry over TCP
                 arena_key = ""
+                first_box.pop("buf", None)
                 continue
-            buf.write(rep.data)
-            offset += len(rep.data)
-            if rep.eof or not rep.data:
-                break
-        value, _ = _loads_framed(buf.getvalue())
+            break
+        first = first_box.get("buf")
+        if first is None:
+            first = rep.data  # pre-raw-lane peer
+        total = rep.total_size or len(first)
+        if rep.eof or len(first) >= total:
+            value, _ = _loads_framed(first)
+            return value, None
+        data = bytearray(total)
+        data[:len(first)] = first
+        offsets = list(range(len(first), total, FETCH_CHUNK))
+        state = {"left": len(offsets), "error": None}
+        state_lock = threading.Lock()  # NOT self.lock: cbs run on the
+        done = threading.Event()       # reader thread — keep them tiny
+
+        def _chunk_cb(off):
+            def cb(env, error):
+                try:
+                    if error is None:
+                        crep = pb.FetchObjectReply()
+                        crep.ParseFromString(env.body)
+                        if not crep.found:
+                            error = RpcRemoteError(
+                                f"object {oid} vanished mid-fetch")
+                        elif crep.data:
+                            # pre-raw-lane peer: bytes came in the proto
+                            data[off:off + len(crep.data)] = crep.data
+                except Exception as e:  # noqa: BLE001
+                    error = e
+                with state_lock:
+                    if error is not None and state["error"] is None:
+                        state["error"] = error
+                    state["left"] -= 1
+                    if state["left"] == 0 or error is not None:
+                        done.set()
+            return cb
+
+        for off in offsets:
+            # The raw sink lands each chunk's bytes DIRECTLY in its slot
+            # of the destination buffer from the reader thread — the
+            # whole TCP pull does zero user-space payload copies here.
+            client.call_async(
+                pb.FETCH_OBJECT, pb.FetchObjectRequest(
+                    object_id=oid.binary(), offset=off,
+                    max_bytes=FETCH_CHUNK).SerializeToString(),
+                _chunk_cb(off),
+                raw_sink=lambda n, _o=off: memoryview(data)[_o:_o + n])
+        if not done.wait(timeout=120):
+            raise TimeoutError(f"chunked fetch of {oid} from {addr} "
+                               f"timed out")
+        if state["error"] is not None:
+            err = state["error"]
+            if isinstance(err, (RpcConnectionError, RpcRemoteError,
+                                TimeoutError)):
+                raise err
+            raise RpcConnectionError(str(err))
+        value, _ = _loads_framed(data)
         return value, None
 
     def object_ready(self, oid: ObjectID) -> bool:
@@ -956,8 +1034,18 @@ class DistributedRuntime(Runtime):
 
     def _cluster_states(self, include_suspects: bool = False
                         ) -> List[NodeState]:
-        states = [self.local_node.state()]
         now = time.monotonic()
+        if not include_suspects:
+            # Micro-TTL memo: the schedulers call this once PER TASK, and
+            # rebuilding wrapper lists dominates the dispatch hot loop at
+            # thousands of tasks/s. The memoized NodeState objects wrap
+            # the SAME live NodeResources instances, so allocations made
+            # through the memo stay visible; staleness is bounded at 2 ms
+            # (vs the ~1 s heartbeat refresh feeding this view anyway).
+            memo = self._states_memo
+            if memo is not None and now - memo[0] < 0.002:
+                return memo[1]
+        states = [self.local_node.state()]
         with self._view_lock:
             for nid, info in self._view.items():
                 if not info.alive:
@@ -970,6 +1058,8 @@ class DistributedRuntime(Runtime):
                     nr = NodeResources(ResourceSet(dict(info.total.amounts)))
                     self._view_avail[nid] = nr
                 states.append(NodeState(NodeID(nid), nr, True))
+        if not include_suspects:
+            self._states_memo = (now, states)
         return states
 
     def _select_node(self, spec: TaskSpec) -> Optional[NodeID]:
@@ -1068,9 +1158,15 @@ class DistributedRuntime(Runtime):
         if addr is None:
             return "wait"
         request = self._effective_request(spec)
+        alloc = None
         if nr is not None and nr.can_fit(request):
-            nr.allocate(request)  # optimistic; corrected by next refresh
-        self._push_task_remote(spec, addr, cancel)
+            # Optimistic debit, credited back when THIS attempt settles —
+            # waiting for the ~1s heartbeat refresh to restore
+            # availability caps throughput at (queue depth / heartbeat
+            # period) regardless of how fast tasks actually finish.
+            nr.allocate(request)
+            alloc = (nid, request)
+        self._push_task_remote(spec, addr, cancel, alloc=alloc)
         with self.lock:
             self.task_states[spec.task_id] = "RUNNING"
         return "done"
@@ -1114,11 +1210,26 @@ class DistributedRuntime(Runtime):
     # ---------------------------------------------------- remote submission
 
     def _export_callable(self, fn) -> bytes:
+        # Hot path: re-pickling the SAME function object per submit just
+        # to recompute its content key costs ~30us/task. Identity-keyed
+        # weak cache short-circuits it (a mutated-in-place closure would
+        # be missed, but cloudpickle captures by value at decoration time
+        # anyway — the remote() wrapper pins one function object).
+        try:
+            key = self._fn_key_by_identity.get(fn)
+        except TypeError:  # unhashable/unweakrefable callable
+            key = None
+        if key is not None:
+            return key
         payload = cloudpickle.dumps(fn)
         key = _fn_key(payload)
         if key not in self._exported_fns:
             self.state.kv_put(key, payload, overwrite=False, namespace=FN_NS)
             self._exported_fns[key] = payload
+        try:
+            self._fn_key_by_identity[fn] = key
+        except TypeError:
+            pass
         return key
 
     def register_named_function(self, name: str, fn) -> None:
@@ -1272,7 +1383,7 @@ class DistributedRuntime(Runtime):
         super()._unpin_args(spec)
 
     def _push_task_remote(self, spec: TaskSpec, addr: str, cancel,
-                          method: int = pb.PUSH_TASK):
+                          method: int = pb.PUSH_TASK, alloc=None):
         msg, arg_pins = self._spec_to_msg(spec)
         # The re-serialization above re-pinned every arg ref; the previous
         # attempt's pins (held across the pending-queue wait) can go now.
@@ -1286,9 +1397,11 @@ class DistributedRuntime(Runtime):
             "spec": spec, "addr": addr, "cancel": cancel,
             "attempt": attempt, "arg_pins": arg_pins,
             "returns": set(spec.return_ids), "event": threading.Event(),
+            "alloc": alloc,
         }
         with self._inflight_lock:
             self._inflight_remote[key] = info
+            self._index_inflight(info)
 
         def _done(env, error):
             self._on_remote_reply(spec, attempt, addr, cancel, env, error)
@@ -1314,6 +1427,26 @@ class DistributedRuntime(Runtime):
         return (addr.rsplit(":", 1)[0]
                 == self.address.rsplit(":", 1)[0])
 
+    def _settle_view_alloc(self, info, credit: bool):
+        """Settle one push attempt's optimistic view debit, exactly once.
+        ``credit=True`` returns the capacity to the cached view (task left
+        the daemon); ``credit=False`` just discards the marker (a
+        spillback reply overwrote the view with authoritative numbers —
+        releasing on top would double-count). Any drift is self-
+        correcting: overcounts spill back, undercounts heal at the next
+        heartbeat refresh."""
+        if info is None:
+            return
+        with self._inflight_lock:
+            alloc = info.pop("alloc", None)
+        if not alloc or not credit:
+            return
+        nid, request = alloc
+        with self._view_lock:
+            nr = self._view_avail.get(nid)
+            if nr is not None:
+                nr.release(request)
+
     def _on_remote_reply(self, spec: TaskSpec, attempt: int, addr: str,
                          cancel, env, error):
         """Reply/error callback for one push attempt. Failure handling only
@@ -1327,7 +1460,9 @@ class DistributedRuntime(Runtime):
             # attempt's failure authority (NODE_DEAD raced us otherwise).
             with self._inflight_lock:
                 info = self._inflight_remote.pop(key, None)
+                self._unindex_inflight(info)
             if info is not None:
+                self._settle_view_alloc(info, credit=True)
                 try:
                     self._settle_push_failure(spec, attempt, addr, cancel,
                                               error, self._claim_pins(info))
@@ -1390,6 +1525,11 @@ class DistributedRuntime(Runtime):
         finally:
             with self._inflight_lock:
                 self._inflight_remote.pop(key, None)
+                self._unindex_inflight(info)
+            # Spillback replies carry the daemon's authoritative
+            # availability (already written to the view above): discard
+            # the debit marker instead of crediting on top of it.
+            self._settle_view_alloc(info, credit=not spilled)
             if info is not None:
                 if not spilled:
                     # Grace period: the executor's ADD_BORROW for any ref
@@ -1470,8 +1610,9 @@ class DistributedRuntime(Runtime):
         with self._inflight_lock:
             items = [(key, info) for key, info in self._inflight_remote.items()
                      if info["addr"] == addr]
-            for key, _ in items:
+            for key, info in items:
                 self._inflight_remote.pop(key, None)
+                self._unindex_inflight(info)
         for (tid, attempt), info in items:
             try:
                 self._settle_push_failure(info["spec"], attempt, addr,
@@ -2338,14 +2479,32 @@ class DistributedRuntime(Runtime):
         with self._fetch_cache_lock:
             hit = self._fetch_cache.get(oid)
             if hit is not None:
-                return hit
+                return hit[0]
         value = self.local_node.store.get(oid, timeout=0)
         payload = _dumps_framed(value)
         with self._fetch_cache_lock:
-            self._fetch_cache[oid] = payload
+            self._fetch_cache[oid] = [payload, None]
             while len(self._fetch_cache) > 8:
                 self._fetch_cache.pop(next(iter(self._fetch_cache)))
         return payload
+
+    def _fetch_arena_key(self, oid: ObjectID, payload: bytes) -> bytes:
+        """Content-bound arena key for a fetch payload, hashed ONCE per
+        cached serialization: blake2b over a multi-MB payload costs more
+        than the shm handoff itself, and the key is pure function of
+        (oid, payload) — the cache entry dies with the payload, so a
+        reconstructed object with different bytes gets a fresh key."""
+        with self._fetch_cache_lock:
+            entry = self._fetch_cache.get(oid)
+            if entry is not None and entry[0] is payload \
+                    and entry[1] is not None:
+                return entry[1]
+        key = self._arena_payload_key(oid, payload)
+        with self._fetch_cache_lock:
+            entry = self._fetch_cache.get(oid)
+            if entry is not None and entry[0] is payload:
+                entry[1] = key
+        return key
 
     def _handle_get_timeline(self, ctx: RpcContext):
         """Span-buffer fetch/control (cross-process trace propagation:
@@ -2500,7 +2659,7 @@ class DistributedRuntime(Runtime):
         if (req.offset == 0 and req.arena_key
                 and req.arena_key == self.host_arena_key
                 and self.host_arena is not None):
-            key = self._arena_payload_key(oid, payload)
+            key = self._fetch_arena_key(oid, payload)
             if (self.host_arena.contains(key)
                     or self._arena_put(key, payload)):
                 rep.in_arena = True
@@ -2509,9 +2668,12 @@ class DistributedRuntime(Runtime):
                 ctx.reply(rep.SerializeToString())
                 return
         end = min(len(payload), req.offset + (req.max_bytes or FETCH_CHUNK))
-        rep.data = bytes(payload[req.offset:end])  # payload is a bytearray
         rep.eof = end >= len(payload)
-        ctx.reply(rep.SerializeToString())
+        # Bulk lane: the chunk leaves via gather-write straight from the
+        # cached serialization — no per-chunk slice copy, no protobuf
+        # copy (rep.data stays empty; raw_len announces the bytes).
+        ctx.reply(rep.SerializeToString(),
+                  raw=memoryview(payload)[req.offset:end])
 
 
 _FETCH_MISS = object()

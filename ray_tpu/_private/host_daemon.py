@@ -20,6 +20,47 @@ import sys
 import time
 
 
+def _install_thread_profiler(out_dir: str):
+    """RAY_TPU_PROFILE_DIR=<dir>: cProfile EVERY thread of this daemon and
+    dump one .pstats per thread at exit (merge with pstats.Stats.add).
+    The hot paths run on the RPC pool and dispatcher threads, which
+    ordinary main-thread cProfile never sees."""
+    import atexit
+    import cProfile
+    import threading
+
+    os.makedirs(out_dir, exist_ok=True)
+    profiles = []
+    lock = threading.Lock()
+    orig_run = threading.Thread.run
+
+    def run(self):
+        prof = cProfile.Profile()
+        with lock:
+            profiles.append((self.name, prof))
+        try:
+            prof.runcall(orig_run, self)
+        finally:
+            pass
+
+    threading.Thread.run = run
+    main_prof = cProfile.Profile()
+    main_prof.enable()
+    profiles.append(("main", main_prof))
+
+    def dump():
+        main_prof.disable()
+        for i, (name, prof) in enumerate(list(profiles)):
+            safe = "".join(c if c.isalnum() else "_" for c in name)[:60]
+            try:
+                prof.dump_stats(os.path.join(
+                    out_dir, f"daemon{os.getpid()}_{i}_{safe}.pstats"))
+            except Exception:  # noqa: BLE001 - still-running thread etc.
+                pass
+
+    atexit.register(dump)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="ray_tpu host daemon")
     parser.add_argument("--state-addr", required=True,
@@ -38,6 +79,10 @@ def main(argv=None) -> int:
     logging.basicConfig(
         level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
         format="[daemon %(asctime)s] %(levelname)s %(message)s")
+
+    prof_dir = os.environ.get("RAY_TPU_PROFILE_DIR")
+    if prof_dir:
+        _install_thread_profiler(prof_dir)
 
     # Honor JAX_PLATFORMS even when a site hook already imported jax and a
     # device plugin claimed the default platform (the env var alone is read

@@ -51,14 +51,12 @@ class RpcRemoteError(RuntimeError):
     """The peer's handler raised; message carries the remote error string."""
 
 
-def _read_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(min(n - len(buf), 1 << 20))
-        if not chunk:
-            raise RpcConnectionError("connection closed by peer")
-        buf.extend(chunk)
-    return bytes(buf)
+def _read_exact(sock: socket.socket, n: int) -> bytearray:
+    """Read exactly ``n`` bytes with recv_into — no per-chunk allocation
+    or extend-copy (multi-MB fetch replies ride this path)."""
+    buf = bytearray(n)
+    recv_into_exact(sock, memoryview(buf))
+    return buf
 
 
 PRE_AUTH_MAX_FRAME = 1 << 16  # before auth, only a tiny AUTH frame is legal
@@ -79,13 +77,48 @@ def frame_bytes(env: pb.Envelope) -> bytes:
     return _LEN.pack(len(payload)) + payload
 
 
+def send_frame(sock: socket.socket, env: pb.Envelope,
+               raw=None) -> None:
+    """Write one frame with scatter-gather IO: the length prefix and the
+    serialized envelope go out in one sendmsg, WITHOUT concatenating (the
+    concat would copy every multi-MB payload a second time).
+
+    ``raw`` (bytes-like) rides the bulk lane: ``env.raw_len`` announces
+    it, and its bytes follow the envelope frame in the SAME gather write
+    — zero user-space copies of the payload on this side, and the
+    receiver recv_into's it straight into its destination buffer."""
+    if raw is not None:
+        env.raw_len = len(raw)
+    payload = env.SerializeToString()
+    pieces = [memoryview(_LEN.pack(len(payload))), memoryview(payload)]
+    if raw is not None and len(raw):
+        pieces.append(memoryview(raw).cast("B"))
+    while pieces:
+        sent = sock.sendmsg(pieces)
+        while pieces and sent >= len(pieces[0]):
+            sent -= len(pieces[0])
+            pieces.pop(0)
+        if pieces and sent:
+            pieces[0] = pieces[0][sent:]
+
+
+def recv_into_exact(sock: socket.socket, mv: memoryview) -> None:
+    got, n = 0, len(mv)
+    while got < n:
+        r = sock.recv_into(mv[got:], n - got)
+        if r == 0:
+            raise RpcConnectionError("connection closed by peer")
+        got += r
+
+
 class _Pending:
-    __slots__ = ("event", "env", "callback")
+    __slots__ = ("event", "env", "callback", "raw_sink")
 
     def __init__(self):
         self.event = threading.Event()
         self.env: Optional[pb.Envelope] = None
         self.callback = None
+        self.raw_sink = None  # fn(length) -> writable memoryview
 
 
 class RpcClient:
@@ -135,10 +168,15 @@ class RpcClient:
     # -- public ---------------------------------------------------------------
 
     def call(self, method: int, body: bytes = b"",
-             timeout: Optional[float] = None) -> pb.Envelope:
+             timeout: Optional[float] = None,
+             raw_sink=None) -> pb.Envelope:
         """Send a request, block for its reply. Raises RpcRemoteError on a
-        handler error, RpcConnectionError if the connection dies first."""
+        handler error, RpcConnectionError if the connection dies first.
+        ``raw_sink(length) -> memoryview``: where to land the reply's
+        bulk-lane bytes, filled before this returns (the caller keeps its
+        own reference to the buffer the sink handed out)."""
         pending = _Pending()
+        pending.raw_sink = raw_sink
         with self._plock:
             if self._closed:
                 raise RpcConnectionError(
@@ -165,11 +203,14 @@ class RpcClient:
 
     def call_async(self, method: int, body: bytes,
                    callback: Callable[[Optional[pb.Envelope],
-                                       Optional[Exception]], None]) -> None:
+                                       Optional[Exception]], None],
+                   raw_sink=None) -> None:
         """Fire a request; invoke ``callback(reply, None)`` or
-        ``callback(None, error)`` from the reader thread when done."""
+        ``callback(None, error)`` from the reader thread when done.
+        ``raw_sink`` as in :meth:`call` — filled before the callback."""
         pending = _Pending()
         pending.callback = callback  # type: ignore[attr-defined]
+        pending.raw_sink = raw_sink
         with self._plock:
             if self._closed:
                 callback(None, RpcConnectionError(
@@ -198,10 +239,9 @@ class RpcClient:
     # -- internals ------------------------------------------------------------
 
     def _send(self, env: pb.Envelope):
-        data = frame_bytes(env)
         with self._wlock:
             try:
-                self._sock.sendall(data)
+                send_frame(self._sock, env)
             except OSError as e:
                 raise RpcConnectionError(str(e)) from e
 
@@ -209,6 +249,26 @@ class RpcClient:
         try:
             while True:
                 env = read_frame(self._sock)
+                raw_pending = None
+                if env.raw_len:
+                    if env.raw_len > MAX_FRAME:
+                        raise RpcConnectionError(
+                            f"raw payload too large: {env.raw_len}")
+                    with self._plock:
+                        raw_pending = self._pending.get(env.seq)
+                    sink = (raw_pending.raw_sink
+                            if raw_pending is not None else None)
+                    mv = None
+                    if sink is not None:
+                        try:
+                            mv = sink(env.raw_len)
+                        except Exception:
+                            logger.exception("raw sink failed")
+                    if mv is not None and len(mv) == env.raw_len:
+                        recv_into_exact(self._sock, memoryview(mv))
+                    else:
+                        # No usable sink: drain to keep framing intact.
+                        _read_exact(self._sock, env.raw_len)
                 if env.seq == 0 and not env.reply:
                     if self._on_push is not None:
                         try:
@@ -272,12 +332,15 @@ class RpcContext:
         self.method = env.method
         self.seq = env.seq
         self.body = env.body
+        self.raw = None  # bulk-lane bytes of the REQUEST, if any
         self.peer = None  # set by server
         self._done = False
 
-    def reply(self, body: bytes = b""):
+    def reply(self, body: bytes = b"", raw=None):
+        """``raw``: bulk-lane payload (bytes-like); ships after the
+        envelope via gather-write — no protobuf copy of the bulk."""
         self._reply(pb.Envelope(seq=self.seq, method=self.method,
-                                reply=True, body=body))
+                                reply=True, body=body), raw=raw)
 
     def reply_error(self, message: str):
         self._reply(pb.Envelope(seq=self.seq, method=self.method,
@@ -286,17 +349,16 @@ class RpcContext:
     def push(self, method: int, body: bytes):
         """Unsolicited push to this connection (pubsub delivery)."""
         with self._wlock:
-            self._sock.sendall(frame_bytes(
-                pb.Envelope(seq=0, method=method, body=body)))
+            send_frame(self._sock,
+                       pb.Envelope(seq=0, method=method, body=body))
 
-    def _reply(self, env: pb.Envelope):
+    def _reply(self, env: pb.Envelope, raw=None):
         if self._done:
             return
         self._done = True
-        data = frame_bytes(env)
         try:
             with self._wlock:
-                self._sock.sendall(data)
+                send_frame(self._sock, env, raw=raw)
         except OSError:
             pass  # caller vanished; nothing to do
 
@@ -391,9 +453,16 @@ class RpcServer:
                     return
             while True:
                 env = read_frame(sock)
+                raw = None
+                if env.raw_len:
+                    if env.raw_len > MAX_FRAME:
+                        raise RpcConnectionError(
+                            f"raw payload too large: {env.raw_len}")
+                    raw = _read_exact(sock, env.raw_len)
                 if env.method == pb.AUTH:
                     continue  # redundant re-auth: ignore
                 ctx = RpcContext(self, sock, wlock, env)
+                ctx.raw = raw
                 ctx.conn_id = conn_id
                 if env.method in self._inline:
                     self._run_handler(ctx)

@@ -225,6 +225,8 @@ class Runtime:
         # both, or the cap is porous exactly when the backlog is deepest
         self._dispatch_pass_n = 0
         self._pending_cv = threading.Condition()
+        self._dispatch_mutex = threading.Lock()  # single-dispatcher guard
+        self._inline_dispatch = bool(_config.get("inline_dispatch"))
         self._dispatch_dirty = False  # kick arrived while loop was busy
         # Per-task completion hooks, fired once when a task reaches a final
         # state (FINISHED/FAILED/CANCELLED, not retries). The host daemon
@@ -446,12 +448,41 @@ class Runtime:
             self.task_states[spec.task_id] = "PENDING"
             cancel = self.cancel_flags.setdefault(spec.task_id, threading.Event())
         # Pin argument objects for the duration of the task.
-        for oid in _ref_ids_in(spec.args, spec.kwargs):
+        refs = _ref_ids_in(spec.args, spec.kwargs)
+        for oid in refs:
             self.reference_counter.pin_for_task(oid)
+        item = {"spec": spec, "cancel": cancel}
+        # Inline fast path: a ref-free task whose dispatch decision is
+        # immediate skips the queue + dispatcher-thread hop (two context
+        # switches per task — the dominant per-task cost at high rates on
+        # busy hosts). The dispatch mutex preserves the single-dispatcher
+        # invariant (allocation math is not self-synchronized); tasks
+        # with ref deps keep the queue path so dependency probes never
+        # run on the submitter's thread.
+        if not refs and self._inline_dispatch and self._dispatch_now(item):
+            return list(spec.return_ids)
         with self._pending_cv:
-            self._pending.append({"spec": spec, "cancel": cancel})
+            self._pending.append(item)
             self._pending_cv.notify_all()
         return list(spec.return_ids)
+
+    def _dispatch_now(self, item: dict) -> bool:
+        # A free mutex is NOT enough: a non-empty backlog means older
+        # tasks are parked awaiting capacity, and inlining a newcomer
+        # would let it jump the queue (and under a sustained stream,
+        # starve the backlog).
+        with self._pending_cv:
+            if self._pending or self._dispatch_pass_n:
+                return False
+        if not self._dispatch_mutex.acquire(blocking=False):
+            return False  # dispatcher mid-pass: just queue
+        try:
+            action = self._try_dispatch(item)
+        except Exception:  # Infeasible & friends: the loop's policy owns
+            return False   # error handling — re-run it there
+        finally:
+            self._dispatch_mutex.release()
+        return action == "done"
 
     def cancel_task(self, task_id: TaskID, force: bool = False):
         with self.lock:
@@ -472,7 +503,8 @@ class Runtime:
             still_waiting = []
             for item in pending:
                 try:
-                    action = self._try_dispatch(item)
+                    with self._dispatch_mutex:
+                        action = self._try_dispatch(item)
                 except Infeasible as e:
                     if self.autoscaling_enabled:
                         # The cluster can grow: keep infeasible tasks
