@@ -47,6 +47,8 @@ class RunConfig:
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
     verbose: int = 1
+    # tune.SyncConfig: mirror the experiment dir to durable storage
+    sync_config: Optional[Any] = None
 
 
 @dataclass

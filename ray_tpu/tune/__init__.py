@@ -17,6 +17,9 @@ from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
 from ray_tpu.tune.search import (BasicVariantGenerator, BayesOptSearch,
                                  ConcurrencyLimiter, HyperOptSearch,
                                  OptunaSearch, Repeater, Searcher)
+from ray_tpu.tune.bohb import BOHBSearcher, HyperBandForBOHB
+from ray_tpu.tune.pb2 import PB2
+from ray_tpu.tune.syncer import SyncConfig, Syncer
 from ray_tpu.tune.tpe import TPESearcher
 from ray_tpu.tune.session import get_checkpoint, get_trial_id, report
 from ray_tpu.tune.trainable import FunctionTrainable, Trainable, wrap_function
@@ -32,5 +35,6 @@ __all__ = [
     "MedianStoppingRule", "PopulationBasedTraining", "TrialScheduler",
     "BasicVariantGenerator", "ConcurrencyLimiter", "Repeater", "Searcher",
     "TPESearcher", "OptunaSearch", "HyperOptSearch", "BayesOptSearch",
+    "BOHBSearcher", "HyperBandForBOHB", "PB2", "SyncConfig", "Syncer",
     "ExperimentAnalysis", "ResultGrid",
 ]

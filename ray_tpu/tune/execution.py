@@ -73,7 +73,8 @@ class TrialRunner:
                  local_dir: Optional[str] = None,
                  experiment_name: str = "experiment",
                  searcher=None,
-                 time_budget_s: Optional[float] = None):
+                 time_budget_s: Optional[float] = None,
+                 sync_config=None):
         import cloudpickle
         self._trainable_cls = _as_trainable_cls(trainable)
         self._trainable_bytes = cloudpickle.dumps(self._trainable_cls)
@@ -112,6 +113,9 @@ class TrialRunner:
             self._max_concurrent = max_concurrent_trials
         else:
             self._max_concurrent = self._derive_concurrency()
+        from ray_tpu.tune.syncer import _SyncerState
+        self._syncer = _SyncerState(sync_config, self.experiment_dir,
+                                    experiment_name)
         for t in self.trials:
             self.scheduler.on_trial_add(t)
 
@@ -266,7 +270,14 @@ class TrialRunner:
                 continue
             trial = inflight[ready[0]]
             self._process_result(trial, ready[0])
+            self._syncer.maybe_sync()
         self.save_experiment_state()
+        if (self._syncer.syncer is not None
+                and not self._syncer.maybe_sync(force=True)):
+            import logging
+            logging.getLogger("ray_tpu").warning(
+                "experiment sync to %s FAILED — the durable mirror is "
+                "missing or partial", self._syncer.remote)
         return self.trials
 
     def _over_budget(self) -> bool:

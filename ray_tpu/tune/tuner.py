@@ -54,6 +54,7 @@ def run(trainable,
         time_budget_s: Optional[float] = None,
         verbose: int = 1,
         resume_from: Optional[str] = None,
+        sync_config=None,
         seed: Optional[int] = None) -> ExperimentAnalysis:
     """Run an experiment (reference ``tune/tune.py:run``)."""
     if not ray_tpu.is_initialized():
@@ -89,7 +90,7 @@ def run(trainable,
         checkpoint_at_end=checkpoint_at_end,
         resources_per_trial=resources_per_trial, callbacks=callbacks,
         local_dir=local_dir, experiment_name=name, searcher=searcher,
-        time_budget_s=time_budget_s)
+        time_budget_s=time_budget_s, sync_config=sync_config)
     finished = runner.run()
     return ExperimentAnalysis(finished, metric=metric, mode=mode)
 
@@ -134,6 +135,7 @@ class Tuner:
             name=self.run_config.name,
             time_budget_s=tc.time_budget_s,
             resume_from=self._restore_path,
+            sync_config=getattr(self.run_config, "sync_config", None),
             seed=tc.seed,
         )
         return ResultGrid(analysis)

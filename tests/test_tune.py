@@ -502,3 +502,140 @@ def test_hyperband_min_mode_survives_run_default(tmp_path):
     iters = {t.config["q"]: len(t.results) for t in analysis.trials}
     assert iters[1] == 9      # lowest loss runs to max_t
     assert iters[9] == 1      # highest loss cut at the first milestone
+
+
+# ---------------------------------------------------------------- BOHB
+def test_bohb_multi_fidelity_model_selection():
+    """The model must be fit on the LARGEST budget with enough points —
+    low-budget observations that MISLEAD (inverted scores) must be
+    superseded once full-budget evidence accumulates."""
+    from ray_tpu.tune import BOHBSearcher
+    s = BOHBSearcher({"x": tune.uniform(0, 10)}, metric="score", mode="max",
+                     num_samples=80, min_points_in_model=5,
+                     random_fraction=0.0, seed=3)
+    # Budget 1: misleading (higher x looks better). Budget 9: truth
+    # (optimum near x=2).
+    for i in range(10):
+        cfg = s.suggest(f"w{i}")
+        s.on_trial_result(f"w{i}", {"score": cfg["x"],
+                                    "training_iteration": 1})
+        s.on_trial_complete(f"w{i}", {
+            "score": -abs(cfg["x"] - 2.0), "training_iteration": 9})
+    xs = []
+    for i in range(30):
+        cfg = s.suggest(f"t{i}")
+        xs.append(cfg["x"])
+        s.on_trial_complete(f"t{i}", {
+            "score": -abs(cfg["x"] - 2.0), "training_iteration": 9})
+    # most late suggestions should cluster near the true optimum, not 10
+    near = sum(1 for x in xs[-15:] if abs(x - 2.0) < 2.5)
+    assert near >= 9, xs
+
+
+def test_bohb_in_tune_run(tmp_path):
+    from ray_tpu.tune import BOHBSearcher, HyperBandForBOHB
+
+    def trainable(config):
+        for i in range(10):
+            tune.report(score=-abs(config["x"] - 3.0) * (i + 1))
+
+    analysis = tune.run(
+        trainable, config={"x": tune.uniform(0, 10)},
+        num_samples=12, metric="score", mode="max",
+        scheduler=HyperBandForBOHB(max_t=9, reduction_factor=3),
+        search_alg=BOHBSearcher(metric="score", mode="max",
+                                min_points_in_model=3, seed=0),
+        max_concurrent_trials=3, local_dir=str(tmp_path), verbose=0)
+    assert len(analysis.trials) == 12
+    assert all(t.status == TERMINATED for t in analysis.trials)
+    assert analysis.get_best_trial() is not None
+
+
+# ---------------------------------------------------------------- PB2
+def test_pb2_requires_bounds_and_respects_them():
+    from ray_tpu.tune import PB2
+    with pytest.raises(ValueError):
+        PB2(metric="score", mode="max")
+    sched = PB2(metric="score", mode="max",
+                hyperparam_bounds={"lr": [0.01, 1.0]}, seed=0)
+    # GP-free (no data) and GP-fit paths both stay inside the box.
+    for trial_no in range(6):
+        cfg = sched._select_config({"lr": 0.5})
+        assert 0.01 <= cfg["lr"] <= 1.0
+        sched._data.append(
+            (float(trial_no), sched._param_vec({"lr": 0.1 * trial_no}),
+             float(trial_no)))
+
+
+def test_pb2_exploits_and_learns(tmp_path):
+    from ray_tpu.tune import PB2
+
+    class T(tune.Trainable):
+        def setup(self, config):
+            self.weight = 0.0
+
+        def step(self):
+            self.weight += self.config["lr"]
+            return {"score": self.weight, "done": self.iteration >= 14}
+
+        def save_checkpoint(self, d):
+            return {"weight": self.weight}
+
+        def load_checkpoint(self, data):
+            self.weight = data["weight"]
+
+    sched = PB2(perturbation_interval=3,
+                hyperparam_bounds={"lr": [0.05, 5.0]}, seed=0)
+    analysis = tune.run(T, config={"lr": tune.uniform(0.05, 5.0)},
+                        num_samples=4, metric="score", mode="max",
+                        scheduler=sched, checkpoint_freq=1,
+                        max_concurrent_trials=4, local_dir=str(tmp_path),
+                        verbose=0, seed=1)
+    assert all(t.status == TERMINATED for t in analysis.trials)
+    assert sched._data, "GP observations were collected"
+    best = analysis.get_best_trial()
+    assert best.last_result["score"] > 0.05 * 15
+
+
+# ---------------------------------------------------------------- syncer
+def test_sync_config_mirrors_experiment_dir(tmp_path):
+    from ray_tpu.tune import SyncConfig
+
+    def trainable(config):
+        for i in range(3):
+            tune.report(v=i)
+
+    upload = tmp_path / "durable"
+    analysis = tune.run(trainable, config={"x": tune.grid_search([1, 2])},
+                        metric="v", mode="max", name="synced",
+                        local_dir=str(tmp_path / "local"),
+                        sync_config=SyncConfig(upload_dir=str(upload),
+                                               sync_period=0.0),
+                        verbose=0)
+    assert len(analysis.trials) == 2
+    mirrored = upload / "synced"
+    assert (mirrored / "experiment_state.json").exists()
+    # trial logdirs came along too
+    assert any(p.is_dir() for p in mirrored.iterdir())
+
+
+def test_syncer_incremental_and_schemes(tmp_path):
+    from ray_tpu.tune.syncer import SyncConfig, _LocalMirrorSyncer
+    src = tmp_path / "src"
+    dst = tmp_path / "dst"
+    src.mkdir()
+    (src / "a.txt").write_text("one")
+    s = _LocalMirrorSyncer()
+    assert s.sync_up(str(src), f"file://{dst}")
+    assert (dst / "a.txt").read_text() == "one"
+    # unchanged file is skipped (mtime preserved by copy2)
+    before = (dst / "a.txt").stat().st_mtime_ns
+    assert s.sync_up(str(src), str(dst))
+    assert (dst / "a.txt").stat().st_mtime_ns == before
+    # unknown scheme without explicit syncer is an error
+    with pytest.raises(ValueError):
+        SyncConfig(upload_dir="s3://bucket/x").get_syncer()
+    # sync_down restores
+    restored = tmp_path / "restored"
+    assert s.sync_down(str(dst), str(restored))
+    assert (restored / "a.txt").read_text() == "one"
