@@ -27,6 +27,7 @@
 #include <cstdint>
 #include <cstring>
 #include <memory>
+#include <mutex>
 #include <random>
 #include <stdexcept>
 #include <string>
@@ -162,6 +163,9 @@ inline HostPort SplitAddr(const std::string& addr) {
   return {addr.substr(0, pos), std::stoi(addr.substr(pos + 1))};
 }
 
+class TaskCaller;
+class ActorCreator;
+
 class RayTpuClient {
  public:
   RayTpuClient(const std::string& state_addr, const std::string& token)
@@ -171,9 +175,22 @@ class RayTpuClient {
     job_id_ = RandomBytes(4);
   }
 
+  // Typed API entry points (defined after TaskCaller/ActorCreator).
+  TaskCaller Task(const std::string& function_name);
+  ActorCreator Actor(const std::string& registered_class);
+
+  std::string RandomHex(size_t n) {
+    static const char* hex = "0123456789abcdef";
+    std::lock_guard<std::mutex> g(rng_mu_);
+    std::string out;
+    std::uniform_int_distribution<int> d(0, 15);
+    for (size_t i = 0; i < n; ++i) out += hex[d(rng_)];
+    return out;
+  }
+
   // -- cluster introspection ------------------------------------------
   std::vector<raytpu::NodeInfo> ListNodes() {
-    raytpu::Envelope rep = state_->Call(raytpu::LIST_NODES, "");
+    raytpu::Envelope rep = StateCall(raytpu::LIST_NODES, "");
     raytpu::ListNodesReply nodes;
     nodes.ParseFromString(rep.body());
     std::vector<raytpu::NodeInfo> out;
@@ -190,7 +207,7 @@ class RayTpuClient {
     std::string body;
     req.SerializeToString(&body);
     raytpu::KvPutReply kp;
-    kp.ParseFromString(state_->Call(raytpu::KV_PUT, body).body());
+    kp.ParseFromString(StateCall(raytpu::KV_PUT, body).body());
     return kp.added();
   }
 
@@ -200,7 +217,7 @@ class RayTpuClient {
     std::string body;
     req.SerializeToString(&body);
     raytpu::KvGetReply kg;
-    kg.ParseFromString(state_->Call(raytpu::KV_GET, body).body());
+    kg.ParseFromString(StateCall(raytpu::KV_GET, body).body());
     return kg.found() ? kg.value() : "";
   }
 
@@ -272,7 +289,15 @@ class RayTpuClient {
   }
 
  private:
+  // The state connection is shared by every thread of the typed API
+  // (ObjectRef futures submit concurrently): one call at a time.
+  raytpu::Envelope StateCall(raytpu::Method m, const std::string& body) {
+    std::lock_guard<std::mutex> g(state_mu_);
+    return state_->Call(m, body);
+  }
+
   std::string RandomBytes(size_t n) {
+    std::lock_guard<std::mutex> g(rng_mu_);
     std::string out(n, '\0');
     std::uniform_int_distribution<int> d(0, 255);
     for (size_t i = 0; i < n; ++i)
@@ -283,8 +308,308 @@ class RayTpuClient {
   std::string token_;
   std::string job_id_;
   std::unique_ptr<Connection> state_;
+  std::mutex state_mu_;
+  std::mutex rng_mu_;
   std::mt19937_64 rng_;
 };
+
+}  // namespace raytpu_cpp
+
+// ---------------------------------------------------------------------------
+// Typed task/actor API — the surface of the reference's C++ frontend
+// (cpp/include/ray/api/task_caller.h:1, actor_creator.h:1,
+// object_ref.h:1), on this runtime's cross-language contract:
+//
+//   raytpu_cpp::RayTpuClient client(addr, token);
+//   auto ref = client.Task("py_fn").Remote<int64_t>(2, 3);   // non-blocking
+//   int64_t five = ref.Get();                                // typed wait
+//   auto counter = client.Actor("Counter").Remote(10);       // named class
+//   int64_t v = counter.Call<int64_t>("add", 5).Get();
+//   counter.Kill();
+//
+// Arguments are serialized with typed JSON encoders (no stringly-typed
+// payload assembly in user code); results decode into the ObjectRef's
+// type parameter. Execution stays on the Python daemons — the typed
+// layer is the driver-side contract, matching the runtime's
+// "Python defines, any language drives" model (worker.py
+// register_named_actor_class).
+// ---------------------------------------------------------------------------
+
+#include <cmath>
+#include <cstdio>
+#include <future>
+#include <limits>
+#include <sstream>
+
+namespace raytpu_cpp {
+
+// ---- typed JSON encode ----------------------------------------------------
+inline void JsonEncode(std::ostringstream& o, int64_t v) { o << v; }
+inline void JsonEncode(std::ostringstream& o, int v) { o << v; }
+inline void JsonEncode(std::ostringstream& o, double v) {
+  if (!std::isfinite(v))
+    throw std::runtime_error("JSON cannot carry inf/nan arguments");
+  o.precision(std::numeric_limits<double>::max_digits10);
+  o << v;
+}
+inline void JsonEncode(std::ostringstream& o, bool v) {
+  o << (v ? "true" : "false");
+}
+inline void JsonEncode(std::ostringstream& o, const std::string& v) {
+  o << '"';
+  for (char c : v) {
+    unsigned char u = static_cast<unsigned char>(c);
+    switch (c) {
+      case '"': o << "\\\""; break;
+      case '\\': o << "\\\\"; break;
+      case '\n': o << "\\n"; break;
+      case '\t': o << "\\t"; break;
+      case '\r': o << "\\r"; break;
+      case '\b': o << "\\b"; break;
+      case '\f': o << "\\f"; break;
+      default:
+        if (u < 0x20) {  // remaining C0 controls: strict JSON requires \u
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", u);
+          o << buf;
+        } else {
+          o << c;  // UTF-8 bytes pass through verbatim
+        }
+    }
+  }
+  o << '"';
+}
+inline void JsonEncode(std::ostringstream& o, const char* v) {
+  JsonEncode(o, std::string(v));
+}
+template <typename T>
+inline void JsonEncode(std::ostringstream& o, const std::vector<T>& v) {
+  o << '[';
+  for (size_t i = 0; i < v.size(); ++i) {
+    if (i) o << ", ";
+    JsonEncode(o, v[i]);
+  }
+  o << ']';
+}
+
+inline void EncodeArgsInto(std::ostringstream&) {}
+template <typename A, typename... Rest>
+inline void EncodeArgsInto(std::ostringstream& o, A&& a, Rest&&... rest) {
+  JsonEncode(o, std::forward<A>(a));
+  if (sizeof...(rest)) o << ", ";
+  EncodeArgsInto(o, std::forward<Rest>(rest)...);
+}
+template <typename... Args>
+inline std::string EncodeArgs(Args&&... args) {
+  std::ostringstream o;
+  o << '[';
+  EncodeArgsInto(o, std::forward<Args>(args)...);
+  o << ']';
+  return o.str();
+}
+
+// ---- typed JSON decode (scalars + flat arrays — the named-function
+// result contract; nested structures stay strings for the caller) ---------
+inline std::string JsonTrim(const std::string& s) {
+  size_t a = s.find_first_not_of(" \t\n\r");
+  size_t b = s.find_last_not_of(" \t\n\r");
+  return a == std::string::npos ? "" : s.substr(a, b - a + 1);
+}
+
+template <typename T>
+T JsonDecode(const std::string& json);
+
+template <>
+inline int64_t JsonDecode<int64_t>(const std::string& json) {
+  return std::stoll(JsonTrim(json));
+}
+template <>
+inline double JsonDecode<double>(const std::string& json) {
+  return std::stod(JsonTrim(json));
+}
+template <>
+inline bool JsonDecode<bool>(const std::string& json) {
+  std::string t = JsonTrim(json);
+  if (t == "true") return true;
+  if (t == "false") return false;
+  throw std::runtime_error("not a JSON bool: " + t);
+}
+template <>
+inline std::string JsonDecode<std::string>(const std::string& json) {
+  std::string t = JsonTrim(json);
+  if (t.size() < 2 || t.front() != '"' || t.back() != '"')
+    throw std::runtime_error("not a JSON string: " + t);
+  std::string out;
+  for (size_t i = 1; i + 1 < t.size(); ++i) {
+    if (t[i] != '\\' || i + 2 >= t.size()) {
+      out += t[i];
+      continue;
+    }
+    char n = t[++i];
+    switch (n) {
+      case 'n': out += '\n'; break;
+      case 't': out += '\t'; break;
+      case 'r': out += '\r'; break;
+      case 'b': out += '\b'; break;
+      case 'f': out += '\f'; break;
+      case '"': out += '"'; break;
+      case '\\': out += '\\'; break;
+      case '/': out += '/'; break;
+      case 'u': {
+        // \uXXXX (Python json.dumps default ensure_ascii escapes all
+        // non-ASCII this way) -> UTF-8. Surrogate pairs for astral
+        // planes are combined when both halves are present.
+        if (i + 4 >= t.size())
+          throw std::runtime_error("truncated \\u escape");
+        unsigned cp = std::stoul(t.substr(i + 1, 4), nullptr, 16);
+        i += 4;
+        if (cp >= 0xD800 && cp <= 0xDBFF && i + 6 < t.size() &&
+            t[i + 1] == '\\' && t[i + 2] == 'u') {
+          unsigned lo = std::stoul(t.substr(i + 3, 4), nullptr, 16);
+          if (lo >= 0xDC00 && lo <= 0xDFFF) {
+            cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+            i += 6;
+          }
+        }
+        if (cp < 0x80) {
+          out += static_cast<char>(cp);
+        } else if (cp < 0x800) {
+          out += static_cast<char>(0xC0 | (cp >> 6));
+          out += static_cast<char>(0x80 | (cp & 0x3F));
+        } else if (cp < 0x10000) {
+          out += static_cast<char>(0xE0 | (cp >> 12));
+          out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+          out += static_cast<char>(0x80 | (cp & 0x3F));
+        } else {
+          out += static_cast<char>(0xF0 | (cp >> 18));
+          out += static_cast<char>(0x80 | ((cp >> 12) & 0x3F));
+          out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+          out += static_cast<char>(0x80 | (cp & 0x3F));
+        }
+        break;
+      }
+      default: out += n;
+    }
+  }
+  return out;
+}
+template <>
+inline std::vector<int64_t> JsonDecode<std::vector<int64_t>>(
+    const std::string& json) {
+  std::string t = JsonTrim(json);
+  if (t.size() < 2 || t.front() != '[' || t.back() != ']')
+    throw std::runtime_error("not a JSON array: " + t);
+  std::vector<int64_t> out;
+  std::stringstream ss(t.substr(1, t.size() - 2));
+  std::string item;
+  while (std::getline(ss, item, ','))
+    if (!JsonTrim(item).empty()) out.push_back(std::stoll(JsonTrim(item)));
+  return out;
+}
+
+// ---- ObjectRef<T> (object_ref.h role) ------------------------------------
+template <typename T>
+class ObjectRef {
+ public:
+  explicit ObjectRef(std::shared_future<std::string> json)
+      : json_(std::move(json)) {}
+  // Blocks for the task reply, decodes into T. Task errors rethrow here
+  // (the future carries the submission thread's exception).
+  T Get() const { return JsonDecode<T>(json_.get()); }
+  // Raw JSON, for nested results the scalar decoders don't cover.
+  std::string GetJson() const { return json_.get(); }
+
+ private:
+  std::shared_future<std::string> json_;
+};
+
+// ---- TaskCaller (task_caller.h role) -------------------------------------
+class RayTpuClient;  // fwd
+
+class TaskCaller {
+ public:
+  TaskCaller(RayTpuClient* client, std::string fn)
+      : client_(client), fn_(std::move(fn)) {}
+  // Non-blocking: submission runs on its own thread; the ObjectRef's
+  // future resolves with the task's JSON result.
+  template <typename R, typename... Args>
+  ObjectRef<R> Remote(Args&&... args);
+
+ private:
+  RayTpuClient* client_;
+  std::string fn_;
+};
+
+// ---- actors (actor_creator.h / actor_handle.h roles) ---------------------
+class ActorHandle {
+ public:
+  ActorHandle(RayTpuClient* client, std::string name)
+      : client_(client), name_(std::move(name)) {}
+  const std::string& Name() const { return name_; }
+  template <typename R, typename... Args>
+  ObjectRef<R> Call(const std::string& method, Args&&... args);
+  void Kill();
+
+ private:
+  RayTpuClient* client_;
+  std::string name_;
+};
+
+class ActorCreator {
+ public:
+  ActorCreator(RayTpuClient* client, std::string cls)
+      : client_(client), cls_(std::move(cls)) {}
+  // Creates a NAMED actor from the Python-registered class; the handle
+  // routes calls by that name from any connection.
+  template <typename... Args>
+  ActorHandle Remote(Args&&... args);
+
+ private:
+  RayTpuClient* client_;
+  std::string cls_;
+};
+
+// ---- definitions (RayTpuClient is complete here) --------------------------
+inline TaskCaller RayTpuClient::Task(const std::string& function_name) {
+  return TaskCaller(this, function_name);
+}
+inline ActorCreator RayTpuClient::Actor(const std::string& cls) {
+  return ActorCreator(this, cls);
+}
+
+template <typename R, typename... Args>
+ObjectRef<R> TaskCaller::Remote(Args&&... args) {
+  std::string args_json = EncodeArgs(std::forward<Args>(args)...);
+  RayTpuClient* c = client_;
+  std::string fn = fn_;
+  return ObjectRef<R>(std::async(std::launch::async, [c, fn, args_json] {
+                        return c->SubmitTask(fn, args_json);
+                      }).share());
+}
+
+template <typename... Args>
+ActorHandle ActorCreator::Remote(Args&&... args) {
+  // Creation blocks until the daemon's reply: the returned handle must
+  // be immediately callable (the name is registered at creation time).
+  std::string name = cls_ + "-" + client_->RandomHex(12);
+  client_->SubmitTask("__actor_new__::" + cls_,
+                      EncodeArgs(name, std::forward<Args>(args)...));
+  return ActorHandle(client_, name);
+}
+
+template <typename R, typename... Args>
+ObjectRef<R> ActorHandle::Call(const std::string& method, Args&&... args) {
+  std::string args_json =
+      EncodeArgs(name_, method, std::forward<Args>(args)...);
+  RayTpuClient* c = client_;
+  return ObjectRef<R>(std::async(std::launch::async, [c, args_json] {
+                        return c->SubmitTask("__actor_call__", args_json);
+                      }).share());
+}
+
+inline void ActorHandle::Kill() {
+  client_->SubmitTask("__actor_kill__", EncodeArgs(name_));
+}
 
 }  // namespace raytpu_cpp
 
@@ -293,14 +618,35 @@ class RayTpuClient {
 //   - lists nodes
 //   - round-trips the KV
 //   - calls the Python-registered named function "cpp_add" with [2, 3]
+//
+// Typed mode: raytpu_cpp_demo <state_addr> --typed [token]
+//   - Task("cpp_add").Remote<int64_t>(2, 3) -> ObjectRef<int64_t>
+//   - Actor("Counter").Remote(10) -> typed method calls -> Kill()
 int main(int argc, char** argv) {
   if (argc < 2) {
-    fprintf(stderr, "usage: %s <state_addr> [token]\n", argv[0]);
+    fprintf(stderr, "usage: %s <state_addr> [--typed] [token]\n", argv[0]);
     return 2;
   }
-  std::string token = argc > 2 ? argv[2] : "";
+  bool typed = argc > 2 && std::string(argv[2]) == "--typed";
+  std::string token = typed ? (argc > 3 ? argv[3] : "")
+                            : (argc > 2 ? argv[2] : "");
   try {
     raytpu_cpp::RayTpuClient client(argv[1], token);
+    if (typed) {
+      auto sum = client.Task("cpp_add").Remote<int64_t>(2, 3);
+      printf("typed_add=%lld\n", static_cast<long long>(sum.Get()));
+      auto counter = client.Actor("Counter").Remote(int64_t{10});
+      printf("actor_name=%s\n", counter.Name().c_str());
+      auto a = counter.Call<int64_t>("add", int64_t{5});
+      printf("counter_add=%lld\n", static_cast<long long>(a.Get()));
+      auto b = counter.Call<int64_t>("add", int64_t{7});
+      printf("counter_add2=%lld\n", static_cast<long long>(b.Get()));
+      auto t = counter.Call<int64_t>("total");
+      printf("counter_total=%lld\n", static_cast<long long>(t.Get()));
+      counter.Kill();
+      printf("typed-ok\n");
+      return 0;
+    }
     auto nodes = client.ListNodes();
     printf("nodes=%zu\n", nodes.size());
     client.KvPut("cpp-kv-key", "from-cpp");

@@ -346,3 +346,47 @@ def register_named_function(name: str, fn=None):
                            "(init(address=...) or a daemon)")
     reg(name, fn)
     return fn
+
+
+def register_named_actor_class(name: str, cls=None):
+    """Publish an actor class for cross-language callers — the typed C++
+    ``Actor("name").Remote(args...)`` surface (reference
+    ``cpp/include/ray/api/actor_creator.h:1`` role, shaped for this
+    runtime's contract: Python defines the class, any language drives
+    it). Usable as a decorator::
+
+        @ray_tpu.register_named_actor_class("Counter")
+        class Counter: ...
+
+    Under the hood three named functions carry the actor protocol over
+    JSON: ``__actor_new__::<name>`` creates a NAMED actor from the
+    registered class (the daemon executing the creation owns it; the
+    name makes it reachable from every process), and the generic
+    ``__actor_call__`` / ``__actor_kill__`` route method calls and
+    termination through ``get_actor`` — the ordinary, fully-tested
+    Python actor path."""
+    if cls is None:
+        def deco(c):
+            register_named_actor_class(name, c)
+            return c
+        return deco
+
+    import ray_tpu
+
+    def _new(actor_name, *args):
+        remote_cls = ray_tpu.remote(cls)
+        remote_cls.options(name=actor_name).remote(*args)
+        return actor_name
+
+    def _call(actor_name, method, *args):
+        h = ray_tpu.get_actor(actor_name)
+        return ray_tpu.get(getattr(h, method).remote(*args))
+
+    def _kill(actor_name):
+        ray_tpu.kill(ray_tpu.get_actor(actor_name))
+        return True
+
+    register_named_function(f"__actor_new__::{name}", _new)
+    register_named_function("__actor_call__", _call)
+    register_named_function("__actor_kill__", _kill)
+    return cls

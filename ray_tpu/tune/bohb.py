@@ -20,24 +20,23 @@ random instead (keeps the bandit honest, per the paper).
 from __future__ import annotations
 
 import math
-import random
 from typing import Any, Dict, List, Optional, Tuple
 
-from ray_tpu.tune.sample import (Categorical, Domain, Float, Integer,
-                                 Quantized, _is_grid)
 from ray_tpu.tune.schedulers import HyperBandScheduler
-from ray_tpu.tune.search import Searcher, _set_path, _walk
-from ray_tpu.tune.tpe import _CategoricalDim, _NumericDim
+from ray_tpu.tune.tpe import TPESearcher
 
 
-class BOHBSearcher(Searcher):
+class BOHBSearcher(TPESearcher):
     """Model-based searcher for HyperBand-style multi-fidelity runs.
 
-    Use with ``HyperBandForBOHB`` (or any banded scheduler): the runner
-    feeds every intermediate result through ``on_trial_result``, which is
+    Space compilation, Parzen proposal machinery, and the suggest loop
+    are inherited from :class:`TPESearcher`; only observation management
+    (per-budget buckets) and model selection differ. Use with
+    ``HyperBandForBOHB`` (or any banded scheduler): the runner feeds
+    every intermediate result through ``on_trial_result``, which is
     where the per-budget observation sets are built — completion-only
-    feedback would discard exactly the low-budget evidence BOHB exists to
-    exploit.
+    feedback would discard exactly the low-budget evidence BOHB exists
+    to exploit.
     """
 
     def __init__(self, space: Optional[Dict[str, Any]] = None,
@@ -48,56 +47,16 @@ class BOHBSearcher(Searcher):
                  gamma: float = 0.25, n_candidates: int = 24,
                  random_fraction: float = 1.0 / 3.0,
                  seed: Optional[int] = None):
-        super().__init__(metric, mode)
+        super().__init__(space, metric, mode, num_samples=num_samples,
+                         gamma=gamma, n_candidates=n_candidates, seed=seed)
         self.time_attr = time_attr
         self.min_points = min_points_in_model
-        self.gamma = gamma
-        self.n_candidates = n_candidates
         self.random_fraction = random_fraction
-        self._rng = random.Random(seed)
-        self._budget = num_samples
-        self._suggested = 0
-        self._dims: List[Tuple[Tuple, Any]] = []
-        self._passthrough: List[Tuple[Tuple, Any]] = []
         # budget -> list of (flat_config, score); scores normalized to
         # higher-is-better.
         self._obs_by_budget: Dict[float, List[Tuple[Dict, float]]] = {}
-        self._pending: Dict[str, Dict[Tuple, Any]] = {}
-        if space:
-            self._compile(space)
 
-    # -- space (same compilation rules as TPESearcher) -------------------
-    def set_space(self, space: Optional[Dict[str, Any]],
-                  num_samples: Optional[int] = None):
-        if num_samples is not None:
-            self._budget = num_samples
-        if space:
-            self._compile(space)
-
-    def _compile(self, space: Dict[str, Any]):
-        self._dims, self._passthrough = [], []
-        for path, v in _walk(space):
-            if _is_grid(v):
-                self._dims.append((path, _CategoricalDim(v["grid_search"])))
-            elif isinstance(v, Quantized):
-                inner = v.inner
-                upper = (inner.upper - 1 if isinstance(inner, Integer)
-                         else inner.upper)
-                self._dims.append((path, _NumericDim(
-                    inner.lower, upper, getattr(inner, "log", False),
-                    isinstance(inner, Integer), q=v.q)))
-            elif isinstance(v, Float):
-                self._dims.append((path, _NumericDim(
-                    v.lower, v.upper, v.log, integer=False)))
-            elif isinstance(v, Integer):
-                self._dims.append((path, _NumericDim(
-                    v.lower, v.upper - 1, v.log, integer=True)))
-            elif isinstance(v, Categorical):
-                self._dims.append((path, _CategoricalDim(v.categories)))
-            else:
-                self._passthrough.append((path, v))
-
-    # -- model selection -------------------------------------------------
+    # -- model selection (the TPESearcher seam) --------------------------
     def _model_obs(self) -> Optional[List[Tuple[Dict, float]]]:
         """Observations at the largest budget with enough points."""
         for budget in sorted(self._obs_by_budget, reverse=True):
@@ -106,39 +65,13 @@ class BOHBSearcher(Searcher):
                 return obs
         return None
 
-    def _split(self, obs: List[Tuple[Dict, float]]):
+    def _model_split(self):
+        obs = self._model_obs()
+        if obs is None or self._rng.random() < self.random_fraction:
+            return None
         ranked = sorted(obs, key=lambda ov: ov[1], reverse=True)
         n_good = max(1, int(math.ceil(self.gamma * len(ranked))))
         return ranked[:n_good], ranked[n_good:]
-
-    # -- suggest ---------------------------------------------------------
-    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
-        if self._suggested >= self._budget:
-            return None
-        self._suggested += 1
-        obs = self._model_obs()
-        use_model = (obs is not None
-                     and self._rng.random() >= self.random_fraction)
-        good_obs, bad_obs = self._split(obs) if use_model else ([], [])
-        flat: Dict[Tuple, Any] = {}
-        for path, dim in self._dims:
-            if use_model:
-                good = [o[path] for o, _ in good_obs if path in o]
-                bad = [o[path] for o, _ in bad_obs if path in o]
-                flat[path] = dim.propose(good, bad, self.n_candidates,
-                                         self._rng)
-            elif isinstance(dim, _NumericDim):
-                flat[path] = dim.to_native(dim.random(self._rng))
-            else:
-                flat[path] = self._rng.choice(dim.categories)
-        cfg: Dict[str, Any] = {}
-        for path, val in flat.items():
-            _set_path(cfg, path, val)
-        for path, v in self._passthrough:
-            _set_path(cfg, path,
-                      v.sample(self._rng) if isinstance(v, Domain) else v)
-        self._pending[trial_id] = flat
-        return cfg
 
     # -- observe ---------------------------------------------------------
     def _record(self, trial_id: str, result: Dict[str, Any]):

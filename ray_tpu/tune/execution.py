@@ -272,12 +272,7 @@ class TrialRunner:
             self._process_result(trial, ready[0])
             self._syncer.maybe_sync()
         self.save_experiment_state()
-        if (self._syncer.syncer is not None
-                and not self._syncer.maybe_sync(force=True)):
-            import logging
-            logging.getLogger("ray_tpu").warning(
-                "experiment sync to %s FAILED — the durable mirror is "
-                "missing or partial", self._syncer.remote)
+        self._syncer.maybe_sync(force=True)  # failure logged by the state
         return self.trials
 
     def _over_budget(self) -> bool:

@@ -100,6 +100,7 @@ class _SyncerState:
         self.remote = (os.path.join(sync_config.upload_dir, experiment_name)
                        if self.syncer else "")
         self._last = 0.0
+        self._warned = False
 
     def maybe_sync(self, force: bool = False) -> bool:
         if self.syncer is None:
@@ -108,4 +109,18 @@ class _SyncerState:
         if not force and now - self._last < self.cfg.sync_period:
             return False
         self._last = now
-        return self.syncer.sync_up(self.local, self.remote)
+        ok = self.syncer.sync_up(self.local, self.remote)
+        if not ok:
+            # Every failure is loud (a driver crash between now and the
+            # end of the run means the durable mirror is stale), but
+            # repeats of the SAME broken target only log once.
+            import logging
+            if not self._warned:
+                self._warned = True
+                logging.getLogger("ray_tpu").warning(
+                    "experiment sync to %s FAILED — the durable mirror "
+                    "is missing or partial (further failures for this "
+                    "run are silenced)", self.remote)
+        else:
+            self._warned = False
+        return ok

@@ -211,13 +211,22 @@ class TPESearcher(Searcher):
                 self._passthrough.append((path, v))
 
     # -- suggest --------------------------------------------------------
+    def _model_split(self):
+        """(good, bad) observation lists to fit the proposal on, or None
+        to sample randomly. The overridable seam for multi-fidelity
+        variants (BOHB picks its budget bucket here)."""
+        if len(self._obs) >= max(self.n_initial, 2):
+            return self._split()
+        return None
+
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
         if self._suggested >= self._budget:
             return None
         self._suggested += 1
         flat: Dict[Tuple, Any] = {}
-        model_ready = len(self._obs) >= max(self.n_initial, 2)
-        good_obs, bad_obs = self._split() if model_ready else ([], [])
+        split = self._model_split()
+        model_ready = split is not None
+        good_obs, bad_obs = split if model_ready else ([], [])
         for path, dim in self._dims:
             if model_ready:
                 good = [o[path] for o, _ in good_obs if path in o]

@@ -1,0 +1,157 @@
+"""Scale-envelope suite: many-actors / deep-queues / many-PGs at
+CPU-process scale, with wall-clock budgets.
+
+The role of the reference's release-scale benchmarks
+(``release/benchmarks/README.md:5-31``: 10k+ actors, 1M queued tasks,
+1k placement groups at cluster scale) shrunk to what one CI host can
+assert deterministically: the budgets catch complexity regressions
+(O(n^2) scans, per-item wakeup storms), not absolute speed.
+
+Budgets are deliberately loose (5-10x observed) so a loaded CI box
+doesn't flake, while a quadratic blowup still trips them.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    from ray_tpu.cluster_utils import ProcessCluster
+    ray_tpu.shutdown()
+    c = ProcessCluster(num_daemons=2, num_cpus=500)
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _budget(seconds):
+    """Deadline context: asserts the block stayed within budget."""
+    class _B:
+        def __enter__(self):
+            self.t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self.elapsed = time.perf_counter() - self.t0
+            if exc[0] is None:
+                assert self.elapsed < seconds, (
+                    f"scale envelope exceeded: {self.elapsed:.1f}s "
+                    f"> {seconds}s budget")
+            return False
+    return _B()
+
+
+def test_1k_actors_create_call_kill(cluster):
+    """1000 concurrent lightweight actors: create all, one call each,
+    kill all (reference release test: many_actors)."""
+    @ray_tpu.remote(num_cpus=0.01)
+    class Mini:
+        def ping(self, i):
+            return i
+
+    with _budget(120):
+        actors = [Mini.remote() for _ in range(1000)]
+        out = ray_tpu.get([a.ping.remote(i) for i, a in enumerate(actors)],
+                          timeout=110)
+    assert out == list(range(1000))
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_10k_queued_tasks_drain(cluster):
+    """10k tiny tasks submitted at once must all complete (deep pending
+    queues on driver and daemons; admission backpressure may spill but
+    nothing may be lost)."""
+    @ray_tpu.remote(num_cpus=0.01)
+    def tick(i):
+        return i
+
+    with _budget(120):
+        refs = [tick.remote(i) for i in range(10_000)]
+        out = ray_tpu.get(refs, timeout=110)
+    assert out == list(range(10_000))
+
+
+def test_100_placement_groups(cluster):
+    """100 PGs created+ready, an actor placed in each, then removed
+    (reference release test: many_pgs)."""
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    @ray_tpu.remote(num_cpus=0.1)
+    class Holder:
+        def where(self):
+            return 1
+
+    with _budget(120):
+        pgs = [placement_group([{"CPU": 0.5}], strategy="PACK")
+               for _ in range(100)]
+        ray_tpu.get([pg.ready() for pg in pgs], timeout=60)
+        actors = [Holder.options(placement_group=pg).remote() for pg in pgs]
+        assert ray_tpu.get([a.where.remote() for a in actors],
+                           timeout=60) == [1] * 100
+    for a in actors:
+        ray_tpu.kill(a)
+    for pg in pgs:
+        remove_placement_group(pg)
+
+
+def test_wait_on_1k_objects(cluster):
+    """ray.wait over 1000 refs with partial returns: num_returns
+    batching must not degrade quadratically."""
+    @ray_tpu.remote(num_cpus=0.01)
+    def make(i):
+        return i
+
+    with _budget(90):
+        refs = [make.remote(i) for i in range(1000)]
+        remaining = list(refs)
+        seen = 0
+        while remaining:
+            done, remaining = ray_tpu.wait(
+                remaining, num_returns=min(100, len(remaining)), timeout=60)
+            assert done, "wait() made no progress inside its timeout"
+            seen += len(done)
+        assert seen == 1000
+
+
+def test_broadcast_large_object_to_all_daemons(cluster):
+    """One ~8MB object consumed by tasks pinned across both daemons:
+    every consumer sees the full payload (push/pull planes at fan-out)."""
+    payload = np.arange(1_000_000, dtype=np.float64)  # 8 MB
+    ref = ray_tpu.put(payload)
+
+    @ray_tpu.remote(num_cpus=0.01)
+    def crc(arr):
+        return float(arr.sum())
+
+    with _budget(90):
+        out = ray_tpu.get([crc.remote(ref) for _ in range(64)], timeout=80)
+    expected = float(payload.sum())
+    assert out == [expected] * 64
+
+
+def test_submission_latency_stays_flat(cluster):
+    """Per-task submission cost must not grow with completed-task count
+    (leaking per-task state into hot-path scans would show here)."""
+    @ray_tpu.remote(num_cpus=0.01)
+    def nop():
+        return None
+
+    def batch_time(n=500):
+        t0 = time.perf_counter()
+        ray_tpu.get([nop.remote() for _ in range(n)], timeout=60)
+        return time.perf_counter() - t0
+
+    first = batch_time()
+    for _ in range(4):
+        batch_time()
+    last = batch_time()
+    # allow generous noise; a linear-in-history scan would be >>3x
+    assert last < first * 3 + 1.0, (first, last)
