@@ -267,6 +267,18 @@ class DistributedRuntime(Runtime):
         self._incoming_push_seen: Dict[ObjectID, float] = {}
         self._incoming_pushes_lock = threading.Lock()
 
+        # OOM guard: executors shed admissions above the host/cgroup
+        # memory threshold (memory_monitor.h role; drivers don't admit
+        # pushed work, so they don't pay the sampler).
+        self.memory_monitor = None
+        if not is_driver:
+            try:
+                from ray_tpu._private.memory_monitor import MemoryMonitor
+                self.memory_monitor = MemoryMonitor()
+                self.memory_monitor.start()
+            except Exception:
+                logger.debug("memory monitor unavailable", exc_info=True)
+
         # Pubsub: node lifecycle.
         self.state.subscribe(["nodes"], self._on_node_event)
         self._refresh_view()
@@ -601,6 +613,8 @@ class DistributedRuntime(Runtime):
 
     def shutdown(self):
         self._hb_stop.set()
+        if self.memory_monitor is not None:
+            self.memory_monitor.stop()
         self._push_mgr.close()
         if self.host_arena is not None:
             if self._arena_is_owner:
@@ -1279,6 +1293,9 @@ class DistributedRuntime(Runtime):
             caller_address=self.address,
             name=spec.options.name or "",
         )
+        if spec.trace_id:
+            msg.trace_id = spec.trace_id
+            msg.parent_span_id = spec.parent_span_id
         if spec.is_actor_task():
             msg.actor_id = spec.actor_id.binary()
             msg.method_name = spec.method_name or ""
@@ -1811,6 +1828,10 @@ class DistributedRuntime(Runtime):
         self._start_actor_on_node(state, node, request)
 
     def submit_actor_task(self, actor_id: ActorID, spec: TaskSpec):
+        # Before any routing: the remote path returns without reaching
+        # super()'s attach, and a cross-daemon actor call must carry the
+        # trace context like every other hop.
+        self._attach_trace(spec)
         rec = self.remote_actors.get(actor_id)
         state = self.actors.get(actor_id)
         if rec is None and state is None:
@@ -2060,6 +2081,8 @@ class DistributedRuntime(Runtime):
             self._handle_push_object(ctx)
         elif method == pb.GET_TIMELINE:
             self._handle_get_timeline(ctx)
+        elif method == pb.NODE_DEBUG:
+            self._handle_node_debug(ctx)
         elif method == pb.RESERVE_BUNDLE:
             req = pb.BundleRequest()
             req.ParseFromString(ctx.body)
@@ -2158,7 +2181,8 @@ class DistributedRuntime(Runtime):
             function=None, function_name=msg.function_name,
             args=args, kwargs=kwargs, options=options,
             return_ids=tuple(ObjectID(r) for r in msg.return_ids),
-            attempt=msg.attempt)
+            attempt=msg.attempt,
+            trace_id=msg.trace_id, parent_span_id=msg.parent_span_id)
         if msg.actor_id:
             spec.actor_id = ActorID(msg.actor_id)
             spec.method_name = msg.method_name
@@ -2251,6 +2275,13 @@ class DistributedRuntime(Runtime):
             return
         if not self._admission_check(spec.options.resources):
             self._spillback_reply(ctx)
+            return
+        # OOM guard (memory_monitor.h role): a host above the memory
+        # threshold sheds new work instead of letting the kernel kill
+        # the device-owner daemon; the caller re-routes or retries.
+        if (self.memory_monitor is not None
+                and self.memory_monitor.is_over_threshold()):
+            self._spillback_reply(ctx, saturated=True)
             return
         # Bounded admission (push_manager/backpressure half of the
         # reference's lease policy): a daemon whose pending queue is deep
@@ -2505,6 +2536,35 @@ class DistributedRuntime(Runtime):
             if entry is not None and entry[0] is payload:
                 entry[1] = key
         return key
+
+    def _handle_node_debug(self, ctx: RpcContext):
+        """Dashboard drill-down feed: recent log lines (in-process ring,
+        ``log_ring.py``) + this daemon's task-state rows (the per-node
+        half of ``dashboard/modules/log/log_agent.py:1`` and the task
+        table the reference aggregates via GCS)."""
+        from ray_tpu._private import log_ring
+        req = pb.NodeDebugRequest()
+        req.ParseFromString(ctx.body)
+        payload: Dict[str, Any] = {}
+        if req.log_lines:
+            payload["logs"] = log_ring.tail(int(req.log_lines))
+        if req.include_tasks:
+            cap = int(req.max_tasks) or 1000
+            with self.lock:
+                # most-recent N only: a long-lived daemon holds a row per
+                # task it ever ran, and one drill-down click must not
+                # JSON-encode (or ship) the full history
+                items = list(self.task_states.items())[-cap:]
+                wanted = {tid for tid, _ in items}
+                names = {spec.task_id: spec.function_name
+                         for spec in self.lineage.values()
+                         if spec.task_id in wanted}
+            payload["tasks"] = [
+                {"task_id": tid.hex(), "state": st,
+                 "name": names.get(tid, "?")}
+                for tid, st in items]
+        ctx.reply(pb.NodeDebugReply(
+            payload_json=json.dumps(payload).encode()).SerializeToString())
 
     def _handle_get_timeline(self, ctx: RpcContext):
         """Span-buffer fetch/control (cross-process trace propagation:

@@ -79,6 +79,9 @@ def main(argv=None) -> int:
     logging.basicConfig(
         level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
         format="[daemon %(asctime)s] %(levelname)s %(message)s")
+    # Recent-log ring served over NODE_DEBUG (dashboard log viewer).
+    from ray_tpu._private import log_ring
+    log_ring.install()
 
     prof_dir = os.environ.get("RAY_TPU_PROFILE_DIR")
     if prof_dir:

@@ -1,0 +1,58 @@
+"""In-process log ring buffer.
+
+The per-daemon half of the dashboard's log viewer (reference:
+``dashboard/modules/log/log_agent.py:1`` tails worker log FILES; this
+runtime's workers are threads of one daemon process, so the daemon keeps
+its own recent log lines in memory and serves them over the NODE_DEBUG
+RPC — no log-directory contract needed).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+from typing import List, Optional
+
+_FMT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+
+
+class RingLogHandler(logging.Handler):
+    """Keeps the last ``capacity`` formatted log lines."""
+
+    def __init__(self, capacity: int = 2000):
+        super().__init__()
+        self.setFormatter(logging.Formatter(_FMT))
+        self._lock2 = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+
+    def emit(self, record: logging.LogRecord):
+        try:
+            line = self.format(record)
+        except Exception:  # noqa: BLE001 - formatting must never raise out
+            return
+        with self._lock2:
+            self._ring.append(line)
+
+    def tail(self, n: int) -> List[str]:
+        with self._lock2:
+            items = list(self._ring)
+        return items[-n:] if n > 0 else []
+
+
+_handler: Optional[RingLogHandler] = None
+_install_lock = threading.Lock()
+
+
+def install(capacity: int = 2000) -> RingLogHandler:
+    """Attach the ring to the root logger (idempotent)."""
+    global _handler
+    with _install_lock:
+        if _handler is None:
+            _handler = RingLogHandler(capacity)
+            logging.getLogger().addHandler(_handler)
+        return _handler
+
+
+def tail(n: int) -> List[str]:
+    return _handler.tail(n) if _handler is not None else []

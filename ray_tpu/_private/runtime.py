@@ -72,9 +72,20 @@ class _TaskContext(threading.local):
         self.placement_group: Any = None
         self.put_counter: int = 0
         self.cancel_flag: Optional[threading.Event] = None
+        self.trace_id: str = ""   # current trace (propagates to children)
+        self.span_id: str = ""    # current span (children's parent)
 
 
 task_context = _TaskContext()
+
+# Trace context for ASYNC actor methods: coroutines interleave on one
+# loop thread, so a thread-local would be clobbered at every await —
+# a ContextVar is copied per asyncio task instead. _attach_trace prefers
+# it; sync paths (dedicated threads) keep using task_context.
+import contextvars  # noqa: E402
+
+_trace_var: "contextvars.ContextVar" = contextvars.ContextVar(
+    "ray_tpu_trace", default=None)  # (trace_id, span_id) | None
 
 
 class Node:
@@ -437,7 +448,25 @@ class Runtime:
 
     # ------------------------------------------------------------------ tasks
 
+    def _attach_trace(self, spec: TaskSpec):
+        """Propagate the submitting span's trace context into the spec
+        (tracing_helper.py:160-175 role): children inherit the trace id
+        with the current span as parent; a root submission mints a fresh
+        trace id when profiling is on (tracing is free when it's off)."""
+        if spec.trace_id:
+            return  # retries keep their original identity
+        async_ctx = _trace_var.get()
+        ctx = task_context
+        if async_ctx:
+            spec.trace_id, spec.parent_span_id = async_ctx
+        elif ctx.trace_id:
+            spec.trace_id = ctx.trace_id
+            spec.parent_span_id = ctx.span_id
+        elif _prof().enabled:
+            spec.trace_id = os.urandom(8).hex()
+
     def submit_task(self, spec: TaskSpec) -> List[ObjectID]:
+        self._attach_trace(spec)
         if not spec.return_ids:
             spec.return_ids = tuple(
                 ObjectID.for_return(spec.task_id, i)
@@ -718,7 +747,8 @@ class Runtime:
                       alloc_target, cancel: threading.Event):
         ctx = task_context
         prev = (ctx.node_id, ctx.task_id, ctx.job_id, ctx.put_counter,
-                ctx.devices, ctx.cancel_flag, ctx.placement_group)
+                ctx.devices, ctx.cancel_flag, ctx.placement_group,
+                ctx.trace_id, ctx.span_id)
         ctx.node_id = node.node_id
         ctx.task_id = spec.task_id
         ctx.job_id = spec.job_id
@@ -726,6 +756,11 @@ class Runtime:
         ctx.devices = self._assign_devices(request, node)
         ctx.cancel_flag = cancel
         ctx.placement_group = spec.options.placement_group
+        # Trace context for this span: children submitted by the task
+        # body inherit (trace_id, span_id) via _attach_trace.
+        ctx.trace_id = spec.trace_id
+        span_id = os.urandom(8).hex() if spec.trace_id else ""
+        ctx.span_id = span_id
         t0 = time.monotonic()
         try:
             if cancel.is_set():
@@ -751,12 +786,17 @@ class Runtime:
             dur = time.monotonic() - t0
             self.emit_event("TASK_DONE", task=spec.function_name,
                             ms=round(dur * 1e3, 3))
+            span_args = {"task_id": spec.task_id.hex()}
+            if spec.trace_id:
+                span_args.update(trace_id=spec.trace_id, span_id=span_id,
+                                 parent_span_id=spec.parent_span_id)
             _prof().record(spec.function_name, "task",
                            pid=f"node:{node.node_id.hex()[:8]}",
                            start_s=time.time() - dur, dur_s=dur,
-                           args={"task_id": spec.task_id.hex()})
+                           args=span_args)
             (ctx.node_id, ctx.task_id, ctx.job_id, ctx.put_counter,
-             ctx.devices, ctx.cancel_flag, ctx.placement_group) = prev
+             ctx.devices, ctx.cancel_flag, ctx.placement_group,
+             ctx.trace_id, ctx.span_id) = prev
             self._fire_completion(spec)
             self._kick()
 
@@ -955,6 +995,9 @@ class Runtime:
             ctx.task_id = spec.task_id
             ctx.cancel_flag = cancel
             ctx.put_counter = 0
+            ctx.trace_id = spec.trace_id
+            span_id = os.urandom(8).hex() if spec.trace_id else ""
+            ctx.span_id = span_id
             t0 = time.monotonic()
             try:
                 if cancel.is_set():
@@ -984,11 +1027,16 @@ class Runtime:
             finally:
                 self._unpin_args(spec)
                 dur = time.monotonic() - t0
+                span_args = {"actor_id": state.actor_id.hex()}
+                if spec.trace_id:
+                    span_args.update(trace_id=spec.trace_id,
+                                     span_id=span_id,
+                                     parent_span_id=spec.parent_span_id)
                 _prof().record(
                     f"{state.cls.__name__}.{spec.method_name}",
                     "actor_task", pid=f"node:{node.node_id.hex()[:8]}",
                     start_s=time.time() - dur, dur_s=dur,
-                    args={"actor_id": state.actor_id.hex()})
+                    args=span_args)
                 self._fire_completion(spec)
                 self._kick()
 
@@ -1001,6 +1049,10 @@ class Runtime:
 
         async def _run_one(spec: TaskSpec, cancel):
             async with sem:
+                span_id = os.urandom(8).hex() if spec.trace_id else ""
+                token = (_trace_var.set((spec.trace_id, span_id))
+                         if spec.trace_id else None)
+                t0 = time.monotonic()
                 try:
                     if cancel.is_set():
                         raise exc.TaskCancelledError(spec.task_id)
@@ -1026,7 +1078,21 @@ class Runtime:
                     with self.lock:
                         self.task_states[spec.task_id] = "FAILED"
                 finally:
+                    if token is not None:
+                        _trace_var.reset(token)
                     self._unpin_args(spec)
+                    dur = time.monotonic() - t0
+                    span_args = {"actor_id": state.actor_id.hex()}
+                    if spec.trace_id:
+                        span_args.update(
+                            trace_id=spec.trace_id, span_id=span_id,
+                            parent_span_id=spec.parent_span_id)
+                    _prof().record(
+                        f"{state.cls.__name__}.{spec.method_name}",
+                        "actor_task",
+                        pid=f"node:{node.node_id.hex()[:8]}",
+                        start_s=time.time() - dur, dur_s=dur,
+                        args=span_args)
                     self._fire_completion(spec)
                     self._kick()
 
@@ -1044,6 +1110,7 @@ class Runtime:
             loop.close()
 
     def submit_actor_task(self, actor_id: ActorID, spec: TaskSpec) -> List[ObjectID]:
+        self._attach_trace(spec)
         with self.lock:
             state = self.actors.get(actor_id)
         if not spec.return_ids:

@@ -30,7 +30,7 @@ import zipfile
 from typing import Any, Dict, List, Optional
 
 _CACHE_DIR = "/tmp/ray_tpu/runtime_envs"
-_SUPPORTED = {"env_vars", "working_dir", "py_modules"}
+_SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip"}
 
 
 class _EnvGate:
@@ -157,11 +157,12 @@ class RuntimeEnvError(ValueError):
 
 def validate(runtime_env: Dict[str, Any]) -> None:
     unsupported = set(runtime_env) - _SUPPORTED
-    if unsupported & {"pip", "conda", "container"}:
+    if unsupported & {"conda", "container"}:
         raise RuntimeEnvError(
             f"runtime_env fields {sorted(unsupported)} are not supported: "
-            "the host-granular runtime shares one interpreter per host and "
-            "has no package egress. Bake dependencies into the image.")
+            "the host-granular runtime shares one interpreter per host "
+            "(no interpreter/image swap). Use 'pip' for per-task package "
+            "prefixes, or bake dependencies into the image.")
     if unsupported:
         raise RuntimeEnvError(
             f"unknown runtime_env fields {sorted(unsupported)}; "
@@ -215,6 +216,100 @@ def _stage(path: str) -> str:
     return target
 
 
+_PIP_BUILD_LOCKS: Dict[str, threading.Lock] = {}
+_PIP_BUILD_LOCKS_GUARD = threading.Lock()
+
+
+def _pip_build_lock(target: str) -> threading.Lock:
+    """Per-TARGET build lock: same-env racers serialize (one pip run),
+    while builds of unrelated envs — each potentially minutes long —
+    proceed in parallel."""
+    with _PIP_BUILD_LOCKS_GUARD:
+        return _PIP_BUILD_LOCKS.setdefault(target, threading.Lock())
+
+
+def _materialize_pip(spec, counter: Optional[list] = None) -> str:
+    """Build (or reuse) a pip package prefix for a runtime env.
+
+    Reference parity: ``python/ray/_private/runtime_env/pip.py:1`` +
+    ``uri_cache.py:1`` — but redesigned for the thread-worker runtime:
+    the reference builds a virtualenv because it launches worker
+    PROCESSES inside it; here workers are threads of the device-owner
+    daemon, so "materialize" means ``pip install --target`` into a
+    requirements-keyed cache directory that the environment gate puts on
+    ``sys.path`` for the task's duration. Same interpreter, so wheels
+    (including C extensions) are directly importable.
+
+    ``spec``: ``["pkg==1.0", ...]`` or ``{"packages": [...],
+    "find_links": dir}``. Offline installs (this runtime has no package
+    egress) use ``find_links`` — a local wheel directory, also settable
+    via ``RAY_TPU_PIP_FIND_LINKS`` — with ``--no-index``. The cache key
+    covers the package list AND the wheel directory's content hash, so
+    republishing a wheel rebuilds instead of serving the stale prefix.
+    """
+    import subprocess
+    import sys as _sys
+
+    if isinstance(spec, dict):
+        packages = [str(p) for p in spec.get("packages", [])]
+        find_links = spec.get("find_links")
+    elif isinstance(spec, (list, tuple)):
+        packages = [str(p) for p in spec]
+        find_links = None
+    else:
+        raise RuntimeEnvError(
+            f"pip spec must be a list or dict, got {type(spec).__name__}")
+    if not packages:
+        raise RuntimeEnvError("pip spec has no packages")
+    find_links = find_links or os.environ.get("RAY_TPU_PIP_FIND_LINKS")
+    h = hashlib.blake2b(digest_size=16)
+    h.update(repr(sorted(packages)).encode())
+    if find_links:
+        if not os.path.isdir(find_links):
+            raise RuntimeEnvError(
+                f"pip find_links {find_links!r} is not a directory")
+        h.update(_hash_path(find_links).encode())
+    target = os.path.join(_CACHE_DIR, "pip", h.hexdigest())
+    if os.path.isdir(target):
+        # Lock-free fast path: a materialized prefix is immutable, and a
+        # cache hit must not wait behind another env's minutes-long build.
+        return target
+    # check-then-build must be one critical section, or N concurrent
+    # same-env tasks each run pip (observed: 3 builds for 3 tasks);
+    # cross-PROCESS racers are handled by unique staging + atomic replace
+    with _pip_build_lock(target):
+        if os.path.isdir(target):
+            return target  # built while we waited
+        import tempfile
+        os.makedirs(os.path.dirname(target), exist_ok=True)
+        tmp = tempfile.mkdtemp(prefix=os.path.basename(target) + ".stage.",
+                               dir=os.path.dirname(target))
+        cmd = [_sys.executable, "-m", "pip", "install", "--target", tmp,
+               "--no-cache-dir", "--disable-pip-version-check", "--quiet"]
+        if find_links:
+            cmd += ["--no-index", "--find-links", find_links]
+        cmd += packages
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=600)
+        except subprocess.TimeoutExpired as e:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise RuntimeEnvError(
+                f"pip install of {packages} timed out after 600s") from e
+        if proc.returncode != 0:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise RuntimeEnvError(
+                f"pip install of {packages} failed: {proc.stderr[-800:]}")
+        if counter is not None:
+            counter[0] += 1
+        try:
+            os.replace(tmp, target)
+        except OSError:
+            # concurrent materialization (other process) won; use its copy
+            shutil.rmtree(tmp, ignore_errors=True)
+    return target
+
+
 class MaterializedEnv:
     """A staged environment ready to wrap task execution."""
 
@@ -243,6 +338,11 @@ class RuntimeEnvManager:
         self._lock = threading.Lock()
         self._cache: Dict[str, MaterializedEnv] = {}
         self.num_materialized = 0
+        self._pip_builds = [0]  # boxed: _materialize_pip increments
+
+    @property
+    def num_pip_builds(self) -> int:
+        return self._pip_builds[0]
 
     def get_or_create(self, runtime_env: Optional[Dict[str, Any]]
                       ) -> Optional[MaterializedEnv]:
@@ -253,6 +353,12 @@ class RuntimeEnvManager:
         # the CURRENT file contents — editing working_dir and resubmitting
         # must pick up the new code, not a stale repr-keyed entry.
         sys_paths: List[str] = []
+        # Order matters: the gate insert(0)s each path in turn, so LATER
+        # entries shadow earlier ones — pip packages first (lowest
+        # precedence), then working_dir, then py_modules.
+        if "pip" in runtime_env:
+            sys_paths.append(_materialize_pip(runtime_env["pip"],
+                                              self._pip_builds))
         if "working_dir" in runtime_env:
             sys_paths.append(_stage(runtime_env["working_dir"]))
         for mod in runtime_env.get("py_modules", ()):

@@ -47,6 +47,12 @@ class TaskSpec:
     actor_id: Optional[ActorID] = None
     method_name: Optional[str] = None
     attempt: int = 0
+    # Cross-task trace propagation (reference
+    # ``python/ray/util/tracing/tracing_helper.py:160-175``): the trace
+    # id rides every hop of a task tree; parent_span_id links this
+    # task's span to the span that submitted it.
+    trace_id: str = ""
+    parent_span_id: str = ""
 
     def is_actor_task(self) -> bool:
         return self.actor_id is not None

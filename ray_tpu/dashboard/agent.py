@@ -110,6 +110,12 @@ class NodeReporterAgent:
             stats["resources"] = {"available": avail, "total": total}
         except Exception:
             pass
+        monitor = getattr(rt, "memory_monitor", None)
+        if monitor is not None:
+            try:
+                stats["memory_monitor"] = monitor.snapshot()
+            except Exception:
+                pass
         return stats
 
     def publish_once(self):

@@ -10,9 +10,15 @@ Endpoints:
   /                 — self-contained HTML UI (polls the JSON API)
   /api/cluster      — nodes + reporter stats + resource totals
   /api/actors       — actor table
+  /api/actor?id=X   — one actor's full record (drill-down)
   /api/pgs          — placement groups
   /api/jobs         — job table
   /api/stats        — state-service counters
+  /api/node_debug?node=X&lines=N&tasks=1
+                    — per-daemon log tail + local task rows, fetched
+                      live from the daemon over NODE_DEBUG (the log
+                      viewer / task drill-down the reference serves via
+                      dashboard/modules/log/log_agent.py)
 """
 
 from __future__ import annotations
@@ -20,6 +26,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+import urllib.parse
 from typing import Optional
 
 from ray_tpu.dashboard.agent import collect_node_stats
@@ -39,6 +46,14 @@ th{background:#f0f0f3;font-weight:600}
 <h2>Actors</h2><table id=actors></table>
 <h2>Placement groups</h2><table id=pgs></table>
 <h2>Jobs</h2><table id=jobs></table>
+<h2>Node drill-down</h2>
+<div>
+  <select id=nodesel></select>
+  <button onclick="drill()">fetch logs + tasks</button>
+</div>
+<h2 style="font-size:13px">Tasks on node</h2><table id=ntasks></table>
+<h2 style="font-size:13px">Recent logs</h2>
+<pre id=nlogs style="background:#111;color:#ddd;padding:10px;max-height:320px;overflow:auto;font-size:12px"></pre>
 <script>
 // all dynamic values are escaped: actor/class/label names are
 // user-controlled and must not inject HTML into the viewer's page
@@ -48,21 +63,33 @@ function row(cells, tag){tag=tag||'td';return '<tr>'+cells.map(c=>'<'+tag+'>'+c+
 function rowe(cells, tag){return row(cells.map(esc), tag)}
 async function refresh(){
   const c = await (await fetch('/api/cluster')).json();
-  let h = row(['node','address','state','CPU','TPU','cpu%','rss MB','arena','objects'],'th');
+  let h = row(['node','address','state','CPU','TPU','cpu%','rss MB','host mem','arena','objects'],'th');
+  const sel = document.getElementById('nodesel');
+  const cur = sel.value; sel.innerHTML='';
   for (const n of c.nodes){
-    const s = n.stats||{}; const a = s.arena||{};
+    const s = n.stats||{}; const a = s.arena||{}; const mm = s.memory_monitor||{};
+    const mem = mm.total_mb ? (mm.used_frac*100).toFixed(0)+'%'+(mm.over_threshold?' OOM-GUARD':'') :
+      (s.mem&&s.mem.MemTotal ? (100*(1-s.mem.MemAvailable/s.mem.MemTotal)).toFixed(0)+'%' : '-');
     h += row([esc(n.node_id.slice(0,8)), esc(n.address),
       '<span class="'+(n.alive?'alive':'dead')+'">'+(n.alive?'ALIVE':'DEAD')+'</span>',
       esc((n.available.CPU??0)+'/'+(n.total.CPU??0)),
       esc((n.available.TPU??'-')+'/'+(n.total.TPU??'-')),
-      esc(s.cpu_percent??'-'), esc(s.rss_mb??'-'),
+      esc(s.cpu_percent??'-'), esc(s.rss_mb??'-'), esc(mem),
       esc(a.capacity_mb? a.used_mb+'/'+a.capacity_mb+' MB'+(a.owner?' (owner)':'') : '-'),
       esc((s.object_store||{}).num_objects??'-')]);
+    if (n.alive){
+      const o = document.createElement('option');
+      o.value = n.node_id; o.textContent = n.node_id.slice(0,8)+' @ '+n.address;
+      sel.appendChild(o);
+    }
   }
+  if (cur) sel.value = cur;
   document.getElementById('nodes').innerHTML = h;
   const actors = await (await fetch('/api/actors')).json();
-  let ah = row(['actor','class','state','node','restarts'],'th');
-  for (const x of actors) ah += rowe([x.actor_id.slice(0,8), x.class_name, x.state, (x.node_id||'').slice(0,8), x.num_restarts??0]);
+  let ah = row(['actor','class','state','node','restarts',''],'th');
+  for (const x of actors) ah += row([esc(x.actor_id.slice(0,8)), esc(x.class_name),
+    esc(x.state), esc((x.node_id||'').slice(0,8)), esc(x.num_restarts??0),
+    '<a href="/api/actor?id='+encodeURIComponent(x.actor_id)+'" target=_blank>detail</a>']);
   document.getElementById('actors').innerHTML = ah;
   const pgs = await (await fetch('/api/pgs')).json();
   let ph = row(['pg','strategy','state','bundles'],'th');
@@ -74,6 +101,16 @@ async function refresh(){
   document.getElementById('jobs').innerHTML = jh;
   document.getElementById('updated').textContent = 'updated '+new Date().toLocaleTimeString();
 }
+async function drill(){
+  const nid = document.getElementById('nodesel').value;
+  if (!nid) return;
+  const d = await (await fetch('/api/node_debug?node='+encodeURIComponent(nid)+'&lines=200&tasks=1')).json();
+  if (d.error){ document.getElementById('nlogs').textContent = d.error; return; }
+  let th = row(['task','name','state'],'th');
+  for (const t of (d.tasks||[])) th += rowe([t.task_id.slice(0,8), t.name, t.state]);
+  document.getElementById('ntasks').innerHTML = th;
+  document.getElementById('nlogs').textContent = (d.logs||[]).join('\\n') || '(no recent log lines)';
+}
 refresh(); setInterval(refresh, 2000);
 </script></body></html>"""
 
@@ -83,8 +120,10 @@ class DashboardHead:
 
     def __init__(self, state_addr: str, port: int = 0,
                  host: str = "127.0.0.1"):
+        from ray_tpu._private.rpc import ConnectionPool
         from ray_tpu._private.state_client import StateClient
         self.state = StateClient(state_addr)
+        self.pool = ConnectionPool()  # daemon connections for drill-down
         self._httpd = None
         self._thread: Optional[threading.Thread] = None
         self._host, self._want_port = host, port
@@ -134,6 +173,41 @@ class DashboardHead:
             "state": j.state,
         } for j in self.state.list_jobs()]
 
+    def _actor_detail(self, actor_id_hex: str) -> dict:
+        for a in self.state.list_actors():
+            if a.actor_id.hex() == actor_id_hex:
+                return {
+                    "actor_id": a.actor_id.hex(),
+                    "class_name": a.class_name,
+                    "state": a.state,
+                    "node_id": a.node_id.hex() if a.node_id else "",
+                    "address": a.address,
+                    "name": a.name,
+                    "namespace": a.namespace,
+                    "num_restarts": a.restart_count,
+                    "death_cause": getattr(a, "death_cause", ""),
+                }
+        return {"error": f"actor {actor_id_hex} not found"}
+
+    def _node_debug(self, node_id_hex: str, lines: int,
+                    include_tasks: bool) -> dict:
+        from ray_tpu.protocol import pb
+        addr = next((n.address for n in self.state.list_nodes()
+                     if n.node_id.hex() == node_id_hex and n.alive), None)
+        if addr is None:
+            return {"error": f"node {node_id_hex} not alive"}
+        client = self.pool.get(addr)
+        rep = pb.NodeDebugReply()
+        rep.ParseFromString(client.call(
+            pb.NODE_DEBUG, pb.NodeDebugRequest(
+                log_lines=lines,
+                include_tasks=include_tasks).SerializeToString(),
+            timeout=15).body)
+        out = json.loads(bytes(rep.payload_json).decode())
+        out["node_id"] = node_id_hex
+        out["address"] = addr
+        return out
+
     # -- server ----------------------------------------------------------
     def start(self) -> int:
         import http.server
@@ -153,18 +227,29 @@ class DashboardHead:
 
             def do_GET(self):
                 try:
-                    if self.path in ("/", "/index.html"):
+                    parsed = urllib.parse.urlparse(self.path)
+                    q = urllib.parse.parse_qs(parsed.query)
+                    route = parsed.path
+                    if route in ("/", "/index.html"):
                         self._send(_PAGE.encode(), "text/html")
-                    elif self.path == "/api/cluster":
+                    elif route == "/api/cluster":
                         self._json(head._cluster())
-                    elif self.path == "/api/actors":
+                    elif route == "/api/actors":
                         self._json(head._actors())
-                    elif self.path == "/api/pgs":
+                    elif route == "/api/actor":
+                        self._json(head._actor_detail(
+                            q.get("id", [""])[0]))
+                    elif route == "/api/pgs":
                         self._json(head._pgs())
-                    elif self.path == "/api/jobs":
+                    elif route == "/api/jobs":
                         self._json(head._jobs())
-                    elif self.path == "/api/stats":
+                    elif route == "/api/stats":
                         self._json(head.state.stats())
+                    elif route == "/api/node_debug":
+                        self._json(head._node_debug(
+                            q.get("node", [""])[0],
+                            int(q.get("lines", ["200"])[0]),
+                            q.get("tasks", ["1"])[0] not in ("0", "")))
                     else:
                         self._json({"error": "not found"}, 404)
                 except Exception as e:  # noqa: BLE001
@@ -187,6 +272,10 @@ class DashboardHead:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        try:
+            self.pool.close_all()
+        except Exception:
+            pass
         try:
             self.state.close()
         except Exception:

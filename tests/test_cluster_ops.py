@@ -140,17 +140,104 @@ def test_runtime_env_working_dir_and_py_modules(ray_start_regular, tmp_path):
         import mymod_rt_env  # noqa: F401
 
 
-def test_runtime_env_rejects_pip(ray_start_regular):
-    from ray_tpu.exceptions import TaskError
+def test_runtime_env_rejects_conda_and_container(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    for field in ("conda", "container"):
+        with pytest.raises(Exception) as ei:
+            ray_tpu.get(f.options(
+                runtime_env={field: "x"}).remote(), timeout=10)
+        assert "not supported" in str(ei.value)
+
+
+def _write_tiny_wheel(wheel_dir, name="tinypkg_rt", version="1.0",
+                      value=41):
+    """Hand-assemble a minimal PEP-427 wheel (no network, no build
+    backend): pip installs it from a --find-links dir with --no-index."""
+    wheel_dir.mkdir(parents=True, exist_ok=True)
+    whl = wheel_dir / f"{name}-{version}-py3-none-any.whl"
+    dist = f"{name}-{version}.dist-info"
+    with zipfile.ZipFile(whl, "w") as z:
+        z.writestr(f"{name}/__init__.py", f"VALUE = {value}\n")
+        z.writestr(f"{dist}/METADATA",
+                   f"Metadata-Version: 2.1\nName: {name}\n"
+                   f"Version: {version}\n")
+        z.writestr(f"{dist}/WHEEL",
+                   "Wheel-Version: 1.0\nGenerator: test\n"
+                   "Root-Is-Purelib: true\nTag: py3-none-any\n")
+        z.writestr(f"{dist}/RECORD",
+                   f"{name}/__init__.py,,\n{dist}/METADATA,,\n"
+                   f"{dist}/WHEEL,,\n{dist}/RECORD,,\n")
+    return whl
+
+
+def test_runtime_env_pip_installs_absent_package(ray_start_regular,
+                                                 tmp_path):
+    """A task runs with a package ABSENT from the base env, materialized
+    offline from a local wheel dir (reference runtime_env/pip.py role,
+    redesigned as a --target prefix for the thread-worker runtime)."""
+    with pytest.raises(ImportError):
+        import tinypkg_rt  # noqa: F401
+    _write_tiny_wheel(tmp_path / "wheels")
+
+    @ray_tpu.remote
+    def use_pkg():
+        import tinypkg_rt
+        return tinypkg_rt.VALUE
+
+    env = {"pip": {"packages": ["tinypkg_rt==1.0"],
+                   "find_links": str(tmp_path / "wheels")}}
+    assert ray_tpu.get(use_pkg.options(runtime_env=env).remote(),
+                       timeout=120) == 41
+    # gone from sys.path after the task
+    sys.modules.pop("tinypkg_rt", None)
+    with pytest.raises(ImportError):
+        import tinypkg_rt  # noqa: F401
+
+
+def test_runtime_env_pip_cache_hit_and_invalidation(ray_start_regular,
+                                                    tmp_path):
+    from ray_tpu._private.runtime_env import get_manager
+    _write_tiny_wheel(tmp_path / "wheels", value=7)
+    mgr = get_manager()
+
+    @ray_tpu.remote
+    def use_pkg():
+        import tinypkg_rt
+        return tinypkg_rt.VALUE
+
+    env = {"pip": {"packages": ["tinypkg_rt==1.0"],
+                   "find_links": str(tmp_path / "wheels")}}
+    before = mgr.num_pip_builds
+    out = ray_tpu.get([use_pkg.options(runtime_env=env).remote()
+                       for _ in range(3)], timeout=120)
+    assert out == [7, 7, 7]
+    assert mgr.num_pip_builds == before + 1  # one build, two cache hits
+    # republish the wheel with different content: the key covers the
+    # wheel dir's content hash, so the prefix is REBUILT, not reused
+    _write_tiny_wheel(tmp_path / "wheels", value=8)
+    sys.modules.pop("tinypkg_rt", None)
+    assert ray_tpu.get(use_pkg.options(runtime_env=env).remote(),
+                       timeout=120) == 8
+    assert mgr.num_pip_builds == before + 2
+    sys.modules.pop("tinypkg_rt", None)
+
+
+def test_runtime_env_pip_install_failure_surfaces(ray_start_regular,
+                                                  tmp_path):
+    (tmp_path / "empty").mkdir()
 
     @ray_tpu.remote
     def f():
         return 1
 
+    env = {"pip": {"packages": ["definitely_not_a_pkg==9.9"],
+                   "find_links": str(tmp_path / "empty")}}
     with pytest.raises(Exception) as ei:
-        ray_tpu.get(f.options(
-            runtime_env={"pip": ["requests"]}).remote(), timeout=10)
-    assert "not supported" in str(ei.value)
+        ray_tpu.get(f.options(runtime_env=env).remote(), timeout=120)
+    assert "pip install" in str(ei.value)
 
 
 def test_runtime_env_cached_once(ray_start_regular, tmp_path):

@@ -98,3 +98,66 @@ def test_init_include_dashboard_on_cluster():
     finally:
         ray_tpu.shutdown()
         c.shutdown()
+
+
+def test_dashboard_node_debug_logs_and_tasks(cluster):
+    """Per-node drill-down: the head fetches a daemon's recent log ring
+    and local task rows over NODE_DEBUG (log_agent.py role)."""
+    @ray_tpu.remote
+    def marked_task():
+        import logging
+        logging.getLogger("ray_tpu").warning("drilldown-marker-line")
+        return 1
+
+    assert ray_tpu.get([marked_task.remote() for _ in range(4)],
+                       timeout=60) == [1] * 4
+    head = start_dashboard(cluster.address)
+    try:
+        nodes = [n for n in _get(head.port, "/api/cluster")["nodes"]
+                 if n["alive"] and n["address"]]
+        assert nodes
+        found_logs = found_tasks = False
+        for n in nodes:
+            d = _get(head.port,
+                     f"/api/node_debug?node={n['node_id']}&lines=300")
+            assert "error" not in d, d
+            if any("drilldown-marker-line" in ln for ln in d.get("logs", [])):
+                found_logs = True
+            if any(t["name"].endswith("marked_task")
+                   for t in d.get("tasks", [])):
+                found_tasks = True
+        assert found_logs, "marker log line not found on any daemon"
+        assert found_tasks, "task rows missing from every daemon"
+        # dead/unknown node yields a clean error, not a 500
+        d = _get(head.port, "/api/node_debug?node=00ff00ff")
+        assert "error" in d
+    finally:
+        head.stop()
+
+
+def test_dashboard_actor_detail(cluster):
+    @ray_tpu.remote
+    class Detailed:
+        def ping(self):
+            return 1
+
+    a = Detailed.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == 1
+    head = start_dashboard(cluster.address)
+    try:
+        deadline = time.monotonic() + 15
+        actors = []
+        while time.monotonic() < deadline:
+            actors = [x for x in _get(head.port, "/api/actors")
+                      if x["class_name"] == "Detailed"]
+            if actors:
+                break
+            time.sleep(0.3)
+        assert actors
+        detail = _get(head.port, f"/api/actor?id={actors[0]['actor_id']}")
+        assert detail["class_name"] == "Detailed"
+        assert "address" in detail and "num_restarts" in detail
+        missing = _get(head.port, "/api/actor?id=deadbeef")
+        assert "error" in missing
+    finally:
+        head.stop()

@@ -265,3 +265,90 @@ def test_cluster_timeline_merges_daemon_spans():
     finally:
         ray_tpu.shutdown()
         c.shutdown()
+
+
+def test_trace_context_propagates_to_child_tasks(ray_start_regular):
+    """Cross-task trace propagation (tracing_helper.py:160-175 role): a
+    task tree shares one trace_id, and each child's parent_span_id is
+    the submitting task's span_id."""
+    from ray_tpu._private.profiling import get_profiler
+    get_profiler().clear()
+    ray_tpu.set_profiling_enabled(True)
+
+    @ray_tpu.remote
+    def leaf():
+        return 1
+
+    @ray_tpu.remote
+    def root():
+        return ray_tpu.get([leaf.remote(), leaf.remote()])
+
+    assert ray_tpu.get(root.remote(), timeout=60) == [1, 1]
+    spans = {s["name"].split(".")[-1]: s for s in ray_tpu.timeline()
+             if s.get("args", {}).get("trace_id")}
+    leafs = [s for s in ray_tpu.timeline()
+             if s["name"].endswith("leaf") and "args" in s]
+    root_span = next(s for s in ray_tpu.timeline()
+                     if s["name"].endswith("root"))
+    assert root_span["args"]["trace_id"]
+    assert root_span["args"]["parent_span_id"] == ""  # trace root
+    assert len(leafs) == 2
+    for s in leafs:
+        assert s["args"]["trace_id"] == root_span["args"]["trace_id"]
+        assert s["args"]["parent_span_id"] == root_span["args"]["span_id"]
+    # span ids are unique per span
+    ids = [s["args"]["span_id"] for s in leafs] + [
+        root_span["args"]["span_id"]]
+    assert len(set(ids)) == 3, spans
+
+
+def test_trace_context_propagates_across_daemons():
+    """The trace context rides TaskSpecMsg over the wire: spans recorded
+    in DIFFERENT daemon processes still stitch into one trace."""
+    from ray_tpu.cluster_utils import ProcessCluster
+    ray_tpu.shutdown()
+    c = ProcessCluster(num_daemons=2, num_cpus=2)
+    try:
+        ray_tpu.init(address=c.address)
+        ray_tpu.set_profiling_enabled(True)
+
+        @ray_tpu.remote
+        def child():
+            return 1
+
+        @ray_tpu.remote
+        class TracedActor:
+            def mark(self):
+                return 2
+
+        actor = TracedActor.remote()
+
+        @ray_tpu.remote
+        def parent():
+            vals = ray_tpu.get([child.remote() for _ in range(3)])
+            # cross-daemon ACTOR call from inside the traced task: its
+            # span must stitch into the same trace (regression: the
+            # remote actor path bypassed trace attachment)
+            vals.append(ray_tpu.get(actor.mark.remote()))
+            return sum(vals)
+
+        assert ray_tpu.get(parent.remote(), timeout=60) == 5
+        trace = ray_tpu.timeline()
+        parents = [s for s in trace if s["name"].endswith(".parent")
+                   and s.get("args", {}).get("trace_id")]
+        children = [s for s in trace if s["name"].endswith(".child")
+                    and s.get("args", {}).get("trace_id")]
+        marks = [s for s in trace if s["name"].endswith(".mark")
+                 and s.get("args", {}).get("trace_id")]
+        assert len(parents) == 1 and len(children) == 3, (
+            [s["name"] for s in trace][:10])
+        assert len(marks) == 1, [s["name"] for s in trace][:10]
+        tid = parents[0]["args"]["trace_id"]
+        for s in children + marks:
+            assert s["args"]["trace_id"] == tid
+            assert (s["args"]["parent_span_id"]
+                    == parents[0]["args"]["span_id"])
+        ray_tpu.set_profiling_enabled(False)
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
