@@ -11,9 +11,14 @@ the tower logic is a sharding annotation, not an engine.
 
 from ray_tpu.rl.a2c import A2C, A2CConfig
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.ddpg import DDPG, DDPGConfig
+from ray_tpu.rl.ddppo import DDPPO, DDPPOConfig
 from ray_tpu.rl.dqn import DQN, DQNConfig
-from ray_tpu.rl.env import (CartPoleEnv, EnvSpec, PendulumEnv, VectorEnv,
-                            make_env, register_env)
+from ray_tpu.rl.env import (CartPoleEnv, EnvSpec, MemoryCueEnv, PendulumEnv,
+                            VectorEnv, make_env, register_env)
+from ray_tpu.rl.es import ARS, ARSConfig, ES, ESConfig
+from ray_tpu.rl.qmix import QMIX, QMIXConfig
+from ray_tpu.rl.recurrent import RecurrentPolicy
 from ray_tpu.rl.impala import (APPO, APPOConfig, Impala,
                                ImpalaConfig)
 from ray_tpu.rl.policy import Policy
@@ -23,6 +28,7 @@ from ray_tpu.rl.multi_agent import (CoordinationGameEnv, MultiAgentBatch,
                                     MultiAgentPPOConfig,
                                     MultiAgentRolloutWorker,
                                     RockPaperScissorsEnv,
+                                    TwoStepCooperativeGameEnv,
                                     register_multi_agent_env)
 from ray_tpu.rl.offline import (BC, BCConfig, CQL, CQLConfig,
                                 collect_dataset, read_dataset,
@@ -40,12 +46,15 @@ __all__ = [
     "ReplayBuffer", "PrioritizedReplayBuffer",
     "PPO", "PPOConfig", "A2C", "A2CConfig", "DQN", "DQNConfig",
     "Impala", "ImpalaConfig", "APPO", "APPOConfig",
-    "SAC", "SACConfig", "TD3", "TD3Config",
+    "SAC", "SACConfig", "TD3", "TD3Config", "DDPG", "DDPGConfig",
+    "DDPPO", "DDPPOConfig", "ES", "ESConfig", "ARS", "ARSConfig",
+    "QMIX", "QMIXConfig", "RecurrentPolicy",
     "BC", "BCConfig", "CQL", "CQLConfig",
     "collect_dataset", "read_dataset", "write_dataset",
     "MultiAgentEnv", "MultiAgentBatch", "MultiAgentRolloutWorker",
     "MultiAgentPPO", "MultiAgentPPOConfig", "CoordinationGameEnv",
-    "RockPaperScissorsEnv", "register_multi_agent_env",
-    "CartPoleEnv", "PendulumEnv", "VectorEnv", "EnvSpec", "make_env",
-    "register_env",
+    "RockPaperScissorsEnv", "TwoStepCooperativeGameEnv",
+    "register_multi_agent_env",
+    "CartPoleEnv", "MemoryCueEnv", "PendulumEnv", "VectorEnv", "EnvSpec",
+    "make_env", "register_env",
 ]

@@ -134,7 +134,7 @@ class Algorithm(Trainable):
 
     def _worker_kwargs(self) -> Dict[str, Any]:
         cfg = self.algo_config
-        return dict(
+        kw = dict(
             env_name_or_maker=cfg.env,
             env_config=cfg.env_config,
             num_envs=cfg.num_envs_per_worker,
@@ -145,6 +145,12 @@ class Algorithm(Trainable):
             lambda_=getattr(cfg, "lambda_", 0.95),
             compute_advantages=self._needs_advantages(),
         )
+        # Model catalog seam (rllib/models/catalog.py use_lstm /
+        # use_attention): memory models swap in the stateful policy.
+        from ray_tpu.rl.recurrent import RecurrentPolicy, uses_memory_model
+        if uses_memory_model(cfg.model):
+            kw["policy_cls"] = RecurrentPolicy
+        return kw
 
     def _needs_advantages(self) -> bool:
         return True

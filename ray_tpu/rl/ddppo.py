@@ -1,0 +1,233 @@
+"""DD-PPO: decentralized distributed PPO.
+
+Parity with ``rllib/algorithms/ddppo/ddppo.py:91,131-152`` (Wijmans et
+al. 2020): there is NO central learner — each rollout worker trains on
+its OWN locally-collected batch and synchronizes by ALLREDUCING
+GRADIENTS with its peers, so sample collection and SGD both scale with
+the worker count and no batch or weight tensors ever flow through the
+driver. All workers start from identical parameters and apply identical
+averaged updates, so their policies stay bit-identical without any
+weight broadcast.
+
+The gradient exchange rides this package's collective library
+(``ray_tpu.util.collective``): each DD-PPO worker joins one collective
+group and allreduces its flattened gradient pytree every SGD iteration
+— on TPU pods the same program shape rides ICI via the xla backend.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.rl import models as _models
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.env import Box, make_env
+from ray_tpu.rl.policy import Policy
+from ray_tpu.rl.ppo import PPOConfig
+from ray_tpu.rl.rollout_worker import RolloutWorker
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+class DDPPOConfig(PPOConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or DDPPO)
+        self.num_rollout_workers = 2   # the gradient-allreduce world
+        self.num_sgd_iter = 8
+        self.sgd_minibatch_size = 0    # 0 = whole local batch per step
+        self.train_batch_size = 512    # PER WORKER (DD-PPO semantics)
+        self.collective_backend = "cpu"
+
+
+class _DDPPOWorker:
+    """One decentralized worker: rollout sampling + local SGD with
+    per-iteration gradient allreduce. Runs as a ``ray_tpu`` actor."""
+
+    def __init__(self, worker_kwargs: Dict[str, Any], cfg_dict: Dict,
+                 init_weights: Dict, rank: int, world_size: int,
+                 group_name: str):
+        cfg = DDPPOConfig()
+        for k, v in cfg_dict.items():
+            if hasattr(cfg, k):
+                setattr(cfg, k, v)
+        self.cfg = cfg
+        self.rank = rank
+        self.world = world_size
+        self.group = group_name
+        self.worker = RolloutWorker(worker_index=rank, **worker_kwargs)
+        # identical start everywhere: decentralized sync only works if
+        # every peer applies identical updates to identical params
+        self.worker.set_weights(init_weights)
+        self.params = jax.tree_util.tree_map(
+            jnp.asarray, self.worker.get_weights())
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip), optax.adam(cfg.lr))
+        self.opt_state = self.optimizer.init(self.params)
+        self._continuous = isinstance(
+            self.worker.vector_env.spec.action_space, Box)
+        self._grad_fn = self._build_grad_fn()
+        from ray_tpu import collective as col
+        col.init_collective_group(world_size, rank,
+                                  backend=cfg.collective_backend,
+                                  group_name=group_name)
+
+    def _build_grad_fn(self):
+        cfg = self.cfg
+        continuous = self._continuous
+
+        def loss_fn(params, kl_coeff, batch):
+            dist_in, values = _models.actor_critic_apply(
+                params, batch[SampleBatch.OBS])
+            dist = _models.make_distribution(params, dist_in, continuous)
+            return _models.ppo_surrogate_loss(dist, values, batch, cfg,
+                                              kl_coeff)
+
+        return jax.jit(jax.grad(loss_fn, has_aux=True))
+
+    def run_iteration(self, kl_coeff: float) -> Dict[str, Any]:
+        """One DD-PPO iteration: sample locally, then num_sgd_iter rounds
+        of (local grad -> allreduce-mean -> identical apply)."""
+        from jax.flatten_util import ravel_pytree
+        from ray_tpu import collective as col
+        from ray_tpu.rl.sample_batch import concat_samples
+        cfg = self.cfg
+        batch = concat_samples(
+            [self.worker.sample() for _ in range(
+                max(1, cfg.train_batch_size
+                    // max(1, cfg.rollout_fragment_length
+                           * self.worker.vector_env.num_envs)))])
+        arrays = {k: jnp.asarray(np.asarray(v)) for k, v in batch.items()
+                  if k in (SampleBatch.OBS, SampleBatch.ACTIONS,
+                           SampleBatch.ACTION_LOGP, SampleBatch.ADVANTAGES,
+                           SampleBatch.VALUE_TARGETS)}
+        aux = {}
+        for _ in range(cfg.num_sgd_iter):
+            grads, aux = self._grad_fn(
+                self.params, jnp.asarray(kl_coeff, jnp.float32), arrays)
+            flat, unravel = ravel_pytree(grads)
+            # THE DD-PPO step: gradients — not weights — cross workers
+            summed = col.allreduce(np.asarray(flat),
+                                   group_name=self.group)
+            mean = jnp.asarray(summed) / self.world
+            updates, self.opt_state = self.optimizer.update(
+                unravel(mean), self.opt_state, self.params)
+            self.params = optax.apply_updates(self.params, updates)
+        self.worker.set_weights(jax.device_get(self.params))
+        return {"steps": len(batch),
+                "metrics": {k: float(v) for k, v in aux.items()},
+                "episodes": self.worker.pop_metrics()}
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights):
+        """Checkpoint restore: replace params everywhere they live; the
+        optimizer restarts fresh (documented restore semantics)."""
+        self.params = jax.tree_util.tree_map(jnp.asarray, weights)
+        self.opt_state = self.optimizer.init(self.params)
+        self.worker.set_weights(jax.device_get(self.params))
+        return True
+
+
+class DDPPO(Algorithm):
+    _config_cls = DDPPOConfig
+
+    @classmethod
+    def get_default_config(cls) -> DDPPOConfig:
+        return DDPPOConfig(cls)
+
+    def setup(self, config: Dict[str, Any]):
+        cfg = self.algo_config
+        if cfg.env is None:
+            raise ValueError("AlgorithmConfig.environment(env=...) not set")
+        if cfg.num_rollout_workers < 2:
+            raise ValueError("DD-PPO is a decentralized strategy: "
+                             "num_rollout_workers must be >= 2")
+        wk = dict(
+            env_name_or_maker=cfg.env, env_config=cfg.env_config,
+            num_envs=cfg.num_envs_per_worker,
+            rollout_fragment_length=cfg.rollout_fragment_length,
+            policy_config=dict(cfg.model), seed=cfg.seed,
+            gamma=cfg.gamma, lambda_=getattr(cfg, "lambda_", 0.95),
+            compute_advantages=True)
+        # identical init params, minted once
+        probe = make_env(cfg.env, dict(cfg.env_config or {}))
+        init = Policy(probe.spec, dict(cfg.model),
+                      seed=cfg.seed).get_weights()
+        n = cfg.num_rollout_workers
+        group = f"ddppo-{id(self)}"
+        cls = ray_tpu.remote(num_cpus=cfg.num_cpus_per_worker)(
+            _DDPPOWorker)
+        self._workers = [
+            cls.remote(wk, cfg.to_dict(), init, rank, n, group)
+            for rank in range(n)]
+        self._kl_coeff = cfg.kl_coeff
+        # wait for construction (collective join is rendezvous-blocking)
+        ray_tpu.get([w.get_weights.remote() for w in self._workers],
+                    timeout=120)
+
+    def training_step(self) -> Dict[str, Any]:
+        outs = ray_tpu.get(
+            [w.run_iteration.remote(self._kl_coeff)
+             for w in self._workers], timeout=600)
+        steps = sum(o["steps"] for o in outs)
+        self._timesteps_total += steps
+        for o in outs:
+            self._episode_history.extend(o["episodes"])
+        kl = float(np.mean([o["metrics"].get("kl", 0.0) for o in outs]))
+        cfg = self.algo_config
+        if kl > 2.0 * cfg.kl_target:
+            self._kl_coeff *= 1.5
+        elif kl < 0.5 * cfg.kl_target:
+            self._kl_coeff *= 0.5
+        agg = {k: float(np.mean([o["metrics"][k] for o in outs]))
+               for k in outs[0]["metrics"]}
+        agg.update(timesteps_this_iter=steps, kl_coeff=self._kl_coeff)
+        return agg
+
+    # workers ARE the learners; episode metrics flow through training_step
+    def step(self) -> Dict[str, Any]:
+        import time as _time
+        t0 = _time.time()
+        result = self.training_step()
+        self._episode_history = self._episode_history[-100:]
+        if self._episode_history:
+            rewards = [e["episode_reward"] for e in self._episode_history]
+            result["episode_reward_mean"] = float(np.mean(rewards))
+        result["timesteps_total"] = self._timesteps_total
+        result["sample_throughput"] = (
+            result.get("timesteps_this_iter", 0)
+            / max(1e-9, _time.time() - t0))
+        return result
+
+    def get_weights(self):
+        return ray_tpu.get(self._workers[0].get_weights.remote(),
+                           timeout=60)
+
+    def set_weights(self, weights):
+        """Broadcast identical weights to EVERY worker — the only write
+        that preserves the lockstep invariant (each worker also resets
+        its optimizer state, so peers stay bit-identical)."""
+        ray_tpu.get([w.set_weights.remote(weights)
+                     for w in self._workers], timeout=120)
+
+    def __getstate__(self):
+        return {"weights": self.get_weights(),
+                "timesteps_total": self._timesteps_total}
+
+    def __setstate__(self, state):
+        if state.get("weights") is not None:
+            self.set_weights(state["weights"])
+        self._timesteps_total = state.get("timesteps_total", 0)
+
+    def cleanup(self):
+        for w in getattr(self, "_workers", []):
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

@@ -174,6 +174,55 @@ class PendulumEnv(Env):
         return self._obs(), -cost, False, truncated, {}
 
 
+class MemoryCueEnv(Env):
+    """Partially observable recall task (the memory-model gate env).
+
+    A binary cue is visible ONLY at the first step; after ``delay``
+    blank steps the agent must act to match the cue (+1 reward, else
+    -1), then the episode ends. A memoryless policy can do no better
+    than 0 expected reward; any working recurrence/attention solves it
+    — which makes this the decisive test that ``use_lstm`` /
+    ``use_attention`` actually carry information through time.
+    Observation: [cue_+1, cue_-1, is_query, t/delay].
+    """
+
+    def __init__(self, config: Optional[dict] = None):
+        config = config or {}
+        self.delay = int(config.get("delay", 3))
+        self.spec = EnvSpec(
+            observation_space=Box(0.0, 1.0, (4,)),
+            action_space=Discrete(2),
+            max_episode_steps=self.delay + 2,
+        )
+        self._rng = np.random.default_rng(config.get("seed"))
+        self._cue = 0
+        self._t = 0
+
+    def _obs(self) -> np.ndarray:
+        o = np.zeros(4, np.float32)
+        if self._t == 0:
+            o[0 if self._cue == 0 else 1] = 1.0
+        if self._t == self.delay + 1:
+            o[2] = 1.0  # query flag
+        o[3] = self._t / (self.delay + 1)
+        return o
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._cue = int(self._rng.integers(2))
+        self._t = 0
+        return self._obs()
+
+    def step(self, action):
+        acted_on_query = self._t == self.delay + 1
+        self._t = min(self._t + 1, self.delay + 1)
+        if not acted_on_query:
+            return self._obs(), 0.0, False, False, {}
+        rew = 1.0 if int(action) == self._cue else -1.0
+        return self._obs(), rew, True, False, {}
+
+
 class VectorEnv:
     """Steps ``num_envs`` copies of an env with auto-reset on episode end.
 
@@ -276,4 +325,5 @@ def make_env(name_or_maker, config: Optional[dict] = None) -> Env:
 
 
 register_env("CartPole-v1", lambda c: CartPoleEnv(c))
+register_env("MemoryCue-v0", lambda c: MemoryCueEnv(c))
 register_env("Pendulum-v1", lambda c: PendulumEnv(c))

@@ -77,29 +77,68 @@ class ImpalaLearner:
     def __init__(self, init_params, cfg: ImpalaConfig, continuous: bool,
                  clip_param: float = None):
         self.cfg = cfg
-        self.optimizer = optax.chain(
-            optax.clip_by_global_norm(cfg.grad_clip),
-            optax.rmsprop(cfg.lr, decay=0.99, eps=0.1))
+        from ray_tpu.rl.recurrent import uses_memory_model
+        model_cfg = dict(cfg.model)
+        recurrent = uses_memory_model(model_cfg)
+        if recurrent:
+            # The classic IMPALA rmsprop(eps=0.1) effectively multiplies
+            # small gradients by ~1/eps — tuned for its large fcnet, it
+            # destabilizes the gated-recurrence gradients (measured:
+            # CartPole pinned at random under rmsprop, learns under
+            # adam). Memory models get adam, like the reference's
+            # recurrent tuned examples.
+            self.optimizer = optax.chain(
+                optax.clip_by_global_norm(cfg.grad_clip),
+                optax.adam(cfg.lr))
+        else:
+            self.optimizer = optax.chain(
+                optax.clip_by_global_norm(cfg.grad_clip),
+                optax.rmsprop(cfg.lr, decay=0.99, eps=0.1))
         self.params = jax.tree_util.tree_map(jnp.asarray, init_params)
         self.opt_state = self.optimizer.init(self.params)
         gamma = cfg.gamma
 
+        def forward(p, batch):
+            """-> (target_logp [T,B], values [T,B], entropy, boot_values
+            [B]); memory models replay each fragment from its stored
+            state, feedforward models evaluate flat."""
+            T, B = batch[SampleBatch.REWARDS].shape
+            obs = batch[SampleBatch.OBS]
+            actions = batch[SampleBatch.ACTIONS]
+            if recurrent:
+                from ray_tpu.rl.recurrent import (memory_bootstrap_value,
+                                                  memory_forward)
+                boundary = (batch[SampleBatch.TERMINATEDS]
+                            | batch[SampleBatch.TRUNCATEDS]
+                            ).astype(jnp.float32)        # [T, B]
+                resets = jnp.concatenate(
+                    [jnp.zeros((1, B)), boundary[:-1]], axis=0)
+                dist_in, values, final_state = memory_forward(
+                    p, model_cfg, jnp.swapaxes(obs, 0, 1),
+                    batch["state_in"][0],
+                    jnp.swapaxes(resets, 0, 1))
+                dist = _models.make_distribution(
+                    p, jnp.swapaxes(dist_in, 0, 1), continuous)
+                target_logp = dist.logp(actions)
+                boot_values = memory_bootstrap_value(
+                    p, model_cfg, batch["bootstrap_obs"][-1],
+                    final_state * (1.0 - boundary[-1][:, None]))
+                return (target_logp, jnp.swapaxes(values, 0, 1),
+                        dist.entropy().mean(), boot_values)
+            dist_in, values = _models.actor_critic_apply(
+                p, obs.reshape((T * B,) + obs.shape[2:]))
+            dist = _models.make_distribution(p, dist_in, continuous)
+            flat_actions = actions.reshape((T * B,) + actions.shape[2:])
+            return (dist.logp(flat_actions).reshape(T, B),
+                    values.reshape(T, B), dist.entropy().mean(),
+                    _models.actor_critic_apply(
+                        p, batch["bootstrap_obs"][-1])[1])
+
         def update(params, opt_state, batch):
             # Columns arrive time-major [T, B, ...].
             def loss_fn(p):
-                T, B = batch[SampleBatch.REWARDS].shape
-                obs = batch[SampleBatch.OBS]
-                dist_in, values = _models.actor_critic_apply(
-                    p, obs.reshape((T * B,) + obs.shape[2:]))
-                dist = _models.make_distribution(
-                    p, dist_in, continuous)
-                actions = batch[SampleBatch.ACTIONS].reshape(
-                    (T * B,) + batch[SampleBatch.ACTIONS].shape[2:])
-                target_logp = dist.logp(actions).reshape(T, B)
-                values = values.reshape(T, B)
-                entropy = dist.entropy().mean()
-                _, boot_values = _models.actor_critic_apply(
-                    p, batch["bootstrap_obs"][-1])
+                (target_logp, values, entropy,
+                 boot_values) = forward(p, batch)
                 # Truncation cuts the recursion too: the next in-fragment
                 # row belongs to the auto-reset episode, so bootstrapping
                 # across it would blend unrelated returns. Treating
@@ -176,9 +215,12 @@ class Impala(Algorithm):
         T = self.algo_config.rollout_fragment_length
         n = (len(batch) // T) * T
         out = {}
-        for k in (SampleBatch.OBS, SampleBatch.ACTIONS, SampleBatch.REWARDS,
-                  SampleBatch.TERMINATEDS, SampleBatch.TRUNCATEDS,
-                  SampleBatch.ACTION_LOGP, "bootstrap_obs"):
+        keys = [SampleBatch.OBS, SampleBatch.ACTIONS, SampleBatch.REWARDS,
+                SampleBatch.TERMINATEDS, SampleBatch.TRUNCATEDS,
+                SampleBatch.ACTION_LOGP, "bootstrap_obs"]
+        if "state_in" in batch:
+            keys.append("state_in")  # memory models: fragment-start state
+        for k in keys:
             v = batch[k][:n]
             out[k] = np.swapaxes(
                 v.reshape((n // T, T) + v.shape[1:]), 0, 1)

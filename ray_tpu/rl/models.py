@@ -58,6 +58,40 @@ def actor_critic_apply(params, obs) -> Tuple[jax.Array, jax.Array]:
     return logits, values
 
 
+def ppo_surrogate_loss(dist, values, batch, cfg, kl_coeff):
+    """The PPO loss body shared by PPOLearner, RecurrentPPOLearner and
+    the DD-PPO workers: clipped surrogate + clipped vf error + entropy
+    bonus + logp-ratio KL penalty (one copy of the math; the callers
+    differ only in how (dist, values) were produced).
+
+    ``batch`` needs OBS-aligned ACTIONS / ACTION_LOGP / ADVANTAGES /
+    VALUE_TARGETS. Returns (total_loss, aux dict).
+    """
+    from ray_tpu.rl.sample_batch import SampleBatch
+    logp = dist.logp(batch[SampleBatch.ACTIONS])
+    ratio = jnp.exp(logp - batch[SampleBatch.ACTION_LOGP])
+    surrogate = clipped_surrogate(ratio, batch[SampleBatch.ADVANTAGES],
+                                  cfg.clip_param)
+    vf_err = jnp.minimum(
+        (values - batch[SampleBatch.VALUE_TARGETS]) ** 2,
+        cfg.vf_clip_param ** 2)
+    entropy = dist.entropy()
+    # Adaptive-KL penalty vs the behavior logp (rllib uses dist KL
+    # against the old dist; the logp-ratio estimator
+    # E[logp_old - logp] has the same fixed point and needs no old
+    # dist params on device).
+    kl = jnp.maximum(batch[SampleBatch.ACTION_LOGP] - logp, -10.0)
+    total = (-jnp.mean(surrogate)
+             + cfg.vf_loss_coeff * 0.5 * jnp.mean(vf_err)
+             - cfg.entropy_coeff * jnp.mean(entropy)
+             + kl_coeff * jnp.mean(kl))
+    aux = {"policy_loss": -jnp.mean(surrogate),
+           "vf_loss": 0.5 * jnp.mean(vf_err),
+           "entropy": jnp.mean(entropy),
+           "kl": jnp.mean(kl)}
+    return total, aux
+
+
 class Categorical:
     """Categorical over logits (rllib TorchCategorical equivalent)."""
 

@@ -95,6 +95,59 @@ class CoordinationGameEnv(MultiAgentEnv):
         return obs, rews, terms, truncs, {a: {} for a in self.agent_ids}
 
 
+class TwoStepCooperativeGameEnv(MultiAgentEnv):
+    """The QMIX paper's two-step cooperative matrix game (Rashid et al.
+    2018, §6.1): agent_0's first action picks payoff matrix A or B; in
+    the second step both agents act and the TEAM receives the matrix
+    payoff. Matrix A pays 7 everywhere; matrix B pays [[0,1],[1,8]].
+    The optimal joint policy (pick B, then both play 1) earns 8 — but a
+    purely additive value factorization (VDN) converges to the safe 7,
+    which is exactly the representational gap QMIX's monotonic mixing
+    closes. Observation: one-hot of the phase (start/A/B) per agent;
+    ``get_state()`` exposes the same as the mixer's global state."""
+
+    agent_ids = ("agent_0", "agent_1")
+
+    def __init__(self, config: Optional[dict] = None):
+        obs_space = Box(0.0, 1.0, (3,))
+        self.observation_spaces = {a: obs_space for a in self.agent_ids}
+        self.action_spaces = {a: Discrete(2) for a in self.agent_ids}
+        self._phase = 0  # 0 = start, 1 = matrix A, 2 = matrix B
+
+    def _obs(self):
+        o = np.zeros(3, np.float32)
+        o[self._phase] = 1.0
+        return {a: o.copy() for a in self.agent_ids}
+
+    def get_state(self) -> np.ndarray:
+        s = np.zeros(3, np.float32)
+        s[self._phase] = 1.0
+        return s
+
+    def reset(self, seed: Optional[int] = None):
+        self._phase = 0
+        return self._obs()
+
+    def step(self, actions: Dict[str, Any]):
+        if self._phase == 0:
+            self._phase = 1 if int(actions["agent_0"]) == 0 else 2
+            r, done = 0.0, False
+        else:
+            a0, a1 = int(actions["agent_0"]), int(actions["agent_1"])
+            if self._phase == 1:
+                r = 7.0
+            else:
+                r = [[0.0, 1.0], [1.0, 8.0]][a0][a1]
+            done = True
+        obs = self._obs()
+        rews = {a: r for a in self.agent_ids}
+        terms = {a: done for a in self.agent_ids}
+        truncs = {a: False for a in self.agent_ids}
+        terms["__all__"] = done
+        truncs["__all__"] = False
+        return obs, rews, terms, truncs, {a: {} for a in self.agent_ids}
+
+
 class RockPaperScissorsEnv(MultiAgentEnv):
     """Zero-sum repeated RPS (``rllib/examples/env/rock_paper_scissors``).
 

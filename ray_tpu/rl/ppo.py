@@ -73,29 +73,8 @@ class PPOLearner:
             dist_in, values = _models.actor_critic_apply(
                 params, batch[SampleBatch.OBS])
             dist = _models.make_distribution(params, dist_in, continuous)
-            logp = dist.logp(batch[SampleBatch.ACTIONS])
-            ratio = jnp.exp(logp - batch[SampleBatch.ACTION_LOGP])
-            adv = batch[SampleBatch.ADVANTAGES]
-            surrogate = _models.clipped_surrogate(ratio, adv,
-                                                  cfg.clip_param)
-            targets = batch[SampleBatch.VALUE_TARGETS]
-            vf_err = jnp.minimum((values - targets) ** 2,
-                                 cfg.vf_clip_param ** 2)
-            entropy = dist.entropy()
-            # Adaptive-KL penalty vs the behavior logp (rllib uses dist KL
-            # against the old dist; the logp-ratio estimator
-            # E[logp_old - logp] has the same fixed point and needs no old
-            # dist params on device).
-            kl = jnp.maximum(batch[SampleBatch.ACTION_LOGP] - logp, -10.0)
-            total = (-jnp.mean(surrogate)
-                     + cfg.vf_loss_coeff * 0.5 * jnp.mean(vf_err)
-                     - cfg.entropy_coeff * jnp.mean(entropy)
-                     + kl_coeff * jnp.mean(kl))
-            aux = {"policy_loss": -jnp.mean(surrogate),
-                   "vf_loss": 0.5 * jnp.mean(vf_err),
-                   "entropy": jnp.mean(entropy),
-                   "kl": jnp.mean(kl)}
-            return total, aux
+            return _models.ppo_surrogate_loss(dist, values, batch, cfg,
+                                              kl_coeff)
 
         def train_fn(params, opt_state, rng, kl_coeff, batch):
             n = batch[SampleBatch.OBS].shape[0]
@@ -168,6 +147,12 @@ class PPO(Algorithm):
         cfg = self.algo_config
         lw = self.workers.local_worker
         self.kl_coeff = cfg.kl_coeff
+        from ray_tpu.rl.recurrent import (RecurrentPPOLearner,
+                                          uses_memory_model)
+        if uses_memory_model(cfg.model):
+            return RecurrentPPOLearner(lw.get_weights(), cfg,
+                                       lw.policy.continuous,
+                                       cfg.rollout_fragment_length)
         return PPOLearner(lw.get_weights(), cfg, lw.policy.continuous,
                           mesh=cfg.mesh)
 
@@ -180,12 +165,16 @@ class PPO(Algorithm):
         # Batch-level advantage standardization (ppo.py:415).
         batch[SampleBatch.ADVANTAGES] = standardize(
             batch[SampleBatch.ADVANTAGES])
-        # Pad to the static train_batch_size so XLA compiles once.
-        n = (len(batch) // cfg.sgd_minibatch_size) * cfg.sgd_minibatch_size
-        if n == 0:
-            batch = batch.pad_to(cfg.sgd_minibatch_size)
-        else:
-            batch = batch.slice(0, n)
+        # Pad to the static train_batch_size so XLA compiles once. The
+        # sequence learner shapes its own batches (slicing here could
+        # cut a fragment mid-sequence).
+        if not getattr(self.learner, "handles_batch_shaping", False):
+            n = (len(batch) // cfg.sgd_minibatch_size
+                 ) * cfg.sgd_minibatch_size
+            if n == 0:
+                batch = batch.pad_to(cfg.sgd_minibatch_size)
+            else:
+                batch = batch.slice(0, n)
         metrics = self.learner.train(batch, self.kl_coeff)
         # Adaptive KL coefficient (ppo.py:433-437).
         kl = metrics["kl"]

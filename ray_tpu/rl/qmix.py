@@ -1,0 +1,317 @@
+"""QMIX: monotonic value-function factorization for cooperative MARL.
+
+Parity with ``rllib/algorithms/qmix`` (Rashid et al. 2018): per-agent
+utility networks Q_i(obs_i, a_i) combined by a MIXING network whose
+weights are produced by hypernetworks conditioned on the global state
+and constrained non-negative — so argmax_a Q_tot decomposes into
+per-agent argmaxes (the IGM property) while Q_tot can still represent
+non-additive team payoffs that defeat VDN.
+
+Runtime shape (this package's DQN family): epsilon-greedy joint
+sampling from a ``MultiAgentEnv``, transition replay over JOINT
+transitions (all agents' obs/actions + the team reward at one step),
+and one jitted update fusing agent nets, hypernets, double-Q targets,
+and the periodic target sync. Agents share one utility network with a
+one-hot agent id appended to the observation (the reference's default
+parameter sharing).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl import models as _models
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.env import make_env
+
+
+class QMIXConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or QMIX)
+        self.lr = 2e-4
+        self.mixing_embed_dim = 16
+        self.epsilon_start = 1.0
+        self.epsilon_end = 0.05
+        self.epsilon_decay_steps = 1000
+        self.train_batch_size = 128
+        self.replay_capacity = 10_000
+        self.target_update_freq = 100  # learner steps between syncs
+        self.episodes_per_iter = 8
+        self.n_updates_per_iter = 16
+        self.learning_starts = 200     # joint transitions before updates
+        self.model = {"fcnet_hiddens": (64,)}
+        self.double_q = True
+
+
+class QMIXLearner:
+    """Shared utility net + monotonic mixer, one jitted update."""
+
+    def __init__(self, obs_dim: int, n_agents: int, n_actions: int,
+                 state_dim: int, cfg: QMIXConfig):
+        self.cfg = cfg
+        embed = cfg.mixing_embed_dim
+        hidden = tuple(cfg.model.get("fcnet_hiddens", (64,)))
+        ks = jax.random.split(jax.random.key(cfg.seed or 0), 5)
+        in_dim = obs_dim + n_agents  # one-hot agent id appended
+        self.params = {
+            "agent": _models.mlp_init(ks[0], in_dim, hidden, n_actions),
+            # hypernetworks: state -> mixer weights (abs() at use site)
+            "hyper_w1": _models.mlp_init(ks[1], state_dim, (embed,),
+                                         n_agents * embed),
+            "hyper_b1": _models.mlp_init(ks[2], state_dim, (), embed),
+            "hyper_w2": _models.mlp_init(ks[3], state_dim, (embed,), embed),
+            "hyper_v": _models.mlp_init(ks[4], state_dim, (embed,), 1),
+        }
+        self.target = jax.tree_util.tree_map(jnp.array, self.params)
+        self.opt = optax.chain(optax.clip_by_global_norm(10.0),
+                               optax.adam(cfg.lr))
+        self.opt_state = self.opt.init(self.params)
+        self.steps = 0
+        gamma = cfg.gamma
+        eye = jnp.eye(n_agents)
+
+        def agent_qs(p, obs):
+            """obs [B, n_agents, obs_dim] -> [B, n_agents, n_actions]."""
+            ids = jnp.broadcast_to(eye, obs.shape[:-2] + eye.shape)
+            x = jnp.concatenate([obs, ids], axis=-1)
+            return _models.mlp_apply(p["agent"], x, activation="relu")
+
+        def mix(p, qs, state):
+            """qs [B, n_agents] + state [B, state_dim] -> Q_tot [B]."""
+            w1 = jnp.abs(_models.mlp_apply(p["hyper_w1"], state)
+                         ).reshape(state.shape[0], n_agents, embed)
+            b1 = _models.mlp_apply(p["hyper_b1"], state)
+            h = jax.nn.elu(jnp.einsum("ba,bae->be", qs, w1) + b1)
+            w2 = jnp.abs(_models.mlp_apply(p["hyper_w2"], state))
+            v = _models.mlp_apply(p["hyper_v"], state)[..., 0]
+            return jnp.einsum("be,be->b", h, w2) + v
+
+        def update(params, target, opt_state, batch):
+            obs = batch["obs"]            # [B, n_agents, obs_dim]
+            acts = batch["actions"]       # [B, n_agents] int
+            rews = batch["rewards"]       # [B] team reward
+            nxt = batch["next_obs"]
+            state = batch["state"]        # [B, state_dim]
+            nxt_state = batch["next_state"]
+            not_done = 1.0 - batch["dones"].astype(jnp.float32)
+            tq_all = agent_qs(target, nxt)
+            if cfg.double_q:
+                sel = jnp.argmax(agent_qs(params, nxt), axis=-1)
+            else:
+                sel = jnp.argmax(tq_all, axis=-1)
+            tq = jnp.take_along_axis(tq_all, sel[..., None],
+                                     axis=-1)[..., 0]
+            y = rews + gamma * not_done * jax.lax.stop_gradient(
+                mix(target, tq, nxt_state))
+
+            def loss_fn(p):
+                q_all = agent_qs(p, obs)
+                q = jnp.take_along_axis(q_all, acts[..., None],
+                                        axis=-1)[..., 0]
+                q_tot = mix(p, q, state)
+                return jnp.mean((q_tot - y) ** 2), jnp.mean(q_tot)
+
+            (loss, q_mean), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {"loss": loss, "q_tot_mean": q_mean}
+
+        self._update = jax.jit(update, donate_argnums=(0, 2))
+        self._agent_qs = jax.jit(lambda p, obs: agent_qs(p, obs))
+
+    def act(self, obs_stack: np.ndarray, epsilon: float,
+            rng: np.random.Generator) -> np.ndarray:
+        """Greedy per-agent argmax (IGM: joint argmax decomposes) with
+        per-agent epsilon exploration. obs_stack [n_agents, obs_dim]."""
+        qs = np.asarray(self._agent_qs(self.params, obs_stack[None]))[0]
+        greedy = qs.argmax(axis=-1)
+        explore = rng.random(len(greedy)) < epsilon
+        random_a = rng.integers(0, qs.shape[-1], len(greedy))
+        return np.where(explore, random_a, greedy)
+
+    def train(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        self.steps += 1
+        arrays = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.target, self.opt_state, arrays)
+        if self.steps % self.cfg.target_update_freq == 0:
+            self.target = jax.tree_util.tree_map(jnp.array, self.params)
+        return {k: float(v) for k, v in aux.items()}
+
+    def state(self):
+        return jax.device_get((self.params, self.target, self.opt_state,
+                               self.steps))
+
+    def set_state(self, state):
+        p, t, o, s = state
+        self.params = jax.tree_util.tree_map(jnp.asarray, p)
+        self.target = jax.tree_util.tree_map(jnp.asarray, t)
+        self.opt_state = jax.tree_util.tree_map(jnp.asarray, o)
+        self.steps = s
+
+
+class QMIX(Algorithm):
+    _config_cls = QMIXConfig
+
+    @classmethod
+    def get_default_config(cls) -> QMIXConfig:
+        return QMIXConfig(cls)
+
+    def setup(self, config: Dict[str, Any]):
+        cfg = self.algo_config
+        if cfg.env is None:
+            raise ValueError("AlgorithmConfig.environment(env=...) not set")
+        self.env = make_env(cfg.env, dict(cfg.env_config or {}))
+        self.agent_ids = tuple(self.env.agent_ids)
+        first = self.agent_ids[0]
+        self.obs_dim = int(np.prod(
+            self.env.observation_spaces[first].shape))
+        self.n_actions = int(self.env.action_spaces[first].n)
+        self._state_fn = getattr(self.env, "get_state", None)
+        if self._state_fn is not None:
+            self.state_dim = int(np.prod(self._state_fn().shape))
+        else:
+            # default global state: concatenation of all agent obs
+            self.state_dim = self.obs_dim * len(self.agent_ids)
+        self.learner = QMIXLearner(self.obs_dim, len(self.agent_ids),
+                                   self.n_actions, self.state_dim, cfg)
+        self._replay: List[tuple] = []
+        self._rng = np.random.default_rng(cfg.seed or 0)
+        self._env_steps = 0
+
+    def _global_state(self, obs: Dict[str, np.ndarray]) -> np.ndarray:
+        if self._state_fn is not None:
+            return np.asarray(self._state_fn(), np.float32).reshape(-1)
+        return np.concatenate(
+            [np.asarray(obs[a], np.float32).reshape(-1)
+             for a in self.agent_ids])
+
+    def _epsilon(self) -> float:
+        cfg = self.algo_config
+        frac = min(1.0, self._env_steps / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_start + frac * (cfg.epsilon_end
+                                           - cfg.epsilon_start)
+
+    def _collect_episode(self) -> float:
+        cfg = self.algo_config
+        obs = self.env.reset(seed=int(self._rng.integers(1 << 31)))
+        total = 0.0
+        length = 0
+        for _ in range(1000):
+            stack = np.stack([np.asarray(obs[a], np.float32).reshape(-1)
+                              for a in self.agent_ids])
+            acts = self.learner.act(stack, self._epsilon(), self._rng)
+            action_dict = {a: int(acts[i])
+                           for i, a in enumerate(self.agent_ids)}
+            state = self._global_state(obs)
+            nxt, rews, terms, truncs, _ = self.env.step(action_dict)
+            team_r = float(sum(rews.values())) / len(self.agent_ids)
+            done = bool(terms.get("__all__")) or bool(truncs.get("__all__"))
+            nxt_stack = np.stack(
+                [np.asarray(nxt[a], np.float32).reshape(-1)
+                 for a in self.agent_ids])
+            self._replay.append((stack, acts, team_r, nxt_stack, state,
+                                 self._global_state(nxt),
+                                 bool(terms.get("__all__"))))
+            if len(self._replay) > cfg.replay_capacity:
+                del self._replay[: cfg.replay_capacity // 10]
+            total += team_r
+            length += 1
+            self._env_steps += 1
+            obs = nxt
+            if done:
+                break
+        self._episode_history.append(
+            {"episode_reward": total, "episode_len": length})
+        return total
+
+    def _sample_batch(self) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, len(self._replay),
+                                 self.algo_config.train_batch_size)
+        rows = [self._replay[i] for i in idx]
+        return {
+            "obs": np.stack([r[0] for r in rows]),
+            "actions": np.stack([r[1] for r in rows]).astype(np.int32),
+            "rewards": np.asarray([r[2] for r in rows], np.float32),
+            "next_obs": np.stack([r[3] for r in rows]),
+            "state": np.stack([r[4] for r in rows]),
+            "next_state": np.stack([r[5] for r in rows]),
+            "dones": np.asarray([r[6] for r in rows], np.float32),
+        }
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        before = self._env_steps
+        for _ in range(cfg.episodes_per_iter):
+            self._collect_episode()
+        metrics: Dict[str, Any] = {
+            "timesteps_this_iter": self._env_steps - before,
+            "epsilon": self._epsilon(),
+        }
+        self._timesteps_total = self._env_steps
+        if len(self._replay) >= cfg.learning_starts:
+            auxes = [self.learner.train(self._sample_batch())
+                     for _ in range(cfg.n_updates_per_iter)]
+            metrics.update({k: float(np.mean([a[k] for a in auxes]))
+                            for k in auxes[-1]})
+        return metrics
+
+    # self-contained sampling: no worker set
+    def step(self) -> Dict[str, Any]:
+        import time as _time
+        t0 = _time.time()
+        result = self.training_step()
+        self._episode_history = self._episode_history[-100:]
+        rewards = [e["episode_reward"] for e in self._episode_history]
+        result["episode_reward_mean"] = float(np.mean(rewards))
+        result["episodes_this_iter"] = self.algo_config.episodes_per_iter
+        result["timesteps_total"] = self._timesteps_total
+        result["sample_throughput"] = (
+            result.get("timesteps_this_iter", 0)
+            / max(1e-9, _time.time() - t0))
+        return result
+
+    def get_weights(self):
+        return {"params": jax.device_get(self.learner.params)}
+
+    def set_weights(self, weights):
+        self.learner.params = jax.tree_util.tree_map(
+            jnp.asarray, weights["params"])
+
+    def _learner_state(self):
+        return {"learner": self.learner.state(),
+                "env_steps": self._env_steps}
+
+    def _set_learner_state(self, state):
+        if state:
+            self.learner.set_state(state["learner"])
+            self._env_steps = state.get("env_steps", 0)
+
+    def greedy_joint_return(self, episodes: int = 10) -> float:
+        """Evaluation: greedy (epsilon=0) episodes, mean team return."""
+        totals = []
+        for _ in range(episodes):
+            obs = self.env.reset(seed=int(self._rng.integers(1 << 31)))
+            total = 0.0
+            for _ in range(1000):
+                stack = np.stack(
+                    [np.asarray(obs[a], np.float32).reshape(-1)
+                     for a in self.agent_ids])
+                acts = self.learner.act(stack, 0.0, self._rng)
+                obs, rews, terms, truncs, _ = self.env.step(
+                    {a: int(acts[i])
+                     for i, a in enumerate(self.agent_ids)})
+                total += float(sum(rews.values())) / len(self.agent_ids)
+                if terms.get("__all__") or truncs.get("__all__"):
+                    break
+            totals.append(total)
+        return float(np.mean(totals))
+
+    def cleanup(self):
+        pass

@@ -1,0 +1,421 @@
+"""Recurrent (LSTM) and attention policy models for PPO/IMPALA.
+
+Parity with the reference model catalog's ``use_lstm`` /
+``use_attention`` wrappers (``rllib/models/catalog.py:1``,
+``torch/recurrent_net.py``, ``torch/attention_net.py`` GTrXL): a memory
+core between the observation encoder and the pi/vf heads, enabled by
+``model={"use_lstm": True}`` or ``{"use_attention": True}`` on any
+algorithm whose worker/learner pair routes through this module (PPO,
+IMPALA).
+
+TPU-first shape: BOTH cores are expressed as one ``core_step``
+(state [B, S] -> state [B, S]) so sampling is a T=1 step and learning
+is a ``lax.scan`` over the SAME function — one compiled program, no
+python-side sequence bookkeeping, mid-fragment episode boundaries
+handled by a reset mask inside the scan:
+
+- LSTM: state = [h, c] concatenated.
+- Attention: state = the rolling window of the last K encoded frames
+  (+ a validity flag per slot); each step attends its current frame
+  over the window (single head, learned positional embeddings) — the
+  fixed-window "transformer-lite" memory the reference's GTrXL
+  truncates to in practice.
+
+The fragment contract matches rllib's ``state_in`` + sequence replay:
+the rollout worker snapshots per-env state at fragment start
+(``rollout_worker.py sample()``), the learner replays each fragment
+from that snapshot with in-scan resets at episode ends.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl import models as _models
+from ray_tpu.rl.env import Box, EnvSpec
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+# ---------------------------------------------------------------------------
+# memory cores
+# ---------------------------------------------------------------------------
+
+def _branch_init(key: jax.Array, obs_dim: int,
+                 config: Dict[str, Any]) -> Tuple[Dict, int, int]:
+    """One encoder+core branch -> (params, state_size, out_dim)."""
+    use_attn = bool(config.get("use_attention"))
+    feat = int(config.get("encoder_dim",
+                          config.get("attention_dim", 64) if use_attn
+                          else config.get("lstm_cell_size", 64)))
+    k_enc, k1, k2, k3, k4 = jax.random.split(key, 5)
+    params: Dict[str, Any] = {
+        "encoder": _models.mlp_init(k_enc, obs_dim, (), feat,
+                                    out_scale=1.0),
+    }
+    if use_attn:
+        K = int(config.get("attention_window", 8))
+        d = feat
+        params["attn"] = {
+            "wq": jax.nn.initializers.orthogonal()(k1, (d, d)),
+            "wk": jax.nn.initializers.orthogonal()(k2, (d, d)),
+            "wv": jax.nn.initializers.orthogonal()(k3, (d, d)),
+            "pos": 0.01 * jax.random.normal(k4, (K, d)),
+        }
+        return params, K * (d + 1), d  # window + per-slot validity flag
+    h = int(config.get("lstm_cell_size", 64))
+    params["lstm"] = {
+        "wx": jax.nn.initializers.orthogonal()(k1, (feat, 4 * h)),
+        "wh": jax.nn.initializers.orthogonal()(k2, (h, 4 * h)),
+        # forget-gate bias 1.0 (standard trainability trick)
+        "b": jnp.concatenate([jnp.zeros(h), jnp.ones(h),
+                              jnp.zeros(2 * h)]),
+    }
+    return params, 2 * h, h
+
+
+def memory_model_init(key: jax.Array, obs_dim: int, action_dim: int,
+                      config: Dict[str, Any], continuous: bool
+                      ) -> Tuple[Dict[str, Any], int]:
+    """-> (params, flat state size). ``config`` keys: use_lstm,
+    lstm_cell_size, use_attention, attention_window, attention_dim,
+    vf_share_layers.
+
+    The value function gets its OWN encoder+core by default
+    (``vf_share_layers=False``, the reference PPO default): with a
+    shared trunk the value-regression gradient (errors on the scale of
+    RETURNS) dwarfs the policy gradient and churns the features under
+    the pi head every update — measured on CartPole as a policy pinned
+    at random-level return while vf_loss dominated. Untied branches
+    double the core but make both objectives independently stable."""
+    k_pi_net, k_vf_net, k_pi, k_vf = jax.random.split(key, 4)
+    share = bool(config.get("vf_share_layers", False))
+    pi_net, s_size, core_out = _branch_init(k_pi_net, obs_dim, config)
+    params: Dict[str, Any] = {"pi_net": pi_net}
+    state_size = s_size
+    if not share:
+        vf_net, vs, _ = _branch_init(k_vf_net, obs_dim, config)
+        params["vf_net"] = vf_net
+        state_size += vs
+    params["pi"] = _models.mlp_init(k_pi, core_out, (), action_dim)
+    params["vf"] = _models.mlp_init(k_vf, core_out, (), 1, out_scale=1.0)
+    if continuous:
+        params["log_std"] = jnp.zeros((action_dim,), jnp.float32)
+    return params, state_size
+
+
+def _branch_step(branch, config: Dict[str, Any], obs: jax.Array,
+                 state: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """One memory step of one branch: obs [B, D] + state [B, S]."""
+    feat = jnp.tanh(_models.mlp_apply(branch["encoder"], obs))
+    if config.get("use_attention"):
+        ap = branch["attn"]
+        K, d = ap["pos"].shape
+        win = state.reshape(state.shape[0], K, d + 1)
+        # roll the window and append the current frame (valid flag 1)
+        new_row = jnp.concatenate(
+            [feat, jnp.ones(feat.shape[:-1] + (1,))], axis=-1)
+        win = jnp.concatenate([win[:, 1:], new_row[:, None]], axis=1)
+        frames, valid = win[..., :d], win[..., d]
+        q = feat @ ap["wq"]                        # [B, d]
+        k = (frames + ap["pos"]) @ ap["wk"]        # [B, K, d]
+        v = frames @ ap["wv"]
+        att = jnp.einsum("bd,bkd->bk", q, k) / jnp.sqrt(float(d))
+        att = att + (1.0 - valid) * -1e9           # mask empty slots
+        att = jax.nn.softmax(att, axis=-1)
+        out = jnp.tanh(feat + jnp.einsum("bk,bkd->bd", att, v))
+        return out, win.reshape(state.shape)
+    lp = branch["lstm"]
+    h_size = lp["wh"].shape[0]
+    h, c = state[:, :h_size], state[:, h_size:]
+    gates = feat @ lp["wx"] + h @ lp["wh"] + lp["b"]
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return h, jnp.concatenate([h, c], axis=-1)
+
+
+def _split_state(params, state):
+    """[B, S] -> (pi_state, vf_state_or_None), by branch sizes."""
+    if "vf_net" not in params:
+        return state, None
+    half = state.shape[-1] // 2
+    return state[..., :half], state[..., half:]
+
+
+def _core_step(params, config: Dict[str, Any], obs: jax.Array,
+               state: jax.Array
+               ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Both branches, one step: -> (pi_out, vf_out, state')."""
+    pi_s, vf_s = _split_state(params, state)
+    pi_out, pi_s = _branch_step(params["pi_net"], config, obs, pi_s)
+    if vf_s is None:
+        return pi_out, pi_out, pi_s
+    vf_out, vf_s = _branch_step(params["vf_net"], config, obs, vf_s)
+    return pi_out, vf_out, jnp.concatenate([pi_s, vf_s], axis=-1)
+
+
+def memory_forward(params, config, obs_seq: jax.Array, state0: jax.Array,
+                   resets: jax.Array
+                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Sequence replay: obs [B, T, obs], state0 [B, S], resets [B, T]
+    (1.0 where a NEW episode starts at step t) -> (dist_in [B, T, A],
+    values [B, T], final_state [B, S]). One lax.scan over the shared
+    core step; the final state feeds bootstrap-value computation
+    (V-trace learners)."""
+
+    def step(state, inputs):
+        obs_t, reset_t = inputs                    # [B, D], [B]
+        state = state * (1.0 - reset_t)[:, None]
+        pi_out, vf_out, state = _core_step(params, config, obs_t, state)
+        return state, (pi_out, vf_out)
+
+    final_state, (pi_outs, vf_outs) = jax.lax.scan(
+        step, state0,
+        (jnp.swapaxes(obs_seq, 0, 1), jnp.swapaxes(resets, 0, 1)))
+    pi_outs = jnp.swapaxes(pi_outs, 0, 1)          # [B, T, d]
+    vf_outs = jnp.swapaxes(vf_outs, 0, 1)
+    dist_in = _models.mlp_apply(params["pi"], pi_outs)
+    values = _models.mlp_apply(params["vf"], vf_outs)[..., 0]
+    return dist_in, values, final_state
+
+
+def memory_bootstrap_value(params, config, boot_obs: jax.Array,
+                           final_state: jax.Array) -> jax.Array:
+    """Value of the post-fragment observation from the fragment-end
+    state (fragment-boundary bootstrap for V-trace)."""
+    _, vf_out, _ = _core_step(params, config, boot_obs, final_state)
+    return _models.mlp_apply(params["vf"], vf_out)[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# sampling-side policy
+# ---------------------------------------------------------------------------
+
+class RecurrentPolicy:
+    """Stateful sampling policy over a memory core (the model-catalog
+    ``use_lstm``/``use_attention`` path). Same surface as ``Policy``
+    plus the recurrent-state hooks the rollout worker duck-types."""
+
+    def __init__(self, spec: EnvSpec, config: Optional[dict] = None,
+                 seed: int = 0):
+        self.spec = spec
+        self.config = dict(config or {})
+        self.continuous = isinstance(spec.action_space, Box)
+        obs_dim = int(np.prod(spec.observation_space.shape))
+        self.action_dim = (int(np.prod(spec.action_space.shape))
+                           if self.continuous else spec.action_space.n)
+        self.params, self.state_size = memory_model_init(
+            jax.random.key(seed), obs_dim, self.action_dim, self.config,
+            self.continuous)
+        self._rng = jax.random.key(seed + 1)
+        self._state: Optional[np.ndarray] = None
+        continuous = self.continuous
+        cfg = self.config
+
+        def _compute(params, rng, obs, state, explore):
+            pi_out, vf_out, state = _core_step(params, cfg, obs, state)
+            dist_in = _models.mlp_apply(params["pi"], pi_out)
+            values = _models.mlp_apply(params["vf"], vf_out)[..., 0]
+            dist = _models.make_distribution(params, dist_in, continuous)
+            actions = jax.lax.cond(
+                explore, lambda: dist.sample(rng),
+                lambda: dist.deterministic())
+            return actions, dist.logp(actions), values, state
+
+        def _value(params, obs, state):
+            _, vf_out, _ = _core_step(params, cfg, obs, state)
+            return _models.mlp_apply(params["vf"], vf_out)[..., 0]
+
+        self._compute = jax.jit(_compute)
+        self._value = jax.jit(_value)
+
+    def _ensure_state(self, n: int):
+        if self._state is None or len(self._state) != n:
+            self._state = np.zeros((n, self.state_size), np.float32)
+
+    def compute_actions(self, obs, explore: bool = True):
+        obs = jnp.asarray(obs, jnp.float32)
+        self._ensure_state(obs.shape[0])
+        self._rng, key = jax.random.split(self._rng)
+        actions, logp, values, state = self._compute(
+            self.params, key, obs, jnp.asarray(self._state),
+            jnp.asarray(explore))
+        self._state = np.array(state)  # writable copy: reset hooks mutate
+        actions = np.asarray(actions)
+        if self.continuous:
+            actions = np.clip(actions, self.spec.action_space.low,
+                              self.spec.action_space.high)
+        return actions, np.asarray(logp), np.asarray(values)
+
+    def value(self, obs, env_indices=None) -> np.ndarray:
+        """Bootstrap values from the CURRENT state, without advancing it
+        (the worker calls this for fragment-end/truncation bootstraps).
+        ``env_indices`` selects the state rows when ``obs`` covers only
+        a subset of the sub-envs (truncation bootstraps) — without it a
+        shape mismatch would silently clobber the whole state."""
+        obs = jnp.asarray(obs, jnp.float32)
+        if env_indices is not None:
+            self._ensure_state(max(env_indices) + 1
+                               if self._state is None
+                               else len(self._state))
+            state = self._state[np.asarray(env_indices, int)]
+        else:
+            self._ensure_state(obs.shape[0])
+            state = self._state
+        return np.asarray(self._value(self.params, obs,
+                                      jnp.asarray(state)))
+
+    # -- recurrent-state hooks (duck-typed by the rollout worker) --------
+    def get_recurrent_state(self, n_envs: int) -> np.ndarray:
+        self._ensure_state(n_envs)
+        return self._state.copy()
+
+    def on_episode_end(self, env_indices):
+        if self._state is not None:
+            self._state[np.asarray(env_indices, int)] = 0.0
+
+    # -- weights ---------------------------------------------------------
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights):
+        self.params = jax.tree_util.tree_map(jnp.asarray, weights)
+
+
+# ---------------------------------------------------------------------------
+# PPO sequence learner
+# ---------------------------------------------------------------------------
+
+class RecurrentPPOLearner:
+    """PPO over fragment sequences: minibatches are SEQUENCES, the loss
+    replays each from its fragment-start state (rllib's RNN-PPO
+    semantics), compiled as scans like ``PPOLearner``."""
+
+    handles_batch_shaping = True  # sequences must not be cut mid-fragment
+
+    def __init__(self, init_params, cfg, continuous: bool,
+                 fragment_length: int):
+        self.cfg = cfg
+        self.T = fragment_length
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip), optax.adam(cfg.lr))
+        self.params = jax.tree_util.tree_map(jnp.asarray, init_params)
+        self.opt_state = self.optimizer.init(self.params)
+        self.rng = jax.random.key(cfg.seed + 7919)
+        self._continuous = continuous
+        self._model_cfg = dict(cfg.model)
+        self._train = self._build_train_fn()
+
+    def _build_train_fn(self):
+        cfg = self.cfg
+        continuous = self._continuous
+        model_cfg = self._model_cfg
+        optimizer = self.optimizer
+        # minibatch size in SEQUENCES
+        mb_seqs = max(1, cfg.sgd_minibatch_size // max(1, self.T))
+
+        def loss_fn(params, kl_coeff, batch):
+            dist_in, values, _ = memory_forward(
+                params, model_cfg, batch[SampleBatch.OBS],
+                batch["state_in"], batch["resets"])
+            dist = _models.make_distribution(params, dist_in, continuous)
+            return _models.ppo_surrogate_loss(dist, values, batch, cfg,
+                                              kl_coeff)
+
+        def train_fn(params, opt_state, rng, kl_coeff, batch):
+            n_seq = batch[SampleBatch.OBS].shape[0]
+            num_mb = max(1, n_seq // mb_seqs)
+
+            def epoch(carry, _):
+                params, opt_state, rng = carry
+                rng, key = jax.random.split(rng)
+                perm = jax.random.permutation(key, n_seq)
+                shuffled = jax.tree_util.tree_map(
+                    lambda x: x[perm][:num_mb * mb_seqs].reshape(
+                        (num_mb, mb_seqs) + x.shape[1:]), batch)
+
+                def mb_step(c, minibatch):
+                    p, o = c
+                    (_, aux), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True)(p, kl_coeff, minibatch)
+                    updates, o = optimizer.update(grads, o, p)
+                    p = optax.apply_updates(p, updates)
+                    return (p, o), aux
+
+                (params, opt_state), auxs = jax.lax.scan(
+                    mb_step, (params, opt_state), shuffled)
+                return (params, opt_state, rng), auxs
+
+            (params, opt_state, rng), auxs = jax.lax.scan(
+                epoch, (params, opt_state, rng), None,
+                length=cfg.num_sgd_iter)
+            metrics = jax.tree_util.tree_map(jnp.mean, auxs)
+            metrics["kl"] = jnp.mean(auxs["kl"][-1])
+            return params, opt_state, rng, metrics
+
+        return jax.jit(train_fn, donate_argnums=(0, 1))
+
+    def train(self, batch: SampleBatch, kl_coeff: float) -> Dict[str, float]:
+        T = self.T
+        n = len(batch) // T * T
+        n_seq = n // T
+        # The minibatch reshape needs at least one full minibatch of
+        # sequences; pad small batches by tiling (the sequence analogue
+        # of the flat learner's pad_to, which ppo.py skips for us).
+        mb_seqs = max(1, self.cfg.sgd_minibatch_size // max(1, T))
+        reps = 1 if n_seq >= mb_seqs else -(-mb_seqs // max(1, n_seq))
+
+        def to_seq(v):
+            a = np.asarray(v)[:n]
+            a = a.reshape((n_seq, T) + a.shape[1:])
+            if reps > 1:
+                a = np.concatenate([a] * reps)[:mb_seqs]
+            return jnp.asarray(a)
+
+        def pad_seqs(a):
+            if reps > 1:
+                a = np.concatenate([a] * reps)[:mb_seqs]
+            return jnp.asarray(a)
+
+        dones = (np.asarray(batch[SampleBatch.TERMINATEDS])[:n]
+                 | np.asarray(batch[SampleBatch.TRUNCATEDS])[:n]
+                 ).astype(np.float32).reshape(n_seq, T)
+        # a NEW episode starts at t where step t-1 ended (never at t=0:
+        # the fragment-start state already reflects any prior boundary)
+        resets = np.concatenate(
+            [np.zeros((n_seq, 1), np.float32), dones[:, :-1]], axis=1)
+        arrays = {
+            SampleBatch.OBS: to_seq(batch[SampleBatch.OBS]),
+            SampleBatch.ACTIONS: to_seq(batch[SampleBatch.ACTIONS]),
+            SampleBatch.ACTION_LOGP: to_seq(
+                batch[SampleBatch.ACTION_LOGP]),
+            SampleBatch.ADVANTAGES: to_seq(batch[SampleBatch.ADVANTAGES]),
+            SampleBatch.VALUE_TARGETS: to_seq(
+                batch[SampleBatch.VALUE_TARGETS]),
+            "state_in": pad_seqs(np.asarray(
+                batch["state_in"])[:n].reshape(
+                    n_seq, T, -1)[:, 0]),        # fragment-start rows
+            "resets": pad_seqs(resets),
+        }
+        self.params, self.opt_state, self.rng, metrics = self._train(
+            self.params, self.opt_state, self.rng,
+            jnp.asarray(kl_coeff, jnp.float32), arrays)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def state(self):
+        return jax.device_get((self.params, self.opt_state))
+
+    def set_state(self, state):
+        params, opt_state = state
+        self.params = jax.tree_util.tree_map(jnp.asarray, params)
+        self.opt_state = jax.tree_util.tree_map(jnp.asarray, opt_state)
+
+
+def uses_memory_model(model_config: Dict[str, Any]) -> bool:
+    return bool(model_config.get("use_lstm")
+                or model_config.get("use_attention"))

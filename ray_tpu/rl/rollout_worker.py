@@ -60,6 +60,11 @@ class RolloutWorker:
             SampleBatch.TERMINATEDS, SampleBatch.TRUNCATEDS,
             SampleBatch.ACTION_LOGP, SampleBatch.VF_PREDS,
             SampleBatch.EPS_ID, "bootstrap_values")}
+        # Stateful (recurrent/attention) policies: snapshot the per-env
+        # recurrent state at fragment START — the learner replays each
+        # fragment from it (rllib's state_in_0 / seq_lens contract).
+        get_state = getattr(self.policy, "get_recurrent_state", None)
+        state0 = get_state(n_envs) if get_state is not None else None
         for _ in range(T):
             actions, logp, values = self.policy.compute_actions(self._obs)
             obs2, rews, terms, truncs, infos = self.vector_env.step(actions)
@@ -69,7 +74,13 @@ class RolloutWorker:
             if trunc_idx:
                 term_obs = np.stack(
                     [infos[i]["terminal_obs"] for i in trunc_idx])
-                vals = self.policy.value(term_obs)
+                if state0 is not None:
+                    # stateful policy: value for a SUBSET of envs needs
+                    # the matching state rows
+                    vals = self.policy.value(term_obs,
+                                             env_indices=trunc_idx)
+                else:
+                    vals = self.policy.value(term_obs)
                 for j, i in enumerate(trunc_idx):
                     boots[i] = vals[j]
             cols[SampleBatch.OBS].append(self._obs)
@@ -83,8 +94,10 @@ class RolloutWorker:
             cols["bootstrap_values"].append(boots)
             self._eps_return += rews
             self._eps_len += 1
+            done_idx = []
             for i in range(n_envs):
                 if terms[i] or truncs[i]:
+                    done_idx.append(i)
                     self._completed.append({
                         "episode_reward": float(self._eps_return[i]),
                         "episode_len": int(self._eps_len[i])})
@@ -92,6 +105,12 @@ class RolloutWorker:
                     self._eps_len[i] = 0
                     self._eps_ids[i] = self._next_eps_id
                     self._next_eps_id += 1
+            if done_idx:
+                # recurrent policies must not carry memory across the
+                # episode boundary (the sub-env auto-reset)
+                reset_hook = getattr(self.policy, "on_episode_end", None)
+                if reset_hook is not None:
+                    reset_hook(done_idx)
             self._obs = obs2
 
         # Per-env fragments so GAE recursion never crosses env boundaries.
@@ -106,6 +125,11 @@ class RolloutWorker:
         frags = []
         for i in range(n_envs):
             frag = SampleBatch({k: v[:, i] for k, v in stacked.items()})
+            if state0 is not None:
+                # broadcast per step so concat/shuffle stays rectangular;
+                # the learner reads row 0 of each T-block
+                frag["state_in"] = np.repeat(
+                    np.asarray(state0[i])[None], T, 0)
             if self.compute_advantages:
                 compute_gae(frag, float(last_values[i]),
                             self.gamma, self.lambda_)

@@ -89,6 +89,7 @@ class TD3Config(AlgorithmConfig):
         self.policy_delay = 2          # critic steps per actor step
         self.target_noise = 0.2        # target policy smoothing std
         self.target_noise_clip = 0.5
+        self.twin_q = True             # False = plain DDPG critic
         self.explore_noise = 0.1
         self.train_batch_size = 256
         self.replay_buffer_capacity = 100_000
@@ -161,14 +162,21 @@ class TD3Learner:
                 -tn_clip * scale_a, tn_clip * scale_a)
             lo_b, hi_b = center_a - scale_a, center_a + scale_a
             ta = jnp.clip(ta + noise, lo_b, hi_b)
-            tq = jnp.minimum(q_apply(target["q1"], nxt, ta),
-                             q_apply(target["q2"], nxt, ta))
+            # twin_q is STATIC config: DDPG (twin_q=False) bootstraps
+            # and regresses a single critic; TD3 takes the min of twins.
+            if cfg.twin_q:
+                tq = jnp.minimum(q_apply(target["q1"], nxt, ta),
+                                 q_apply(target["q2"], nxt, ta))
+            else:
+                tq = q_apply(target["q1"], nxt, ta)
             y = rews + gamma * not_done * jax.lax.stop_gradient(tq)
 
             def critic_loss_fn(cp):
                 q1 = q_apply(cp["q1"], obs, acts)
-                q2 = q_apply(cp["q2"], obs, acts)
-                loss = jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2)
+                loss = jnp.mean((q1 - y) ** 2)
+                if cfg.twin_q:
+                    q2 = q_apply(cp["q2"], obs, acts)
+                    loss = loss + jnp.mean((q2 - y) ** 2)
                 return loss, jnp.mean(q1)
 
             (closs, q_mean), cgrads = jax.value_and_grad(

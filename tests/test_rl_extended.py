@@ -1,0 +1,340 @@
+"""Learning gates for the round-5 RL additions: DDPG, ES/ARS, QMIX,
+DD-PPO, and the LSTM/attention memory models (reference pass-criteria
+style: each algorithm must demonstrably improve within a small budget,
+and the memory models must SOLVE a task memoryless policies cannot)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _ray():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=8)
+    yield
+
+
+# ------------------------------------------------------------------- DDPG
+def test_ddpg_is_td3_without_the_fixes():
+    from ray_tpu.rl import DDPG
+    cfg = DDPG.get_default_config()
+    assert cfg.twin_q is False
+    assert cfg.policy_delay == 1
+    assert cfg.target_noise == 0.0
+
+
+def test_ddpg_learns_pendulum():
+    from ray_tpu.rl import DDPG
+    algo = (DDPG.get_default_config()
+            .environment("Pendulum-v1")
+            .training(train_batch_size=128, n_updates_per_iter=8,
+                      num_steps_sampled_before_learning_starts=256)
+            .debugging(seed=0)
+            .build())
+    try:
+        worst = 0.0
+        for i in range(600):
+            r = algo.step()
+            rew = r.get("episode_reward_mean")
+            if rew is not None:
+                worst = min(worst, rew)
+        final = r["episode_reward_mean"]
+        # measured (seed 0): dips to ~-1350 mid-training (random-policy
+        # episodes filling the running mean), recovers to ~-916 by 600
+        # iters; random level sustains ~-1300
+        assert final > -1000, (worst, final)
+        assert final > worst + 250, (worst, final)
+    finally:
+        algo.stop()
+
+
+# ------------------------------------------------------------------ ES/ARS
+def test_es_learns_cartpole():
+    from ray_tpu.rl import ES
+    algo = (ES.get_default_config().environment("CartPole-v1")
+            .debugging(seed=0).build())
+    best = 0
+    for _ in range(40):
+        r = algo.step()
+        best = max(best, r["episode_reward_mean"])
+    assert best > 150, best
+
+
+def test_ars_learns_cartpole_fast():
+    from ray_tpu.rl import ARS
+    algo = (ARS.get_default_config().environment("CartPole-v1")
+            .debugging(seed=0).build())
+    best = 0
+    for _ in range(20):
+        r = algo.step()
+        best = max(best, r["episode_reward_mean"])
+    assert best > 150, best
+
+
+def test_es_parallel_rollouts_match_serial_api():
+    """num_rollout_workers>0 evaluates perturbations as remote tasks."""
+    from ray_tpu.rl import ES
+    algo = (ES.get_default_config().environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2)
+            .training(num_perturbations=4)
+            .debugging(seed=0).build())
+    r = algo.step()
+    assert r["timesteps_this_iter"] > 0
+    assert "episode_reward_mean" in r
+
+
+def test_es_checkpoint_roundtrip(tmp_path):
+    from ray_tpu.rl import ARS
+    algo = (ARS.get_default_config().environment("CartPole-v1")
+            .debugging(seed=0).build())
+    algo.step()
+    d = tmp_path / "ck"
+    d.mkdir()
+    state = algo.save_checkpoint(str(d))
+    theta = algo.theta.copy()
+    algo.step()
+    assert not np.allclose(theta, algo.theta)
+    algo.load_checkpoint(state)
+    np.testing.assert_allclose(theta, algo.theta)
+
+
+# -------------------------------------------------------------------- QMIX
+def test_qmix_beats_vdn_ceiling_on_two_step_game():
+    """The QMIX paper's gate: the two-step game's optimum (8) requires a
+    NON-additive joint value — reaching it proves the monotonic mixing
+    network does its job (additive factorization converges to 7)."""
+    from ray_tpu.rl import QMIX, TwoStepCooperativeGameEnv
+    algo = (QMIX.get_default_config()
+            .environment(lambda c: TwoStepCooperativeGameEnv(c))
+            .debugging(seed=0)
+            .build())
+    for _ in range(90):
+        algo.step()
+    greedy = algo.greedy_joint_return(20)
+    assert greedy >= 7.9, greedy
+
+
+def test_qmix_checkpoint_roundtrip(tmp_path):
+    from ray_tpu.rl import QMIX, TwoStepCooperativeGameEnv
+    algo = (QMIX.get_default_config()
+            .environment(lambda c: TwoStepCooperativeGameEnv(c))
+            .debugging(seed=1).build())
+    algo.step()
+    d = tmp_path / "ck"
+    d.mkdir()
+    state = algo.save_checkpoint(str(d))
+    algo2 = (QMIX.get_default_config()
+             .environment(lambda c: TwoStepCooperativeGameEnv(c))
+             .debugging(seed=2).build())
+    algo2.load_checkpoint(state)
+    import jax
+    a = jax.flatten_util.ravel_pytree(algo.learner.params)[0]
+    b = jax.flatten_util.ravel_pytree(algo2.learner.params)[0]
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------------------------ DD-PPO
+def test_ddppo_requires_multiple_workers():
+    from ray_tpu.rl import DDPPO
+    with pytest.raises(ValueError):
+        (DDPPO.get_default_config().environment("CartPole-v1")
+         .rollouts(num_rollout_workers=1).build())
+
+
+def test_ddppo_learns_cartpole_decentralized():
+    """Decentralized DP gate: workers train via gradient allreduce (no
+    central learner), policies stay in lockstep, and the team learns."""
+    from ray_tpu.rl import DDPPO
+    algo = (DDPPO.get_default_config()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=4,
+                      rollout_fragment_length=100)
+            .training(train_batch_size=400, num_sgd_iter=6, lr=3e-4)
+            .debugging(seed=0)
+            .build())
+    try:
+        first = None
+        for i in range(35):
+            r = algo.step()
+            if first is None and "episode_reward_mean" in r:
+                first = r["episode_reward_mean"]
+        final = r["episode_reward_mean"]
+        assert final > max(40.0, first + 10), (first, final)
+        # lockstep: every worker holds bit-identical parameters
+        import jax
+        ws = ray_tpu.get([w.get_weights.remote() for w in algo._workers],
+                         timeout=60)
+        a = jax.flatten_util.ravel_pytree(ws[0])[0]
+        b = jax.flatten_util.ravel_pytree(ws[1])[0]
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    finally:
+        algo.stop()
+
+
+# ---------------------------------------------------------- memory models
+def test_lstm_ppo_solves_memory_task():
+    """Decisive recurrence gate: MemoryCue pays +1 only for recalling a
+    cue visible ONLY at t=0 — a memoryless policy averages 0."""
+    from ray_tpu.rl import PPO
+    algo = (PPO.get_default_config()
+            .environment("MemoryCue-v0")
+            .rollouts(num_rollout_workers=0, num_envs_per_worker=16,
+                      rollout_fragment_length=20)
+            .training(train_batch_size=640, sgd_minibatch_size=160,
+                      num_sgd_iter=10, lr=1e-3, grad_clip=10.0,
+                      entropy_coeff=0.01,
+                      model={"use_lstm": True, "lstm_cell_size": 32})
+            .debugging(seed=0).build())
+    for _ in range(30):
+        r = algo.step()
+    assert r["episode_reward_mean"] > 0.8, r["episode_reward_mean"]
+    algo.stop()
+
+
+def test_attention_ppo_solves_memory_task():
+    from ray_tpu.rl import PPO
+    algo = (PPO.get_default_config()
+            .environment("MemoryCue-v0")
+            .rollouts(num_rollout_workers=0, num_envs_per_worker=16,
+                      rollout_fragment_length=20)
+            .training(train_batch_size=640, sgd_minibatch_size=160,
+                      num_sgd_iter=10, lr=1e-3, grad_clip=10.0,
+                      entropy_coeff=0.01,
+                      model={"use_attention": True, "attention_dim": 32,
+                             "attention_window": 8})
+            .debugging(seed=0).build())
+    for _ in range(30):
+        r = algo.step()
+    assert r["episode_reward_mean"] > 0.8, r["episode_reward_mean"]
+    algo.stop()
+
+
+def test_memoryless_policy_cannot_solve_memory_task():
+    """Control: plain PPO stays near chance on MemoryCue — proving the
+    task actually requires memory (guards against env leakage)."""
+    from ray_tpu.rl import PPO
+    algo = (PPO.get_default_config()
+            .environment("MemoryCue-v0")
+            .rollouts(num_rollout_workers=0, num_envs_per_worker=16,
+                      rollout_fragment_length=20)
+            .training(train_batch_size=640, sgd_minibatch_size=160,
+                      num_sgd_iter=10, lr=1e-3, grad_clip=10.0)
+            .debugging(seed=0).build())
+    for _ in range(20):
+        r = algo.step()
+    assert r["episode_reward_mean"] < 0.6, r["episode_reward_mean"]
+    algo.stop()
+
+
+def test_lstm_impala_learns_cartpole():
+    """Memory models ride IMPALA's V-trace learner too (sequence replay
+    + fragment-end bootstrap from the scan's final state)."""
+    from ray_tpu.rl import Impala
+    algo = (Impala.get_default_config()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=1, num_envs_per_worker=8,
+                      rollout_fragment_length=50)
+            .training(lr=1e-3, entropy_coeff=0.01,
+                      model={"use_lstm": True, "lstm_cell_size": 64})
+            .debugging(seed=0).build())
+    first = None
+    for i in range(60):
+        r = algo.step()
+        if first is None and "episode_reward_mean" in r:
+            first = r["episode_reward_mean"]
+    final = r["episode_reward_mean"]
+    algo.stop()
+    assert final > max(45.0, first + 15), (first, final)
+
+
+def test_recurrent_replay_is_exact():
+    """The learner's sequence replay must reproduce the sampling-time
+    logps bit-exactly (state_in + in-scan resets contract)."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rl import models as _models
+    from ray_tpu.rl.recurrent import RecurrentPolicy, memory_forward
+    from ray_tpu.rl.rollout_worker import RolloutWorker
+    from ray_tpu.rl.sample_batch import SampleBatch
+
+    for cfg in ({"use_lstm": True, "lstm_cell_size": 16},
+                {"use_attention": True, "attention_dim": 16,
+                 "attention_window": 4}):
+        w = RolloutWorker("CartPole-v1", num_envs=4,
+                          rollout_fragment_length=20, policy_config=cfg,
+                          seed=0, policy_cls=RecurrentPolicy)
+        b = w.sample()
+        T, n = 20, len(b)
+        obs = jnp.asarray(np.asarray(b[SampleBatch.OBS]).reshape(
+            n // T, T, -1))
+        acts = jnp.asarray(np.asarray(b[SampleBatch.ACTIONS]).reshape(
+            n // T, T))
+        lp_sampled = np.asarray(b[SampleBatch.ACTION_LOGP]).reshape(
+            n // T, T)
+        st = jnp.asarray(np.asarray(b["state_in"]).reshape(
+            n // T, T, -1)[:, 0])
+        dones = (np.asarray(b[SampleBatch.TERMINATEDS])
+                 | np.asarray(b[SampleBatch.TRUNCATEDS])
+                 ).astype(np.float32).reshape(n // T, T)
+        resets = jnp.asarray(np.concatenate(
+            [np.zeros((n // T, 1), np.float32), dones[:, :-1]], 1))
+        dist_in, _, _ = memory_forward(w.policy.params, cfg, obs, st,
+                                       resets)
+        lp = np.asarray(_models.make_distribution(
+            w.policy.params, dist_in, False).logp(acts))
+        np.testing.assert_allclose(lp, lp_sampled, atol=1e-6)
+
+
+def test_recurrent_ppo_small_batch_pads_sequences():
+    """Fewer sequences than one minibatch must pad (tile), not crash
+    (regression: reshape ValueError when n_seq < sgd_minibatch_size/T)."""
+    from ray_tpu.rl import PPO
+    algo = (PPO.get_default_config()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=0, num_envs_per_worker=2,
+                      rollout_fragment_length=20)
+            .training(train_batch_size=40, sgd_minibatch_size=128,
+                      num_sgd_iter=2, lr=3e-4,
+                      model={"use_lstm": True, "lstm_cell_size": 16})
+            .debugging(seed=0).build())
+    r = algo.step()
+    assert "policy_loss" in r
+    algo.stop()
+
+
+def test_ddppo_checkpoint_restores_weights(tmp_path):
+    """Restore must land the trained weights on every worker (regression:
+    __setstate__ dropped them, leaving fresh random init)."""
+    import jax
+
+    from ray_tpu.rl import DDPPO
+    algo = (DDPPO.get_default_config()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=2,
+                      rollout_fragment_length=50)
+            .training(train_batch_size=100, num_sgd_iter=2)
+            .debugging(seed=0).build())
+    try:
+        algo.step()
+        trained = algo.get_weights()
+        d = tmp_path / "ck"
+        d.mkdir()
+        state = algo.save_checkpoint(str(d))
+    finally:
+        algo.stop()
+    algo2 = (DDPPO.get_default_config()
+             .environment("CartPole-v1")
+             .rollouts(num_rollout_workers=2, num_envs_per_worker=2,
+                       rollout_fragment_length=50)
+             .training(train_batch_size=100, num_sgd_iter=2)
+             .debugging(seed=99).build())
+    try:
+        algo2.load_checkpoint(state)
+        restored = algo2.get_weights()
+        a = jax.flatten_util.ravel_pytree(trained)[0]
+        b = jax.flatten_util.ravel_pytree(restored)[0]
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    finally:
+        algo2.stop()
