@@ -113,6 +113,11 @@ _config.define("health_check_period_ms", int, 1000, "actor health check period")
 _config.define("daemon_admission_queue_limit", int, 1000,
                "pending tasks a daemon accepts before spilling back "
                "(backpressure: one daemon must not absorb the cluster)")
+_config.define("task_push_batching", bool, False,
+               "coalesce task pushes into one TaskBatchMsg frame per "
+               "daemon per dispatch pass; helps many-core hosts (fewer "
+               "syscalls/wakeups), hurts single-core ones (serializes "
+               "admission on the reader thread) — measured both ways")
 _config.define("inline_dispatch", bool, False,
                "dispatch ref-free tasks inline on the submitting thread "
                "when the dispatcher is idle; wins on many-core hosts "

@@ -141,6 +141,10 @@ class DistributedRuntime(Runtime):
                  heartbeat_interval_s: float = 1.0,
                  view_refresh_s: float = 0.5,
                  namespace: str = "default"):
+        # Before super().__init__: the base constructor starts the
+        # dispatcher thread, whose pass-end hook reads these.
+        self._push_batch: Dict[str, list] = {}
+        self._push_batch_lock = threading.Lock()
         super().__init__(job_id=job_id)
         self.is_driver = is_driver
         self.namespace = namespace
@@ -158,7 +162,8 @@ class DistributedRuntime(Runtime):
         # the mailbox in submission order).
         self.server = RpcServer(
             self._handle_rpc, host=listen_host, max_workers=256,
-            inline_methods={pb.PUSH_TASK, pb.ACTOR_CALL, pb.ADD_BORROW,
+            inline_methods={pb.PUSH_TASK, pb.PUSH_TASK_BATCH,
+                            pb.ACTOR_CALL, pb.ADD_BORROW,
                             pb.REMOVE_BORROW, pb.RELEASE_PIN, pb.PING,
                             pb.CANCEL_TASK, pb.RESERVE_BUNDLE,
                             pb.FREE_BUNDLE, pb.FREE_OBJECT})
@@ -190,6 +195,11 @@ class DistributedRuntime(Runtime):
         # across a driver gathering n results.
         self._inflight_by_return: Dict[ObjectID, dict] = {}
         self._completed_returns: set = set()  # return oids known done
+        # Bulk p2p mailbox: (group, src, dst, seq) -> (dtype, shape,
+        # bytes). Fed by P2P_DATA frames (tensor in the raw lane),
+        # drained by XLAProcessGroup.recv.
+        self._p2p_box: Dict[tuple, tuple] = {}
+        self._p2p_cv = threading.Condition()
         # Nodes whose death we already processed (signals arrive from both
         # the pubsub push and the view refresh; handling must be idempotent).
         self._dead_handled: set = set()
@@ -1180,7 +1190,9 @@ class DistributedRuntime(Runtime):
             # period) regardless of how fast tasks actually finish.
             nr.allocate(request)
             alloc = (nid, request)
-        self._push_task_remote(spec, addr, cancel, alloc=alloc)
+        self._push_task_remote(spec, addr, cancel, alloc=alloc,
+                               batched=bool(_config.get(
+                                   "task_push_batching")))
         with self.lock:
             self.task_states[spec.task_id] = "RUNNING"
         return "done"
@@ -1400,7 +1412,8 @@ class DistributedRuntime(Runtime):
         super()._unpin_args(spec)
 
     def _push_task_remote(self, spec: TaskSpec, addr: str, cancel,
-                          method: int = pb.PUSH_TASK, alloc=None):
+                          method: int = pb.PUSH_TASK, alloc=None,
+                          batched: bool = False):
         msg, arg_pins = self._spec_to_msg(spec)
         # The re-serialization above re-pinned every arg ref; the previous
         # attempt's pins (held across the pending-queue wait) can go now.
@@ -1426,7 +1439,20 @@ class DistributedRuntime(Runtime):
         try:
             client = self.pool.get(
                 addr, on_close=self._on_peer_conn_close)
-            client.call_async(method, msg.SerializeToString(), _done)
+            if batched and method == pb.PUSH_TASK:
+                # Hot-loop batching: reserve the reply seq now, ship the
+                # spec in the NEXT batch frame to this daemon (one
+                # frame/syscall/reader-wakeup per dispatch pass, replies
+                # still per-task).
+                seq = client.allocate_pending(_done)
+                with self._push_batch_lock:
+                    group = self._push_batch.setdefault(addr, [])
+                    group.append((client, seq, msg))
+                    flush_now = len(group) >= 128
+                if flush_now:
+                    self._flush_push_batches(only_addr=addr)
+            else:
+                client.call_async(method, msg.SerializeToString(), _done)
         except Exception as e:  # connection refused etc.
             self._on_remote_reply(spec, attempt, addr, cancel, None, e)
             return
@@ -1443,6 +1469,43 @@ class DistributedRuntime(Runtime):
     def _same_host(self, addr: str) -> bool:
         return (addr.rsplit(":", 1)[0]
                 == self.address.rsplit(":", 1)[0])
+
+    def p2p_wait(self, key: tuple, timeout_s: float):
+        """Block for a P2P_DATA delivery; returns (dtype, shape, bytes)."""
+        deadline = time.monotonic() + timeout_s
+        with self._p2p_cv:
+            while key not in self._p2p_box:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"p2p recv {key} timed out")
+                self._p2p_cv.wait(remaining)
+            return self._p2p_box.pop(key)[:3]
+
+    def _flush_push_batches(self, only_addr: Optional[str] = None):
+        """Ship queued task pushes, one TaskBatchMsg frame per daemon."""
+        with self._push_batch_lock:
+            if only_addr is not None:
+                groups = {only_addr: self._push_batch.pop(only_addr, [])}
+            else:
+                groups, self._push_batch = self._push_batch, {}
+        for addr, items in groups.items():
+            if not items:
+                continue
+            by_client: Dict[Any, list] = {}
+            for client, seq, msg in items:
+                by_client.setdefault(client, []).append((seq, msg))
+            for client, pairs in by_client.items():
+                batch = pb.TaskBatchMsg(seqs=[s for s, _ in pairs])
+                for _, msg in pairs:
+                    batch.tasks.append(msg)
+                try:
+                    client.send_oneway(pb.PUSH_TASK_BATCH,
+                                       batch.SerializeToString())
+                except Exception as e:  # noqa: BLE001 - conn died
+                    client.fail_pending([s for s, _ in pairs], e)
+
+    def _flush_dispatch_batches(self):
+        self._flush_push_batches()
 
     def _settle_view_alloc(self, info, credit: bool):
         """Settle one push attempt's optimistic view debit, exactly once.
@@ -2083,6 +2146,26 @@ class DistributedRuntime(Runtime):
             self._handle_get_timeline(ctx)
         elif method == pb.NODE_DEBUG:
             self._handle_node_debug(ctx)
+        elif method == pb.PUSH_TASK_BATCH:
+            self._handle_push_task_batch(ctx)
+        elif method == pb.P2P_DATA:
+            req = pb.P2PDataMsg()
+            req.ParseFromString(ctx.body)
+            key = (req.group, req.src_rank, req.dst_rank, req.p2p_seq)
+            now = time.monotonic()
+            with self._p2p_cv:
+                self._p2p_box[key] = (req.dtype, tuple(req.shape),
+                                      bytes(ctx.raw or b""), now)
+                # TTL sweep: deliveries whose recv timed out (the
+                # receiver's seq counter has moved past them) would
+                # otherwise pin full tensors in memory forever.
+                if len(self._p2p_box) > 8:
+                    stale = [k for k, v in self._p2p_box.items()
+                             if now - v[3] > 120.0]
+                    for k in stale:
+                        del self._p2p_box[k]
+                self._p2p_cv.notify_all()
+            ctx.reply()
         elif method == pb.RESERVE_BUNDLE:
             req = pb.BundleRequest()
             req.ParseFromString(ctx.body)
@@ -2255,9 +2338,24 @@ class DistributedRuntime(Runtime):
             return True
         return False
 
-    def _handle_push_task(self, ctx: RpcContext):
-        msg = pb.TaskSpecMsg()
-        msg.ParseFromString(ctx.body)
+    def _handle_push_task_batch(self, ctx: RpcContext):
+        """Fan a TaskBatchMsg out into per-task contexts: each task's
+        admission outcome/completion replies on its caller-allocated seq
+        exactly as an individually-pushed task would."""
+        batch = pb.TaskBatchMsg()
+        batch.ParseFromString(ctx.body)
+        ctx._done = True  # the batch envelope itself gets no reply
+        for seq, task in zip(batch.seqs, batch.tasks):
+            child = ctx.child(seq, pb.PUSH_TASK)
+            try:
+                self._handle_push_task(child, msg=task)
+            except Exception as e:  # noqa: BLE001 - isolate per task
+                child.reply_error(f"{type(e).__name__}: {e}")
+
+    def _handle_push_task(self, ctx: RpcContext, msg=None):
+        if msg is None:
+            msg = pb.TaskSpecMsg()
+            msg.ParseFromString(ctx.body)
         if self._dedupe_pushed_task(ctx, msg):
             return
         try:

@@ -87,12 +87,16 @@ def send_frame(sock: socket.socket, env: pb.Envelope,
     it, and its bytes follow the envelope frame in the SAME gather write
     — zero user-space copies of the payload on this side, and the
     receiver recv_into's it straight into its destination buffer."""
+    raw_mv = None
     if raw is not None:
-        env.raw_len = len(raw)
+        # byte-cast FIRST: len() of a structured memoryview counts
+        # ELEMENTS of its first dimension, not bytes
+        raw_mv = memoryview(raw).cast("B")
+        env.raw_len = len(raw_mv)
     payload = env.SerializeToString()
     pieces = [memoryview(_LEN.pack(len(payload))), memoryview(payload)]
-    if raw is not None and len(raw):
-        pieces.append(memoryview(raw).cast("B"))
+    if raw_mv is not None and len(raw_mv):
+        pieces.append(raw_mv)
     while pieces:
         sent = sock.sendmsg(pieces)
         while pieces and sent >= len(pieces[0]):
@@ -169,12 +173,14 @@ class RpcClient:
 
     def call(self, method: int, body: bytes = b"",
              timeout: Optional[float] = None,
-             raw_sink=None) -> pb.Envelope:
+             raw_sink=None, raw=None) -> pb.Envelope:
         """Send a request, block for its reply. Raises RpcRemoteError on a
         handler error, RpcConnectionError if the connection dies first.
         ``raw_sink(length) -> memoryview``: where to land the reply's
         bulk-lane bytes, filled before this returns (the caller keeps its
-        own reference to the buffer the sink handed out)."""
+        own reference to the buffer the sink handed out). ``raw``:
+        bulk-lane payload to ship WITH the request (gather-write, no
+        protobuf copy)."""
         pending = _Pending()
         pending.raw_sink = raw_sink
         with self._plock:
@@ -186,7 +192,7 @@ class RpcClient:
             self._pending[seq] = pending
         env = pb.Envelope(seq=seq, method=method, body=body)
         try:
-            self._send(env)
+            self._send(env, raw=raw)
             if not pending.event.wait(timeout):
                 raise TimeoutError(
                     f"rpc {pb.Method.Name(method)} to {self.address} timed out")
@@ -229,6 +235,33 @@ class RpcClient:
     def send_oneway(self, method: int, body: bytes = b"") -> None:
         self._send(pb.Envelope(seq=0, method=method, body=body))
 
+    def allocate_pending(self, callback) -> int:
+        """Reserve a reply seq with a callback but send NOTHING — the
+        caller ships the seq inside a batch envelope (TaskBatchMsg) and
+        the peer answers it like any ordinary reply. Pair with
+        fail_pending when the batch send errors."""
+        pending = _Pending()
+        pending.callback = callback
+        with self._plock:
+            if self._closed:
+                raise RpcConnectionError(
+                    f"connection to {self.address} is closed")
+            self._seq += 1
+            seq = self._seq
+            self._pending[seq] = pending
+        return seq
+
+    def fail_pending(self, seqs, error: Exception) -> None:
+        """Settle reserved seqs whose batch never reached the wire."""
+        for seq in seqs:
+            with self._plock:
+                pending = self._pending.pop(seq, None)
+            if pending is not None and pending.callback is not None:
+                try:
+                    pending.callback(None, error)
+                except Exception:
+                    logger.exception("rpc callback failed")
+
     def close(self):
         self._shutdown(RpcConnectionError("closed locally"))
 
@@ -238,10 +271,10 @@ class RpcClient:
 
     # -- internals ------------------------------------------------------------
 
-    def _send(self, env: pb.Envelope):
+    def _send(self, env: pb.Envelope, raw=None):
         with self._wlock:
             try:
-                send_frame(self._sock, env)
+                send_frame(self._sock, env, raw=raw)
             except OSError as e:
                 raise RpcConnectionError(str(e)) from e
 
@@ -341,6 +374,16 @@ class RpcContext:
         envelope via gather-write — no protobuf copy of the bulk."""
         self._reply(pb.Envelope(seq=self.seq, method=self.method,
                                 reply=True, body=body), raw=raw)
+
+    def child(self, seq: int, method: int, body: bytes = b""
+              ) -> "RpcContext":
+        """A sibling context on the SAME connection with its own reply
+        seq — how one batch envelope fans out into per-item contexts
+        whose replies multiplex like ordinary calls."""
+        env = pb.Envelope(seq=seq, method=method, body=body)
+        ctx = RpcContext(None, self._sock, self._wlock, env)
+        ctx.conn_id = getattr(self, "conn_id", None)
+        return ctx
 
     def reply_error(self, message: str):
         self._reply(pb.Envelope(seq=self.seq, method=self.method,

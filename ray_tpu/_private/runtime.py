@@ -507,6 +507,7 @@ class Runtime:
             return False  # dispatcher mid-pass: just queue
         try:
             action = self._try_dispatch(item)
+            self._flush_dispatch_batches()  # inline path has no pass end
         except Exception:  # Infeasible & friends: the loop's policy owns
             return False   # error handling — re-run it there
         finally:
@@ -571,6 +572,12 @@ class Runtime:
                     continue
                 if action == "wait":
                     still_waiting.append(item)
+            # Batched remote pushes accumulate during the pass; ship them
+            # as one frame per daemon (no-op for the in-process runtime).
+            try:
+                self._flush_dispatch_batches()
+            except Exception:  # defensive: never kill the dispatcher
+                logger.exception("dispatch batch flush failed")
             if still_waiting:
                 with self._pending_cv:
                     self._pending.extend(still_waiting)
@@ -585,6 +592,9 @@ class Runtime:
             else:
                 with self._pending_cv:
                     self._dispatch_pass_n = 0
+
+    def _flush_dispatch_batches(self):
+        """Hook: distributed runtimes flush per-daemon push batches."""
 
     def _kick(self):
         with self._pending_cv:

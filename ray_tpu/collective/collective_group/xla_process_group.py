@@ -13,8 +13,12 @@ ICI/DCN (Gloo on CPU test clusters).
 Multi-controller contract: every rank (process) must invoke the same op in
 the same order — true of collectives by definition. ``send``/``recv`` are
 point-to-point and therefore CANNOT ride a compiled program only two
-processes run; they transit the state-service KV (control-plane path,
-meant for small tensors — bulk data belongs to the object plane).
+processes run; they transit the BULK P2P LANE: a direct daemon-to-daemon
+``P2P_DATA`` frame whose tensor bytes ride the RPC raw lane
+(gather-write out, recv_into in — zero protobuf copies), delivered into
+the receiver's p2p mailbox. Ranks publish their RPC address at group
+init; when a peer's address is unknown (in-process test planes) the
+state-KV path remains as the small-tensor fallback.
 """
 
 from __future__ import annotations
@@ -30,6 +34,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ray_tpu.collective.types import ReduceOp
 
 P2P_NS = b"tplane-p2p"
+
+
+def _np_dtype(name: str):
+    """np.dtype by name, including the ml_dtypes family (bfloat16 etc.)
+    that plain numpy only knows once ml_dtypes is imported."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
 
 _REDUCE = {
     ReduceOp.SUM: lambda a: jnp.sum(a, axis=0),
@@ -63,6 +77,7 @@ class XLAProcessGroup:
         self.mesh = Mesh(np.array(self._leads), ("p",))
         self._p2p_seq: Dict[tuple, int] = {}
         self._programs: Dict[tuple, Any] = {}  # per-instance, dies with us
+        self._publish_p2p_addr()  # bulk p2p reachability (best-effort)
 
     # -- plumbing -------------------------------------------------------------
 
@@ -152,18 +167,88 @@ class XLAProcessGroup:
             raise RuntimeError("p2p needs the cluster state service")
         return state
 
+    def _runtime(self):
+        from ray_tpu._private import worker as _worker
+        return _worker.try_global_runtime()
+
+    def _publish_p2p_addr(self):
+        """Make this rank reachable for bulk p2p (idempotent)."""
+        rt = self._runtime()
+        addr = getattr(rt, "address", None)
+        if addr:
+            try:
+                self._kv().kv_put(
+                    f"{self.group_name}/addr/{self.rank}".encode(),
+                    addr.encode(), overwrite=True, namespace=P2P_NS)
+            except Exception:
+                pass
+
+    def _peer_addr(self, rank: int) -> Optional[str]:
+        try:
+            raw = self._kv().kv_get(
+                f"{self.group_name}/addr/{rank}".encode(),
+                namespace=P2P_NS)
+            return raw.decode() if raw else None
+        except Exception:
+            return None
+
     def send(self, tensor, dst_rank: int):
-        import pickle
         seq = self._p2p_seq.get(("s", dst_rank), 0)
         self._p2p_seq[("s", dst_rank)] = seq + 1
+        arr = np.ascontiguousarray(np.asarray(tensor))
+        rt = self._runtime()
+        addr = self._peer_addr(dst_rank)
+        if addr and getattr(rt, "pool", None) is not None:
+            # Bulk lane: metadata in the envelope, bytes gather-written
+            # from the array's buffer — no pickle, no KV round-trips.
+            # byte-view first: bf16 & friends (ml_dtypes) have no buffer
+            # protocol, and bf16 is the dominant dtype on this hardware.
+            from ray_tpu.protocol import pb
+            msg = pb.P2PDataMsg(
+                group=self.group_name, src_rank=self.rank,
+                dst_rank=dst_rank, p2p_seq=seq, dtype=str(arr.dtype),
+                shape=list(arr.shape))
+            rt.pool.get(addr).call(pb.P2P_DATA, msg.SerializeToString(),
+                                   timeout=120,
+                                   raw=arr.view(np.uint8).reshape(-1))
+            return
+        # Fallback (no RPC address: in-process planes): state-KV path.
+        import pickle
         key = f"{self.group_name}/{self.rank}>{dst_rank}/{seq}".encode()
-        self._kv().kv_put(key, pickle.dumps(np.asarray(tensor)),
-                          overwrite=True, namespace=P2P_NS)
+        self._kv().kv_put(key, pickle.dumps(arr), overwrite=True,
+                          namespace=P2P_NS)
 
     def recv(self, src_rank: int, timeout_s: float = 30.0):
         import pickle
         seq = self._p2p_seq.get(("r", src_rank), 0)
         self._p2p_seq[("r", src_rank)] = seq + 1
+        rt = self._runtime()
+        if hasattr(rt, "p2p_wait"):
+            box_key = (self.group_name, src_rank, self.rank, seq)
+            kv_key = (f"{self.group_name}/{src_rank}>{self.rank}/{seq}"
+                      .encode())
+            deadline = time.monotonic() + timeout_s
+            while True:
+                # Primarily wait on the mailbox (event-driven); probe the
+                # KV fallback only at a coarse 1s interval — the sender
+                # uses the KV path only when OUR address is unpublished,
+                # and a tight kv_get loop would hammer the control plane
+                # with no-op RPCs (one per 50ms per blocked rank).
+                try:
+                    dtype, shape, data = rt.p2p_wait(box_key,
+                                                     timeout_s=1.0)
+                    return jnp.asarray(
+                        np.frombuffer(data, dtype=_np_dtype(dtype))
+                        .reshape(shape))
+                except TimeoutError:
+                    pass
+                raw = self._kv().kv_get(kv_key, namespace=P2P_NS)
+                if raw is not None:
+                    self._kv().kv_del(kv_key, namespace=P2P_NS)
+                    return jnp.asarray(pickle.loads(raw))
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"recv from rank {src_rank} timed out")
         key = f"{self.group_name}/{src_rank}>{self.rank}/{seq}".encode()
         kv = self._kv()
         deadline = time.monotonic() + timeout_s

@@ -3,18 +3,33 @@
 Parity with ``python/ray/serve/_private/http_proxy.py``: an actor running
 an HTTP server that maps route prefixes to deployments (table pushed from
 the controller via long-poll) and forwards request bodies through a
-``DeploymentHandle``.  The reference uses uvicorn/ASGI; here the server is
-the stdlib threading HTTP server — ingress is control-path, the data path
-(model execution) stays in replicas.
+``DeploymentHandle``. The reference uses uvicorn/ASGI; here the server
+is the stdlib threading HTTP server hardened with the proxy-level
+behaviors the ASGI stack provides:
 
-Request convention: POST body is JSON (or raw bytes if not JSON) passed as
-the single argument; the JSON-serialized return value is the response.
+- **Ingress concurrency limiting**: at most ``max_concurrent_requests``
+  requests execute at once; excess requests are rejected immediately
+  with 503 + Retry-After (the proxy's half of the reference's
+  ``max_ongoing_requests`` backpressure) instead of stacking threads.
+- **Streaming responses**: list/tuple results stream as
+  chunked-transfer pieces when the client asks
+  (``X-Serve-Stream: 1``) — element-wise flush, so large outputs don't
+  buffer into one JSON blob. (Replica execution itself completes
+  before streaming starts: the task protocol replies once; this is
+  response streaming, not incremental generation.)
+- **Utility endpoints**: ``/-/healthz`` and ``/-/routes`` (same paths
+  as the reference proxy's health/routes endpoints).
+- **Draining**: during shutdown new requests get 503 while in-flight
+  ones finish.
+
+Request convention: POST body is JSON (or raw bytes if not JSON) passed
+as the single argument; the JSON-serialized return value is the
+response.
 """
 
 from __future__ import annotations
 
 import json
-import socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
@@ -26,11 +41,15 @@ from ray_tpu.serve.handle import DeploymentHandle
 
 class HTTPProxy:
     def __init__(self, controller_handle, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, max_concurrent_requests: int = 200,
+                 request_timeout_s: float = 60.0):
         self._controller = controller_handle
         self._routes: Dict[str, str] = {}
         self._handles: Dict[str, DeploymentHandle] = {}
         self._lock = threading.Lock()
+        self._inflight = threading.Semaphore(max_concurrent_requests)
+        self._draining = False
+        self._timeout_s = request_timeout_s
         import ray_tpu
         self._routes = ray_tpu.get(
             controller_handle.get_route_table.remote())
@@ -40,18 +59,60 @@ class HTTPProxy:
         proxy = self
 
         class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"  # chunked streaming needs 1.1
+
             def log_message(self, *a):  # quiet
                 pass
 
+            def _json(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                if code == 503:
+                    self.send_header("Retry-After", "1")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _stream(self, items):
+                self._headers_sent = True
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                for item in items:
+                    piece = (json.dumps(item) + "\n").encode()
+                    self.wfile.write(
+                        f"{len(piece):x}\r\n".encode() + piece + b"\r\n")
+                    self.wfile.flush()
+                self.wfile.write(b"0\r\n\r\n")
+
             def _dispatch(self, body: Optional[bytes]):
                 path = self.path.split("?")[0].rstrip("/") or "/"
-                name = proxy._match(path)
-                if name is None:
-                    self.send_response(404)
-                    self.end_headers()
-                    self.wfile.write(b'{"error": "no route"}')
+                if path == "/-/healthz":
+                    self._json(503 if proxy._draining else 200,
+                               {"status": "draining"
+                                if proxy._draining else "ok"})
+                    return
+                if path == "/-/routes":
+                    with proxy._lock:
+                        self._json(200, dict(proxy._routes))
+                    return
+                if proxy._draining:
+                    self._json(503, {"error": "proxy draining"})
+                    return
+                if not proxy._inflight.acquire(blocking=False):
+                    # Backpressure at ingress: reject NOW rather than
+                    # stacking unbounded handler threads on a saturated
+                    # cluster (max_ongoing_requests role).
+                    self._json(503, {"error": "too many in-flight "
+                                              "requests"})
                     return
                 try:
+                    name = proxy._match(path)
+                    if name is None:
+                        self._json(404, {"error": "no route"})
+                        return
                     arg = None
                     if body:
                         try:
@@ -59,17 +120,35 @@ class HTTPProxy:
                         except json.JSONDecodeError:
                             arg = body
                     handle = proxy._get_handle(name)
-                    result = handle.remote(arg).result(timeout=60)
-                    payload = json.dumps(result).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.end_headers()
-                    self.wfile.write(payload)
-                except Exception as e:
-                    self.send_response(500)
-                    self.end_headers()
-                    self.wfile.write(
-                        json.dumps({"error": str(e)}).encode())
+                    result = handle.remote(arg).result(
+                        timeout=proxy._timeout_s)
+                    if (isinstance(result, (list, tuple))
+                            and self.headers.get("X-Serve-Stream")):
+                        self._stream(result)
+                        return
+                    self._send_value(result)
+                except Exception as e:  # noqa: BLE001 - surface to caller
+                    if getattr(self, "_headers_sent", False):
+                        # Mid-stream failure: a second status line would
+                        # corrupt the half-sent chunked body AND poison
+                        # the keep-alive connection — just sever it.
+                        self.close_connection = True
+                        try:
+                            self.wfile.flush()
+                        except OSError:
+                            pass
+                    else:
+                        self._json(500, {"error": str(e)})
+                finally:
+                    proxy._inflight.release()
+
+            def _send_value(self, result):
+                body = json.dumps(result).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             def do_GET(self):
                 self._dispatch(None)
@@ -124,6 +203,7 @@ class HTTPProxy:
         return f"http://{self.host}:{self.port}"
 
     def shutdown(self) -> None:
+        self._draining = True
         self._poller.stop()
         self._server.shutdown()
         self._server.server_close()

@@ -2,9 +2,11 @@
 
 Parity with the stub layer of Ray Client (``util/client/common.py``
 ``ClientObjectRef``/``ClientActorHandle``/``ClientRemoteFunc``). One
-socket, one lock: calls are serialized per connection (the reference
-multiplexes streams; for a control-plane API the simple protocol wins).
-"""
+socket, MULTIPLEXED: every request carries a seq, a reader thread
+matches responses, and the server dispatches each request on its own
+worker — so a second in-flight call (e.g. a quick ``put`` while a long
+``get`` blocks) no longer waits for the first to finish (the
+reference's ``proxier.py`` stream multiplexing role)."""
 
 from __future__ import annotations
 
@@ -105,16 +107,57 @@ class ClientAPI:
         self._sock = socket.create_connection((host, int(port)),
                                               timeout=timeout)
         self._sock.settimeout(None)
-        self._lock = threading.Lock()
+        self._send_lock = threading.Lock()
+        self._plock = threading.Lock()
+        self._pending: Dict[int, list] = {}  # seq -> [Event, resp|None]
+        self._seq = 0
+        self._closed: Optional[Exception] = None
+        self._reader = threading.Thread(target=self._read_loop,
+                                        daemon=True, name="client-reader")
+        self._reader.start()
         assert self._call({"op": "ping"})["initialized"], \
             "server head is not initialized"
 
+    def _read_loop(self):
+        try:
+            while True:
+                resp = recv_msg(self._sock)
+                if resp is None:
+                    raise ConnectionError(
+                        "client server closed the connection")
+                with self._plock:
+                    slot = self._pending.pop(resp.get("seq"), None)
+                if slot is not None:
+                    slot[1] = resp
+                    slot[0].set()
+        except BaseException as e:  # noqa: BLE001 - teardown path
+            with self._plock:
+                self._closed = e if isinstance(e, Exception) else \
+                    ConnectionError(str(e))
+                pending, self._pending = dict(self._pending), {}
+            for slot in pending.values():
+                slot[0].set()
+
     def _call(self, req: dict):
-        with self._lock:
-            send_msg(self._sock, req)
-            resp = recv_msg(self._sock)
+        slot = [threading.Event(), None]
+        with self._plock:
+            if self._closed is not None:
+                raise ConnectionError(
+                    f"client connection closed: {self._closed}")
+            self._seq += 1
+            seq = self._seq
+            self._pending[seq] = slot
+        try:
+            with self._send_lock:
+                send_msg(self._sock, dict(req, seq=seq))
+            slot[0].wait()
+        finally:
+            with self._plock:
+                self._pending.pop(seq, None)
+        resp = slot[1]
         if resp is None:
-            raise ConnectionError("client server closed the connection")
+            raise ConnectionError(
+                f"client connection lost mid-call: {self._closed}")
         if "error" in resp:
             raise resp["error"]
         return resp["ok"]

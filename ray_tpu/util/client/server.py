@@ -50,10 +50,41 @@ class ClientServer:
                             f"client ref {ref_id[:8]} is unknown to this "
                             f"session (freed or from another session)")
 
+                from concurrent.futures import ThreadPoolExecutor
+                wlock = threading.Lock()
+                pool = ThreadPoolExecutor(
+                    max_workers=16, thread_name_prefix="client-srv")
+
+                def run_one(req, seq):
+                    try:
+                        payload = {"seq": seq,
+                                   "ok": outer._dispatch(session, req)}
+                    except BaseException as e:  # noqa: BLE001
+                        payload = {"seq": seq, "error": e}
+                    try:
+                        with wlock:
+                            send_msg(sock, payload)
+                    except (ConnectionError, OSError):
+                        pass
+                    except BaseException as e:  # noqa: BLE001
+                        # Unpicklable result/exception: the client must
+                        # still get SOME reply or it blocks forever.
+                        try:
+                            with wlock:
+                                send_msg(sock, {
+                                    "seq": seq,
+                                    "error": RuntimeError(
+                                        "response serialization failed: "
+                                        f"{type(e).__name__}: {e}")})
+                        except BaseException:
+                            pass
+
                 try:
                     while True:
                         # markers anywhere in the request swap for real
-                        # refs DURING unpickling (protocol.RefMarker)
+                        # refs DURING unpickling (protocol.RefMarker) —
+                        # parsing stays on the reader thread so the
+                        # resolver contextvar scopes correctly
                         token = _RESTORE_RESOLVER.set(resolve)
                         try:
                             req = recv_msg(sock)
@@ -61,14 +92,14 @@ class ClientServer:
                             _RESTORE_RESOLVER.reset(token)
                         if req is None:
                             break
-                        try:
-                            result = outer._dispatch(session, req)
-                            send_msg(sock, {"ok": result})
-                        except BaseException as e:  # noqa: BLE001
-                            send_msg(sock, {"error": e})
+                        # Each request dispatches on its own worker: a
+                        # blocking get() must not serialize the client's
+                        # other calls behind it.
+                        pool.submit(run_one, req, req.get("seq"))
                 except (ConnectionError, OSError):
                     pass
                 finally:
+                    pool.shutdown(wait=False)
                     # Disconnect releases everything the client held.
                     session.refs.clear()
                     session.actors.clear()

@@ -170,3 +170,35 @@ def test_client_refs_in_exotic_containers(client_pair):
     out = api.get(f.remote(Point(1, 2), {r: "lbl"}, frozenset({r})),
                   timeout=30)
     assert out == 12
+
+
+def test_client_calls_multiplex(client_pair):
+    """A quick call issued WHILE a long get() blocks must complete first
+    (regression: one socket + one lock serialized all calls)."""
+    import threading
+    import time as _time
+
+    api = client_pair
+
+    def slow():
+        _time.sleep(3.0)
+        return "slow-done"
+
+    f = api.remote(slow)
+    ref = f.remote()
+    got = {}
+
+    def getter():
+        got["slow"] = api.get(ref, timeout=30)
+
+    t = threading.Thread(target=getter)
+    t.start()
+    _time.sleep(0.2)  # the get() is now blocking server-side
+    t0 = _time.perf_counter()
+    quick = api.get(api.put("quick"), timeout=10)
+    quick_elapsed = _time.perf_counter() - t0
+    t.join(timeout=30)
+    assert quick == "quick"
+    assert got.get("slow") == "slow-done"
+    assert quick_elapsed < 2.0, (
+        f"quick call serialized behind the slow get ({quick_elapsed:.1f}s)")

@@ -480,3 +480,27 @@ def test_serve_replicas_across_daemon_processes(cluster):
         assert pids and pids <= daemon_pids, (pids, daemon_pids)
     finally:
         serve.shutdown()
+
+
+def test_task_push_batching_mode(cluster):
+    """task_push_batching=True routes pushes through TaskBatchMsg frames
+    with per-task reply seqs: results, errors, and follow-up work all
+    behave exactly as unbatched pushes."""
+    from ray_tpu._private.config import _config
+    _config.set("task_push_batching", True)
+    try:
+        @ray_tpu.remote(num_cpus=0.01)
+        def double(x):
+            return x * 2
+
+        @ray_tpu.remote(num_cpus=0.01)
+        def boom():
+            raise ValueError("batched boom")
+
+        assert ray_tpu.get([double.remote(i) for i in range(200)],
+                           timeout=60) == [i * 2 for i in range(200)]
+        with pytest.raises(Exception):
+            ray_tpu.get(boom.remote(), timeout=30)
+        assert ray_tpu.get(double.remote(21), timeout=30) == 42
+    finally:
+        _config.set("task_push_batching", False)

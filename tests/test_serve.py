@@ -250,3 +250,75 @@ def test_rolling_update_on_code_change(serve_instance):
             break
         time.sleep(0.1)
     assert h.remote(0).result(timeout=30) == "v2"
+
+
+def test_http_proxy_health_routes_and_streaming(serve_instance):
+    """Proxy-level features (reference http_proxy.py parity): /-/healthz,
+    /-/routes, chunked streaming of list results, 404 body shape."""
+    @serve.deployment
+    class Lister:
+        def __call__(self, n):
+            return list(range(n or 3))
+
+    serve.run(Lister.options(name="lister").bind(), route_prefix="/list")
+    url = serve.start_http_proxy()
+    with urllib.request.urlopen(f"{url}/-/healthz", timeout=30) as r:
+        assert json.loads(r.read())["status"] == "ok"
+    with urllib.request.urlopen(f"{url}/-/routes", timeout=30) as r:
+        routes = json.loads(r.read())
+    assert routes.get("/list") == "lister"
+    # streaming: each element arrives as its own chunk line
+    req = urllib.request.Request(
+        f"{url}/list", data=json.dumps(4).encode(),
+        headers={"Content-Type": "application/json", "X-Serve-Stream": "1"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.headers.get("Transfer-Encoding") == "chunked"
+        lines = [json.loads(x) for x in r.read().split(b"\n") if x]
+    assert lines == [0, 1, 2, 3]
+    # non-streamed default still one JSON body
+    req = urllib.request.Request(
+        f"{url}/list", data=json.dumps(2).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert json.loads(r.read()) == [0, 1]
+
+
+def test_http_proxy_concurrency_limit(serve_instance):
+    """Over-limit requests are rejected 503 immediately (ingress
+    backpressure), not queued behind blocked handlers."""
+    import threading
+    import time as _time
+
+    @serve.deployment
+    class Slow:
+        def __call__(self, x):
+            _time.sleep(2.0)
+            return "done"
+
+    serve.run(Slow.options(name="slowd").bind(), route_prefix="/slow")
+    from ray_tpu.serve import api as serve_api
+    from ray_tpu.serve._private.http_proxy import HTTPProxy
+    proxy = HTTPProxy(serve_api._get_controller(),
+                      max_concurrent_requests=1)
+    url = proxy.address()
+    results = {}
+
+    def call(key):
+        req = urllib.request.Request(
+            f"{url}/slow", data=b"1",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                results[key] = ("ok", json.loads(r.read()))
+        except urllib.error.HTTPError as e:
+            results[key] = ("http", e.code, e.headers.get("Retry-After"))
+
+    t1 = threading.Thread(target=call, args=("a",))
+    t1.start()
+    _time.sleep(0.5)  # first request is now holding the one slot
+    call("b")
+    t1.join(timeout=30)
+    assert results["a"] == ("ok", "done"), results
+    assert results["b"][0] == "http" and results["b"][1] == 503, results
+    assert results["b"][2] == "1"  # Retry-After
+    proxy.shutdown()

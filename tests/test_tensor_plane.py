@@ -116,6 +116,46 @@ def test_cross_process_p2p(tp_cluster):
     np.testing.assert_allclose(got, np.arange(4.0))
 
 
+@ray_tpu.remote(num_cpus=2)  # fills a daemon: one rank per process
+class BulkRank:
+    def send_big(self, group_name, peer, n):
+        import numpy as _np
+
+        from ray_tpu import collective as col
+        col.send(_np.arange(n, dtype=_np.float32).reshape(-1, 1024),
+                 peer, group_name)
+        return True
+
+    def recv_big(self, group_name, peer, n):
+        import numpy as _np
+
+        from ray_tpu import collective as col
+        out = _np.asarray(col.recv(peer, group_name))
+        assert out.shape == (n // 1024, 1024)
+        assert float(out[-1, -1]) == float(n - 1)
+        # bulk transfers must NOT transit the state-KV p2p namespace
+        import ray_tpu as _rt
+        state = _rt._private.worker.global_worker().runtime.state
+        leftovers = [k for k in state.kv_keys(namespace=b"tplane-p2p")
+                     if b">" in k]
+        return leftovers
+
+
+def test_cross_process_p2p_bulk_lane(tp_cluster):
+    """A multi-MB tensor rides the raw-lane P2P_DATA path (NCCL-send
+    role): correct bytes, nothing parked in the control-plane KV."""
+    from ray_tpu.collective import create_collective_group
+    actors = [BulkRank.remote() for _ in range(2)]
+    create_collective_group(actors, 2, [0, 1], backend="xla",
+                            group_name="tp-bulk")
+    n = 2 * 1024 * 1024  # 8 MB of float32
+    s = actors[0].send_big.remote("tp-bulk", 1, n)
+    r = actors[1].recv_big.remote("tp-bulk", 0, n)
+    sent, leftovers = ray_tpu.get([s, r], timeout=120)
+    assert sent is True
+    assert leftovers == []
+
+
 # ---------------------------------------------------------------- trainer
 
 def _make_dp_loop():
