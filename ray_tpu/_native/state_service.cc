@@ -513,7 +513,33 @@ class StateService {
     } else {
       rep.set_recognized(true);
       it->second.set_last_heartbeat_ms(now_ms());
-      if (req.has_available()) *it->second.mutable_available() = req.available();
+      if (req.has_available()) {
+        // Delta broadcast (ray_syncer role): CHANGED availability pushes
+        // a NODE_RESOURCES event to every subscriber immediately, so
+        // schedulers track capacity at heartbeat latency without
+        // polling ListNodes; unchanged heartbeats publish nothing.
+        // Entry-wise map compare: serialized-bytes comparison is
+        // order-dependent for protobuf maps and would false-positive on
+        // every heartbeat with 2+ resource entries.
+        const auto& prev = it->second.available().amounts();
+        const auto& next = req.available().amounts();
+        bool changed = prev.size() != next.size();
+        if (!changed) {
+          for (const auto& [k, v] : next) {
+            auto pit = prev.find(k);
+            if (pit == prev.end() || pit->second != v) {
+              changed = true;
+              break;
+            }
+          }
+        }
+        *it->second.mutable_available() = req.available();
+        if (changed) {
+          std::string info_bytes;
+          it->second.SerializeToString(&info_bytes);
+          Publish("nodes", "NODE_RESOURCES", info_bytes);
+        }
+      }
       hb_deadline_[req.node_id()] = mono_ms() + hb_timeout_ms_;
     }
     Reply(fd, env, rep);

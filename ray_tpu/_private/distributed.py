@@ -583,6 +583,22 @@ class DistributedRuntime(Runtime):
                     # again.
                     self._dead_handled.discard(info.node_id)
             self._kick()
+        elif ev.kind == "NODE_RESOURCES":
+            # ray_syncer delta: a peer's availability changed — apply it
+            # NOW instead of waiting out the polling view refresh, and
+            # wake the dispatcher (capacity may have freed).
+            if info.node_id != self.local_node.node_id.binary():
+                with self._view_lock:
+                    known = self._view.get(info.node_id)
+                    if known is not None and known.alive:
+                        nr = self._view_avail.get(info.node_id)
+                        if nr is None:
+                            nr = NodeResources(
+                                ResourceSet(dict(info.total.amounts)))
+                            self._view_avail[info.node_id] = nr
+                        nr.available = ResourceSet(
+                            dict(info.available.amounts))
+                self._kick()
 
     def _handle_remote_node_death(self, info: pb.NodeInfo):
         """The single authority for a peer's death: fail its in-flight

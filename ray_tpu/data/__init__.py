@@ -15,12 +15,13 @@ from ray_tpu.data.dataset_pipeline import DatasetPipeline
 from ray_tpu.data.read_api import (from_arrow, from_items, from_numpy,
                                    from_pandas, range, range_table,
                                    read_binary_files, read_csv, read_json,
-                                   read_numpy, read_parquet, read_text)
+                                   read_numpy, read_parquet, read_text,
+                                   read_tfrecords)
 
 __all__ = [
     "Dataset", "DataIterator", "RandomAccessDataset", "DatasetPipeline", "GroupedData", "BlockAccessor",
     "ActorPoolStrategy", "TaskPoolStrategy",
     "from_items", "from_pandas", "from_arrow", "from_numpy",
     "range", "range_table", "read_csv", "read_parquet", "read_json",
-    "read_numpy", "read_text", "read_binary_files",
+    "read_numpy", "read_text", "read_binary_files", "read_tfrecords",
 ]

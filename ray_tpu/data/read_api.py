@@ -140,3 +140,18 @@ def read_binary_files(paths: Union[str, List[str]]) -> Dataset:
         with open(fp, "rb") as f:
             return [f.read()]
     return _read_files(paths, _reader, None)
+
+
+def read_tfrecords(paths: Union[str, List[str]]) -> Dataset:
+    """TFRecord files of tf.train.Example protos -> tabular rows
+    (reference ``read_api.py read_tfrecords``; dependency-free codec in
+    ``data/tfrecords.py``)."""
+    import pandas as pd
+
+    from ray_tpu.data.tfrecords import decode_example, read_tfrecord_file
+
+    def _reader(fp):
+        rows = [decode_example(rec) for rec in read_tfrecord_file(fp)]
+        return pd.DataFrame(rows)
+
+    return _read_files(paths, _reader, [".tfrecord", ".tfrecords"])

@@ -504,3 +504,46 @@ def test_task_push_batching_mode(cluster):
         assert ray_tpu.get(double.remote(21), timeout=30) == 42
     finally:
         _config.set("task_push_batching", False)
+
+
+def test_heartbeat_resource_delta_broadcast(cluster):
+    """ray_syncer role: CHANGED availability is pushed to subscribers as
+    a NODE_RESOURCES event at heartbeat latency (no ListNodes polling);
+    unchanged heartbeats publish nothing for that node."""
+    import threading
+
+    from ray_tpu._private.state_client import StateClient
+    from ray_tpu.protocol import pb
+
+    rt = ray_tpu._private.worker.global_worker().runtime
+    events = []
+    got_change = threading.Event()
+
+    def on_event(ev):
+        if ev.kind == "NODE_RESOURCES":
+            info = pb.NodeInfo()
+            info.ParseFromString(ev.payload)
+            events.append(dict(info.available.amounts))
+            got_change.set()
+
+    sub = StateClient(rt.state_addr)
+    sub.subscribe(["nodes"], on_event)
+    try:
+        @ray_tpu.remote(num_cpus=1)
+        def hold():
+            import time as _t
+            _t.sleep(2.5)
+            return 1
+
+        ref = hold.remote()
+        # capacity drop (and later recovery) must arrive as pushes
+        assert got_change.wait(timeout=15), "no NODE_RESOURCES delta"
+        assert ray_tpu.get(ref, timeout=30) == 1
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if len(events) >= 2:
+                break
+            time.sleep(0.2)
+        assert len(events) >= 2, events  # drop + recovery
+    finally:
+        sub.close()
