@@ -614,25 +614,7 @@ class Dataset:
     def write_tfrecords(self, path: str):
         """One TFRecord file per block; rows serialize as
         tf.train.Example (``data/tfrecords.py`` codec)."""
-        import os
-        os.makedirs(path, exist_ok=True)
-
-        @ray_tpu.remote
-        def _w(block, i):
-            from ray_tpu.data.tfrecords import (encode_example,
-                                                write_tfrecord_file)
-            df = BlockAccessor.for_block(block).to_pandas()
-            # to_dict("records") preserves per-COLUMN dtypes; iterrows
-            # would coerce each row to one dtype and silently turn int64
-            # ids into lossy float32 FloatLists
-            write_tfrecord_file(
-                os.path.join(path, f"block_{i:06d}.tfrecord"),
-                (encode_example(row)
-                 for row in df.to_dict(orient="records")))
-            return None
-
-        ray_tpu.get([_w.remote(r, i)
-                     for i, r in enumerate(self._execute())])
+        self._write(path, "tfrecord")
 
     def write_numpy(self, path: str, column: Optional[str] = None):
         import os
@@ -658,6 +640,15 @@ class Dataset:
                 df.to_parquet(fp)
             elif fmt == "csv":
                 df.to_csv(fp, index=False)
+            elif fmt == "tfrecord":
+                from ray_tpu.data.tfrecords import (encode_example,
+                                                    write_tfrecord_file)
+                # to_dict("records") preserves per-COLUMN dtypes;
+                # iterrows would coerce rows to one dtype and silently
+                # turn int64 ids into lossy float32 FloatLists
+                write_tfrecord_file(
+                    fp, (encode_example(row)
+                         for row in df.to_dict(orient="records")))
             else:
                 df.to_json(fp, orient="records", lines=True)
             return None

@@ -30,9 +30,9 @@ from ray_tpu.rl.multi_agent import (CoordinationGameEnv, MultiAgentBatch,
                                     RockPaperScissorsEnv,
                                     TwoStepCooperativeGameEnv,
                                     register_multi_agent_env)
-from ray_tpu.rl.offline import (BC, BCConfig, CQL, CQLConfig,
-                                collect_dataset, read_dataset,
-                                write_dataset)
+from ray_tpu.rl.offline import (BC, BCConfig, CQL, CQLConfig, MARWIL,
+                                MARWILConfig, collect_dataset,
+                                read_dataset, write_dataset)
 from ray_tpu.rl.sac import SAC, SACConfig
 from ray_tpu.rl.td3 import TD3, TD3Config
 from ray_tpu.rl.replay_buffer import (PrioritizedReplayBuffer, ReplayBuffer)
@@ -49,7 +49,7 @@ __all__ = [
     "SAC", "SACConfig", "TD3", "TD3Config", "DDPG", "DDPGConfig",
     "DDPPO", "DDPPOConfig", "ES", "ESConfig", "ARS", "ARSConfig",
     "QMIX", "QMIXConfig", "RecurrentPolicy",
-    "BC", "BCConfig", "CQL", "CQLConfig",
+    "BC", "BCConfig", "CQL", "CQLConfig", "MARWIL", "MARWILConfig",
     "collect_dataset", "read_dataset", "write_dataset",
     "MultiAgentEnv", "MultiAgentBatch", "MultiAgentRolloutWorker",
     "MultiAgentPPO", "MultiAgentPPOConfig", "CoordinationGameEnv",

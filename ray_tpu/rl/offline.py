@@ -59,7 +59,8 @@ def collect_dataset(env_name_or_maker, policy=None, n_steps: int = 1000,
     obs = env.reset(seed=seed)
     cols: Dict[str, List[Any]] = {k: [] for k in (
         SampleBatch.OBS, SampleBatch.ACTIONS, SampleBatch.REWARDS,
-        SampleBatch.NEXT_OBS, SampleBatch.TERMINATEDS)}
+        SampleBatch.NEXT_OBS, SampleBatch.TERMINATEDS,
+        SampleBatch.TRUNCATEDS)}
     for _ in range(n_steps):
         if policy is None:
             action = env.spec.action_space.sample(rng)
@@ -72,7 +73,15 @@ def collect_dataset(env_name_or_maker, policy=None, n_steps: int = 1000,
         cols[SampleBatch.REWARDS].append(rew)
         cols[SampleBatch.NEXT_OBS].append(obs2)
         cols[SampleBatch.TERMINATEDS].append(term)
+        cols[SampleBatch.TRUNCATEDS].append(trunc)
         obs = env.reset() if (term or trunc) else obs2
+    if cols[SampleBatch.TERMINATEDS]:
+        # collection may stop mid-episode: mark the seam, or return
+        # computations over CONCATENATED datasets would leak rewards
+        # across shard boundaries
+        if not (cols[SampleBatch.TERMINATEDS][-1]
+                or cols[SampleBatch.TRUNCATEDS][-1]):
+            cols[SampleBatch.TRUNCATEDS][-1] = True
     return SampleBatch({k: np.asarray(v) for k, v in cols.items()})
 
 
@@ -191,6 +200,131 @@ class BC(Algorithm):
             self.learner["params"] = jax.tree_util.tree_map(jnp.asarray, p)
             self.learner["opt_state"] = jax.tree_util.tree_map(
                 jnp.asarray, o)
+
+
+# -------------------------------------------------------------- MARWIL
+
+
+class MARWILConfig(BCConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or MARWIL)
+        self.beta = 1.0       # 0.0 degenerates to plain BC (the paper)
+        self.vf_coeff = 1.0
+        self.max_weight = 20.0  # clip exp() advantage weights
+
+
+class MARWIL(BC):
+    """Monotonic Advantage Re-Weighted Imitation Learning
+    (``rllib/algorithms/marwil``, Wang et al. 2018): behavior cloning
+    where each transition's logp is weighted by
+    ``exp(beta * normalized_advantage)`` — good trajectories in a mixed
+    dataset pull the policy harder than bad ones. Advantages come from
+    Monte-Carlo returns (episode boundaries in the dataset) minus a
+    jointly-learned value function, normalized by a running second
+    moment (the paper's c^2 update). ``beta=0`` reduces exactly to BC.
+    """
+
+    _config_cls = MARWILConfig
+
+    @classmethod
+    def get_default_config(cls) -> MARWILConfig:
+        return MARWILConfig(cls)
+
+    @staticmethod
+    def _mc_returns(ds: SampleBatch, gamma: float) -> np.ndarray:
+        rews = np.asarray(ds[SampleBatch.REWARDS], np.float64)
+        ends = np.asarray(ds[SampleBatch.TERMINATEDS]).astype(bool)
+        if SampleBatch.TRUNCATEDS in ds:  # older datasets lack it
+            ends = ends | np.asarray(
+                ds[SampleBatch.TRUNCATEDS]).astype(bool)
+        out = np.zeros_like(rews)
+        acc = 0.0
+        for i in range(len(rews) - 1, -1, -1):
+            if ends[i]:
+                acc = 0.0
+            acc = rews[i] + gamma * acc
+            out[i] = acc
+        return out.astype(np.float32)
+
+    def _make_learner(self):
+        cfg = self.algo_config
+        self.dataset = self._load_dataset()
+        self._returns = self._mc_returns(self.dataset, cfg.gamma)
+        lw = self.workers.local_worker
+        pol = lw.policy
+        self._continuous = pol.continuous
+        self._rng = np.random.default_rng(cfg.seed)
+        params = jax.tree_util.tree_map(jnp.asarray, pol.params)
+        optimizer = optax.adam(cfg.lr)
+        opt_state = optimizer.init(params)
+        continuous = self._continuous
+        beta, vf_coeff = cfg.beta, cfg.vf_coeff
+        max_w = cfg.max_weight
+
+        def step(params, opt_state, ms, obs, actions, returns):
+            def loss_fn(p):
+                dist_in, values = _models.actor_critic_apply(p, obs)
+                dist = _models.make_distribution(p, dist_in, continuous)
+                adv = returns - values
+                # running second moment normalizes the exponent
+                # (paper's c^2; without it exp() saturates)
+                new_ms = 0.99 * ms + 0.01 * jnp.mean(adv ** 2)
+                w = jnp.minimum(
+                    jnp.exp(beta * jax.lax.stop_gradient(adv)
+                            / jnp.sqrt(new_ms + 1e-8)), max_w)
+                pg = -jnp.mean(w * dist.logp(actions))
+                vf = jnp.mean(adv ** 2)
+                return pg + vf_coeff * 0.5 * vf, (new_ms, pg, vf)
+
+            (loss, (new_ms, pg, vf)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = optimizer.update(grads, opt_state)
+            return (optax.apply_updates(params, updates), opt_state,
+                    new_ms, pg, vf)
+
+        self._step = jax.jit(step)
+        return {"params": params, "opt_state": opt_state,
+                "ms": jnp.asarray(1.0)}
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        n = len(self.dataset)
+        pgs, vfs = [], []
+        for _ in range(cfg.n_updates_per_iter):
+            idx = self._rng.integers(0, n, cfg.train_batch_size)
+            obs = jnp.asarray(self.dataset[SampleBatch.OBS][idx],
+                              jnp.float32)
+            act = jnp.asarray(self.dataset[SampleBatch.ACTIONS][idx])
+            ret = jnp.asarray(self._returns[idx])
+            (self.learner["params"], self.learner["opt_state"],
+             self.learner["ms"], pg, vf) = self._step(
+                self.learner["params"], self.learner["opt_state"],
+                self.learner["ms"], obs, act, ret)
+            pgs.append(float(pg))
+            vfs.append(float(vf))
+        self._timesteps_total += (cfg.n_updates_per_iter
+                                  * cfg.train_batch_size)
+        self.workers.local_worker.set_weights(
+            jax.device_get(self.learner["params"]))
+        return {"policy_loss": float(np.mean(pgs)),
+                "vf_loss": float(np.mean(vfs)),
+                "timesteps_this_iter": cfg.n_updates_per_iter
+                * cfg.train_batch_size,
+                "dataset_size": n}
+
+    def _learner_state(self):
+        return jax.device_get((self.learner["params"],
+                               self.learner["opt_state"],
+                               self.learner["ms"]))
+
+    def _set_learner_state(self, state):
+        if state:
+            p, o, ms = state
+            self.learner["params"] = jax.tree_util.tree_map(
+                jnp.asarray, p)
+            self.learner["opt_state"] = jax.tree_util.tree_map(
+                jnp.asarray, o)
+            self.learner["ms"] = jnp.asarray(ms)
 
 
 # ---------------------------------------------------------------- CQL
