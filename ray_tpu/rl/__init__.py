@@ -11,12 +11,17 @@ the tower logic is a sharding annotation, not an engine.
 
 from ray_tpu.rl.a2c import A2C, A2CConfig
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.connectors import (ClipActions, ClipObs, Connector,
+                                   ConnectorPipeline, FlattenObs,
+                                   FrameStack, NormalizeObs, ScaleActions,
+                                   build_connectors, register_connector)
 from ray_tpu.rl.ddpg import DDPG, DDPGConfig
 from ray_tpu.rl.ddppo import DDPPO, DDPPOConfig
 from ray_tpu.rl.dqn import DQN, DQNConfig
 from ray_tpu.rl.env import (CartPoleEnv, EnvSpec, MemoryCueEnv, PendulumEnv,
                             VectorEnv, make_env, register_env)
 from ray_tpu.rl.es import ARS, ARSConfig, ES, ESConfig
+from ray_tpu.rl.external_env import ExternalEnv, ExternalEnvSampler
 from ray_tpu.rl.qmix import QMIX, QMIXConfig
 from ray_tpu.rl.recurrent import RecurrentPolicy
 from ray_tpu.rl.impala import (APPO, APPOConfig, Impala,
@@ -49,6 +54,10 @@ __all__ = [
     "SAC", "SACConfig", "TD3", "TD3Config", "DDPG", "DDPGConfig",
     "DDPPO", "DDPPOConfig", "ES", "ESConfig", "ARS", "ARSConfig",
     "QMIX", "QMIXConfig", "RecurrentPolicy",
+    "ExternalEnv", "ExternalEnvSampler",
+    "Connector", "ConnectorPipeline", "build_connectors",
+    "register_connector", "FlattenObs", "ClipObs", "NormalizeObs",
+    "FrameStack", "ClipActions", "ScaleActions",
     "BC", "BCConfig", "CQL", "CQLConfig", "MARWIL", "MARWILConfig",
     "collect_dataset", "read_dataset", "write_dataset",
     "MultiAgentEnv", "MultiAgentBatch", "MultiAgentRolloutWorker",

@@ -269,9 +269,13 @@ class MARWIL(BC):
                 # running second moment normalizes the exponent
                 # (paper's c^2; without it exp() saturates)
                 new_ms = 0.99 * ms + 0.01 * jnp.mean(adv ** 2)
+                # the normalizer is a running CONSTANT (paper's c^2):
+                # gradients through it would teach the critic to game
+                # the imitation weight instead of fitting returns
                 w = jnp.minimum(
                     jnp.exp(beta * jax.lax.stop_gradient(adv)
-                            / jnp.sqrt(new_ms + 1e-8)), max_w)
+                            / jnp.sqrt(jax.lax.stop_gradient(new_ms)
+                                       + 1e-8)), max_w)
                 pg = -jnp.mean(w * dist.logp(actions))
                 vf = jnp.mean(adv ** 2)
                 return pg + vf_coeff * 0.5 * vf, (new_ms, pg, vf)

@@ -34,25 +34,80 @@ class RolloutWorker:
                  gamma: float = 0.99, lambda_: float = 0.95,
                  compute_advantages: bool = True):
         base_seed = seed + worker_index * 10007
+        from ray_tpu.rl.external_env import ExternalEnv, ExternalEnvSampler
+        probe = make_env(env_name_or_maker, dict(env_config or {}))
+        if isinstance(probe, ExternalEnv):
+            # Application-driven env: sampling SERVICES its queue instead
+            # of stepping it (reference external_env.py integration).
+            from ray_tpu.rl.connectors import ConnectorPipeline
+            self.obs_connectors = ConnectorPipeline([])
+            self.action_connectors = ConnectorPipeline([])
+            self.policy = policy_cls(probe.spec, policy_config,
+                                     seed=base_seed)
+            self._external = ExternalEnvSampler(
+                probe, self.policy, fragment_length=rollout_fragment_length,
+                gamma=gamma, lambda_=lambda_,
+                compute_advantages=compute_advantages)
+            self.vector_env = None
+            self.fragment_length = rollout_fragment_length
+            self.gamma, self.lambda_ = gamma, lambda_
+            self.compute_advantages = compute_advantages
+            self.worker_index = worker_index
+            self._spec = probe.spec
+            return
+        self._external = None
         self.vector_env = VectorEnv(
             lambda c: make_env(env_name_or_maker, c), num_envs,
             env_config, seed=base_seed)
-        self.policy = policy_cls(self.vector_env.spec, policy_config,
-                                 seed=base_seed)
         self.fragment_length = rollout_fragment_length
         self.gamma = gamma
         self.lambda_ = lambda_
         self.compute_advantages = compute_advantages
         self.worker_index = worker_index
-        self._obs = self.vector_env.reset(seed=base_seed)
+        # Connector pipelines (rllib/connectors role): obs transforms on
+        # the way in, action transforms on the way out, built per worker
+        # from (name, kwargs) specs in the model config.
+        from ray_tpu.rl.connectors import ConnectorPipeline, build_connectors
+        cfg = dict(policy_config or {})
+        self.obs_connectors = ConnectorPipeline(
+            build_connectors(cfg.get("obs_connectors")))
+        self.action_connectors = ConnectorPipeline(
+            build_connectors(cfg.get("action_connectors")))
+        self.action_connectors.bind_space(self.vector_env.spec.action_space)
+        self._obs = self._transform_obs(
+            self.vector_env.reset(seed=base_seed))
+        # Connectors may reshape observations (frame stacking): the
+        # policy must be built against the TRANSFORMED shape.
+        spec = self.vector_env.spec
+        obs_shape = np.asarray(self._obs).shape[1:]
+        if tuple(obs_shape) != tuple(spec.observation_space.shape):
+            from dataclasses import replace as _dc_replace
+            from ray_tpu.rl.env import Box as _Box
+            spec = _dc_replace(spec, observation_space=_Box(
+                -np.inf, np.inf, tuple(obs_shape)))
+        self.policy = policy_cls(spec, policy_config, seed=base_seed)
         self._eps_ids = np.arange(num_envs, dtype=np.int64)
         self._next_eps_id = num_envs
         self._eps_return = np.zeros(num_envs, np.float64)
         self._eps_len = np.zeros(num_envs, np.int64)
         self._completed: List[dict] = []
 
+    def _transform_obs(self, obs):
+        if not self.obs_connectors.connectors:
+            return obs
+        return self.obs_connectors(obs)
+
+    def _peek_obs(self, obs):
+        """Transform WITHOUT advancing connector state (bootstrap-value
+        observations are side looks, not steps)."""
+        if not self.obs_connectors.connectors:
+            return obs
+        return self.obs_connectors.peek(obs)
+
     def sample(self) -> SampleBatch:
         """Collect ``fragment_length`` steps per sub-env (column-major)."""
+        if self._external is not None:
+            return self._external.sample()
         n_envs = self.vector_env.num_envs
         T = self.fragment_length
         cols: Dict[str, list] = {k: [] for k in (
@@ -67,13 +122,18 @@ class RolloutWorker:
         state0 = get_state(n_envs) if get_state is not None else None
         for _ in range(T):
             actions, logp, values = self.policy.compute_actions(self._obs)
-            obs2, rews, terms, truncs, infos = self.vector_env.step(actions)
+            env_actions = (self.action_connectors(actions)
+                           if self.action_connectors.connectors
+                           else actions)
+            obs2, rews, terms, truncs, infos = self.vector_env.step(
+                env_actions)
             boots = np.zeros(n_envs, np.float32)
             trunc_idx = [i for i in range(n_envs)
                          if truncs[i] and not terms[i]]
             if trunc_idx:
                 term_obs = np.stack(
                     [infos[i]["terminal_obs"] for i in trunc_idx])
+                term_obs = self._peek_obs(term_obs)
                 if state0 is not None:
                     # stateful policy: value for a SUBSET of envs needs
                     # the matching state rows
@@ -111,16 +171,20 @@ class RolloutWorker:
                 reset_hook = getattr(self.policy, "on_episode_end", None)
                 if reset_hook is not None:
                     reset_hook(done_idx)
-            self._obs = obs2
+                self.obs_connectors.on_episode_end(done_idx)
+            self._obs = self._transform_obs(obs2)
 
         # Per-env fragments so GAE recursion never crosses env boundaries.
         stacked = {k: np.stack(v) for k, v in cols.items()}  # [T, n_envs,...]
         # Bootstrap obs for the step after the fragment end: the live obs,
         # or the pre-reset terminal obs if the final step truncated.
-        boot_obs = self._obs.copy()
+        boot_obs = np.asarray(self._obs).copy()
         for i in range(n_envs):
             if truncs[i] and not terms[i] and "terminal_obs" in infos[i]:
-                boot_obs[i] = infos[i]["terminal_obs"]
+                # self._obs is already connector-transformed; a raw
+                # terminal obs must go through the same (peeked) pipe
+                boot_obs[i] = self._peek_obs(
+                    np.asarray(infos[i]["terminal_obs"])[None])[0]
         last_values = self.policy.value(boot_obs)
         frags = []
         for i in range(n_envs):
@@ -142,6 +206,8 @@ class RolloutWorker:
         return concat_samples(frags)
 
     def pop_metrics(self) -> List[dict]:
+        if self._external is not None:
+            return self._external.pop_metrics()
         out, self._completed = self._completed, []
         return out
 
@@ -151,7 +217,15 @@ class RolloutWorker:
     def set_weights(self, weights) -> None:
         self.policy.set_weights(weights)
 
+    def get_connector_state(self):
+        return self.obs_connectors.state()
+
+    def set_connector_state(self, state) -> None:
+        self.obs_connectors.set_state(state)
+
     def get_spec(self):
+        if self._external is not None:
+            return self._spec
         return self.vector_env.spec
 
     def apply(self, fn: Callable[["RolloutWorker"], Any]) -> Any:
@@ -206,6 +280,18 @@ class WorkerSet:
                 ray_tpu.get(ref)
             except ActorDiedError:
                 self.recreate_failed_worker(w)
+        # Connector statistics flow the OTHER way: the SAMPLING workers
+        # own the running obs stats (they see the data); the local worker
+        # adopts a sampler's stats so evaluation/learner-side transforms
+        # match. Pushing local->remote would wipe the learned stats with
+        # the local worker's empty ones every iteration.
+        try:
+            state = ray_tpu.get(
+                self.remote_workers[0].get_connector_state.remote())
+            if state and any(s is not None for s in state):
+                self.local_worker.set_connector_state(state)
+        except (ActorDiedError, IndexError):
+            pass
 
     def foreach_worker(self, fn: Callable[[RolloutWorker], Any]) -> List[Any]:
         import ray_tpu

@@ -402,3 +402,158 @@ def test_marwil_beta_zero_is_bc():
         assert "policy_loss" in r and r["dataset_size"] == 600
     finally:
         algo.stop()
+
+
+# -------------------------------------------------------------- connectors
+def test_connector_units():
+    from ray_tpu.rl import (ClipActions, ConnectorPipeline, FrameStack,
+                            NormalizeObs, build_connectors)
+    norm = NormalizeObs()
+    batch = np.asarray([[0.0, 10.0], [2.0, 30.0]], np.float64)
+    out = norm(batch)
+    assert out.shape == batch.shape and abs(out.mean()) < 2.0
+    # peek must not advance the running stats
+    state_before = norm.state()
+    norm.peek(batch * 100)
+    assert norm.state()[0] == state_before[0]
+    fs = FrameStack(k=3)
+    o1 = fs(np.ones((2, 4)))
+    assert o1.shape == (2, 12)
+    o2 = fs(2 * np.ones((2, 4)))
+    assert o2[0, -4:].tolist() == [2.0] * 4  # newest frame last
+    peeked = fs.peek(3 * np.ones((2, 4)))
+    again = fs.peek(3 * np.ones((2, 4)))
+    np.testing.assert_array_equal(peeked, again)  # no state advance
+    clip = ClipActions(low=-1.0, high=1.0)
+    assert clip(np.asarray([[5.0, -5.0]])).tolist() == [[1.0, -1.0]]
+    pipe = ConnectorPipeline(build_connectors(
+        ["flatten_obs", ("clip_obs", {"low": -1, "high": 1})]))
+    assert pipe(np.full((2, 2, 2), 9.0)).shape == (2, 4)
+    assert pipe(np.full((2, 2, 2), 9.0)).max() == 1.0
+
+
+def test_ppo_with_connectors_learns():
+    """normalize_obs + frame_stack end-to-end: the policy is built on
+    the TRANSFORMED shape and still learns CartPole; connector stats
+    sync to remote workers with the weights."""
+    from ray_tpu.rl import PPO
+    algo = (PPO.get_default_config()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=1, num_envs_per_worker=4,
+                      rollout_fragment_length=100)
+            .training(train_batch_size=800, sgd_minibatch_size=200,
+                      num_sgd_iter=8, lr=3e-4,
+                      model={"fcnet_hiddens": (64, 64),
+                             "obs_connectors": [
+                                 "normalize_obs",
+                                 ("frame_stack", {"k": 2})]})
+            .debugging(seed=0).build())
+    try:
+        lw = algo.workers.local_worker
+        assert lw.policy.params["pi"]["layers"][0]["w"].shape[0] == 8
+        first = None
+        for i in range(30):
+            r = algo.step()
+            if first is None and "episode_reward_mean" in r:
+                first = r["episode_reward_mean"]
+        final = r["episode_reward_mean"]
+        assert final > max(60.0, first + 20), (first, final)
+        # stateful connector stats actually synced to the remote worker
+        state = lw.get_connector_state()
+        assert state[0] is not None and state[0][0] > 1000  # obs count
+    finally:
+        algo.stop()
+
+
+def test_scale_actions_connector_on_pendulum():
+    from ray_tpu.rl import SAC
+    algo = (SAC.get_default_config()
+            .environment("Pendulum-v1")
+            .training(train_batch_size=64, n_updates_per_iter=2,
+                      num_steps_sampled_before_learning_starts=64,
+                      model={"fcnet_hiddens": (32, 32),
+                             "action_connectors": ["clip_actions"]})
+            .debugging(seed=0).build())
+    try:
+        for _ in range(3):
+            r = algo.step()
+        assert r["timesteps_this_iter"] > 0
+    finally:
+        algo.stop()
+
+
+# ------------------------------------------------------------ external env
+def test_ppo_learns_from_external_env():
+    """The APPLICATION drives the loop (reference external_env.py): a
+    thread wraps CartPole and queries the policy via get_action;
+    PPO trains from the drained experiences unchanged and improves."""
+    from ray_tpu.rl import PPO, ExternalEnv
+    from ray_tpu.rl.env import CartPoleEnv
+
+    class DrivenCartPole(ExternalEnv):
+        def __init__(self, config=None):
+            inner = CartPoleEnv(dict(config or {}))
+            super().__init__(inner.spec)
+            self._inner = inner
+
+        def run(self):
+            seed = 0
+            while True:
+                eid = self.start_episode()
+                obs = self._inner.reset(seed=seed)
+                seed += 1
+                while True:
+                    action = self.get_action(eid, obs)
+                    obs, rew, term, trunc, _ = self._inner.step(
+                        int(action))
+                    self.log_returns(eid, rew)
+                    if term or trunc:
+                        self.end_episode(eid, obs)
+                        break
+
+    algo = (PPO.get_default_config()
+            .environment(lambda c: DrivenCartPole(c))
+            .rollouts(num_rollout_workers=0, num_envs_per_worker=1,
+                      rollout_fragment_length=400)
+            .training(train_batch_size=400, sgd_minibatch_size=128,
+                      num_sgd_iter=8, lr=3e-4)
+            .debugging(seed=0).build())
+    try:
+        first = None
+        for i in range(25):
+            r = algo.step()
+            if first is None and "episode_reward_mean" in r:
+                first = r["episode_reward_mean"]
+        final = r["episode_reward_mean"]
+        assert final > max(50.0, first + 15), (first, final)
+    finally:
+        algo.stop()
+
+
+def test_external_env_off_policy_logging():
+    """log_action records externally-chosen actions into the batch."""
+    from ray_tpu.rl import ExternalEnvSampler
+    from ray_tpu.rl import ExternalEnv
+    from ray_tpu.rl.env import Box, Discrete, EnvSpec
+    from ray_tpu.rl.policy import Policy
+    from ray_tpu.rl.sample_batch import SampleBatch
+
+    class Logger(ExternalEnv):
+        def run(self):
+            eid = self.start_episode()
+            for i in range(6):
+                self.log_action(eid, np.full(3, float(i)), i % 2)
+                self.log_returns(eid, 1.0)
+            self.end_episode(eid, np.zeros(3))
+
+    spec = EnvSpec(observation_space=Box(-1, 1, (3,)),
+                   action_space=Discrete(2), max_episode_steps=100)
+    env = Logger(spec)
+    sampler = ExternalEnvSampler(env, Policy(spec, seed=0),
+                                 fragment_length=6)
+    batch = sampler.sample()
+    assert len(batch) == 6
+    assert list(batch[SampleBatch.ACTIONS]) == [0, 1, 0, 1, 0, 1]
+    assert float(np.sum(batch[SampleBatch.REWARDS])) == 6.0
+    ms = sampler.pop_metrics()
+    assert ms and ms[0]["episode_reward"] == 6.0
