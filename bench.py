@@ -28,8 +28,10 @@ is measured, so a tunnel outage or crash mid-run loses only the sections
 not yet reached. The FINAL stdout line is always the combined headline
 JSON (the one the driver parses), carrying whatever was captured plus a
 ``backend_available`` marker — and the process exits 0 regardless.
-Probe window is env-tunable: ``BENCH_PROBE_RETRIES`` (default 20) x
-``BENCH_PROBE_DELAY_S`` (default 60).
+CPU-pinned sections (PPO) run BEFORE the backend probe so a dead tunnel
+never starves them. The probe window is wall-clock bounded:
+``BENCH_PROBE_DEADLINE_S`` (default 300) with ``BENCH_PROBE_DELAY_S``
+(default 15) between attempts.
 """
 
 import json
@@ -227,14 +229,18 @@ def _wait_for_backend() -> bool:
     on a daemon thread with a timeout: a dead tunnel makes jax.devices()
     BLOCK (not raise), and a hung probe must count as a failed attempt.
 
-    Returns True when the backend answered, False when the whole probe
-    window (BENCH_PROBE_RETRIES x BENCH_PROBE_DELAY_S, default ~20 min)
-    elapsed without one — the caller degrades instead of raising.
+    The whole window is bounded by a wall-clock deadline
+    (``BENCH_PROBE_DEADLINE_S``, default 300 s), not an attempt count: an
+    unbounded retry ladder starved the CPU-pinned sections for ~20 min
+    whenever the tunnel was down. Returns True when the backend answered,
+    False when the deadline elapsed — the caller degrades instead of
+    raising.
     """
     import threading
 
-    retries = max(1, _env_int("BENCH_PROBE_RETRIES", 20))
-    delay_s = _env_float("BENCH_PROBE_DELAY_S", 60.0)
+    deadline_s = _env_float("BENCH_PROBE_DEADLINE_S", 300.0)
+    delay_s = _env_float("BENCH_PROBE_DELAY_S", 15.0)
+    t_start = time.monotonic()
 
     def probe() -> bool:
         out = [False]
@@ -242,22 +248,28 @@ def _wait_for_backend() -> bool:
         def run():
             try:
                 out[0] = len(jax.devices()) > 0
-            except Exception:
+            except Exception:  # raylint: allow(swallow) probe failure IS the signal
                 out[0] = False
 
         t = threading.Thread(target=run, daemon=True)
         t.start()
-        t.join(timeout=45.0)
+        # never let a single hung probe eat the whole window
+        t.join(timeout=min(45.0, max(1.0, deadline_s / 2)))
         return out[0] and not t.is_alive()
 
-    for attempt in range(retries):
+    attempt = 0
+    while True:
+        attempt += 1
         if probe():
             return True
-        _emit({"metric": "backend_probe_failed", "value": attempt + 1,
+        _emit({"metric": "backend_probe_failed", "value": attempt,
                "unit": "attempts"})
-        if attempt < retries - 1:
-            time.sleep(delay_s)
-    return False
+        remaining = deadline_s - (time.monotonic() - t_start)
+        if remaining <= 0:
+            return False
+        time.sleep(min(delay_s, max(0.0, remaining)))
+        if time.monotonic() - t_start >= deadline_s:
+            return False
 
 
 def _emit(obj):
@@ -299,13 +311,17 @@ def _section(name, fn, results, timeout_s=900.0):
 
 
 def main():
+    results = {}
+    # PPO runs CPU-pinned in a subprocess: independent of the TPU tunnel.
+    # It goes FIRST so a dead tunnel (and the probe window that confirms
+    # it) can never starve the sections that need no backend at all.
+    ppo_sps = _section("ppo", bench_ppo, results, timeout_s=700.0)
     try:
         backend_ok = _wait_for_backend()
     except Exception as exc:  # noqa: BLE001 - even the probe must not kill us
         _emit({"metric": "backend_probe_error", "value": str(exc),
                "unit": "error"})
         backend_ok = False
-    results = {}
     kind, peak = ("", None)
     if backend_ok:
         try:
@@ -334,8 +350,6 @@ def main():
                 break
     else:
         r50 = lm = r18 = None
-    # PPO runs CPU-pinned in a subprocess: independent of the TPU tunnel.
-    ppo_sps = _section("ppo", bench_ppo, results, timeout_s=700.0)
 
     def mfu(achieved):
         if peak is None or achieved is None:

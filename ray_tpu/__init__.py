@@ -9,6 +9,14 @@ of NCCL calls, and Train/Tune/Data/Serve/RL library layers built on
 ``jax``/``pjit``/``shard_map``/Pallas.
 """
 
+import os as _os
+
+if _os.environ.get("RAY_TPU_LOCKWATCH"):
+    # Must install before any submodule import so module-level locks are
+    # wrapped too; see ray_tpu/devtools/lockwatch.py.
+    from ray_tpu.devtools import lockwatch as _lockwatch
+    _lockwatch.install()
+
 from ray_tpu._private.config import _config  # noqa: F401
 from ray_tpu._private.worker import (available_resources, cancel,
                                      cluster_resources, get, get_actor, init,

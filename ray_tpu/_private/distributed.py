@@ -375,7 +375,8 @@ class DistributedRuntime(Runtime):
                 # (a daemon may now win ownership; a driver re-joins)
                 try:
                     self.state.kv_del(host_key, namespace=ns)
-                except Exception:
+                except Exception as e:
+                    logger.debug("arena host-key cleanup failed: %s", e)
                     return
                 if _retry:
                     self._setup_host_arena(is_driver, _retry=False)
@@ -455,7 +456,8 @@ class DistributedRuntime(Runtime):
                 return arena.put(key, payload)
             except MemoryError:
                 return False
-        except Exception:
+        except Exception as e:
+            logger.debug("arena store failed: %s", e)
             return False
 
     def _arena_load(self, key: bytes):
@@ -468,13 +470,15 @@ class DistributedRuntime(Runtime):
             return _FETCH_MISS
         try:
             view = arena.get(key)  # pins server-side
-        except Exception:
+        except Exception as e:
+            logger.debug("arena get failed: %s", e)
             return _FETCH_MISS
         if view is None:
             return _FETCH_MISS
         try:
             value, zero_copy = _loads_framed(view)
-        except Exception:
+        except Exception as e:
+            logger.debug("arena payload deserialization failed: %s", e)
             _release_arena_pin(arena, key)
             return _FETCH_MISS
         if zero_copy:
@@ -517,7 +521,8 @@ class DistributedRuntime(Runtime):
                         try:
                             self.state.add_location(
                                 oid.binary(), self.local_node.node_id.binary())
-                        except Exception:
+                        except Exception as e:
+                            logger.debug("location re-publish failed: %s", e)
                             break
             except Exception:
                 if self._hb_stop.is_set():
@@ -529,7 +534,8 @@ class DistributedRuntime(Runtime):
         while not self._hb_stop.wait(self._view_refresh):
             try:
                 self._refresh_view()
-            except Exception:
+            except Exception as e:
+                logger.debug("cluster view refresh failed: %s", e)
                 if self._hb_stop.is_set():
                     return
 
@@ -653,8 +659,8 @@ class DistributedRuntime(Runtime):
                     if cur == self.host_arena_key.encode():
                         self.state.kv_del(self._arena_host_key,
                                           namespace=b"arena")
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("arena kv de-registration failed: %s", e)
                 try:
                     os.unlink(self.host_arena_key)
                 except OSError:
@@ -664,8 +670,8 @@ class DistributedRuntime(Runtime):
                     # keep the mapping: zero-copy fetched values may still
                     # be referenced by the application after shutdown
                     self.host_arena.close(unmap=False)
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("arena close failed: %s", e)
         with self._borrow_q_lock:
             for q in self._borrow_qs.values():
                 q.put(None)
@@ -674,20 +680,20 @@ class DistributedRuntime(Runtime):
                 self.state.register_job(pb.JobInfo(
                     job_id=self.job_id.binary(), driver_address=self.address,
                     state="FINISHED"))
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("job FINISHED publish failed: %s", e)
         try:
             self.state.mark_node_dead(self.local_node.node_id.binary(),
                                       "graceful shutdown")
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("mark_node_dead failed: %s", e)
         super().shutdown()
         self.server.close()
         self.pool.close_all()
         try:
             self.state.close()
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("state client close failed: %s", e)
 
     # --------------------------------------------------------- borrow plane
 
@@ -837,7 +843,8 @@ class DistributedRuntime(Runtime):
                     return self.local_node.store.get(oid, timeout=0)
                 except exc.RayTpuError:
                     raise
-                except Exception:
+                except Exception as e:
+                    logger.debug("local store read failed; trying remote: %s", e)
                     read_failed = True
             # 2. A task we pushed remotely may complete into local seal.
             info = self._inflight_for_return(oid)
@@ -917,8 +924,8 @@ class DistributedRuntime(Runtime):
             for a in rep.addresses:
                 if a and a != self.address and a not in addrs:
                     addrs.append(a)
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("get_locations failed: %s", e)
         for addr in addrs:
             try:
                 value, err = self._fetch_from(addr, oid)
@@ -936,8 +943,8 @@ class DistributedRuntime(Runtime):
                 try:
                     self.state.add_location(
                         oid.binary(), self.local_node.node_id.binary())
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("add_location failed: %s", e)
                 return value, True
         return None, False
 
@@ -1059,8 +1066,8 @@ class DistributedRuntime(Runtime):
                 self._location_hints[oid] = next(
                     (a for a in rep.addresses if a), "")
                 return True
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("get_locations failed: %s", e)
         return False
 
     # ------------------------------------------------------------ scheduling
@@ -1856,8 +1863,8 @@ class DistributedRuntime(Runtime):
                                 else state.node_id)
             try:
                 self.state.update_actor(info)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("update_actor failed: %s", e)
         self.offload(_do)
 
     def _handle_remote_actor_death(self, rec: _RemoteActorRecord,
@@ -2110,8 +2117,8 @@ class DistributedRuntime(Runtime):
                 self._free_bundle(pg, i, nid)
         try:
             self.state.remove_pg(pg_id.binary())
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("remove_pg failed: %s", e)
         self._kick()
 
     def _register_pg_info(self, pg):
@@ -2126,8 +2133,8 @@ class DistributedRuntime(Runtime):
             info.bundle_nodes.append(nid.binary())
         try:
             self.state.register_pg(info)
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("register_pg failed: %s", e)
 
     # ------------------------------------------------------ inbound handler
 
@@ -2455,9 +2462,9 @@ class DistributedRuntime(Runtime):
             rep.error_message = f"{type(err).__name__}: {err}"
             try:
                 rep.error_pickle = cloudpickle.dumps(err)
-            except Exception:
+            except Exception as pe:
                 rep.error_pickle = cloudpickle.dumps(
-                    exc.RayTpuError(f"unpicklable error: {err!r}"))
+                    exc.RayTpuError(f"unpicklable error: {err!r} ({pe})"))
             # Error consumed by the caller; free local copies.
             for rid in spec.return_ids:
                 store.free(rid)
@@ -2496,7 +2503,8 @@ class DistributedRuntime(Runtime):
                             self.object_locations.pop(rid, None)
                         continue
                     payload = cloudpickle.dumps(value)
-                except Exception:
+                except Exception as e:
+                    logger.debug("result pickling failed; keeping non-inline: %s", e)
                     payload = None
                 if payload is not None and len(payload) <= INLINE_RESULT_MAX:
                     rep.inline.append(True)
@@ -2512,8 +2520,8 @@ class DistributedRuntime(Runtime):
                     try:
                         self.state.add_location(
                             rid.binary(), self.local_node.node_id.binary())
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        logger.debug("add_location failed: %s", e)
         data = rep.SerializeToString()
         self._reply_bytes_cache[spec.task_id] = data
         while len(self._reply_bytes_cache) > 512:
@@ -2712,8 +2720,8 @@ class DistributedRuntime(Runtime):
                         set_enabled=True,
                         enabled=bool(enabled)).SerializeToString(),
                     timeout=10)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("timeline toggle push failed: %s", e)
 
     def cluster_timeline(self) -> list:
         """Local spans + every alive daemon's (distinct pids per node)."""
@@ -2727,8 +2735,8 @@ class DistributedRuntime(Runtime):
                     pb.TimelineRequest().SerializeToString(),
                     timeout=30).body)
                 spans.extend(json.loads(bytes(rep.spans_json).decode()))
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("timeline fetch failed: %s", e)
         return spans
 
     def _alive_daemon_addrs(self) -> List[str]:
@@ -2787,7 +2795,8 @@ class DistributedRuntime(Runtime):
         if done:
             try:
                 value, _ = _loads_framed(buf.getvalue())
-            except Exception:
+            except Exception as e:
+                logger.warning("dropping corrupt pushed object payload: %s", e)
                 ctx.reply(rep.SerializeToString())
                 return
             store.put(oid, value)
@@ -2796,8 +2805,8 @@ class DistributedRuntime(Runtime):
             try:
                 self.state.add_location(
                     oid.binary(), self.local_node.node_id.binary())
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("add_location failed: %s", e)
         ctx.reply(rep.SerializeToString())
 
     def _handle_fetch_object(self, ctx: RpcContext):
@@ -2815,14 +2824,15 @@ class DistributedRuntime(Runtime):
             rep.found = True
             try:
                 rep.error_pickle = cloudpickle.dumps(err)
-            except Exception:
+            except Exception as pe:
                 rep.error_pickle = cloudpickle.dumps(
-                    exc.RayTpuError(f"unpicklable error: {err!r}"))
+                    exc.RayTpuError(f"unpicklable error: {err!r} ({pe})"))
             ctx.reply(rep.SerializeToString())
             return
         try:
             payload = self._serialized_for_fetch(oid)
-        except Exception:  # noqa: BLE001 — freed underneath us
+        except Exception as e:  # noqa: BLE001 — freed underneath us
+            logger.debug("object freed during fetch: %s", e)
             rep.found = False
             ctx.reply(rep.SerializeToString())
             return
@@ -2873,7 +2883,8 @@ _FRAME_MAGIC = b"RTF5"
 def _release_arena_pin(arena, key: bytes):
     try:
         arena.release(key)
-    except Exception:
+    except Exception as e:
+        logger.debug("arena pin release failed: %s", e)
         pass  # arena closed/shutdown: the pin died with the connection
 
 
@@ -2898,7 +2909,7 @@ def _dumps_framed(value: Any) -> bytes:
     for b in pbufs:
         try:
             raws.append(b.raw())
-        except Exception:  # non-contiguous: materialize
+        except Exception:  # raylint: allow(swallow) raw() raises for non-contiguous buffers by contract; materialize instead
             raws.append(memoryview(bytes(b)))
     total, hoff, boffs, idx = _frame_layout(len(header),
                                             [r.nbytes for r in raws])
@@ -3003,7 +3014,8 @@ class _PushManager:
                 offset += len(chunk)
                 if eof:
                     return
-        except Exception:
+        except Exception as e:
+            logger.debug("object push failed; pull path authoritative: %s", e)
             pass  # pull path remains authoritative
         finally:
             with self._cv:

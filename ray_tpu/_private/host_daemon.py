@@ -55,7 +55,7 @@ def _install_thread_profiler(out_dir: str):
             try:
                 prof.dump_stats(os.path.join(
                     out_dir, f"daemon{os.getpid()}_{i}_{safe}.pstats"))
-            except Exception:  # noqa: BLE001 - still-running thread etc.
+            except Exception:  # noqa: BLE001  # raylint: allow(swallow) best-effort profile dump at exit
                 pass
 
     atexit.register(dump)

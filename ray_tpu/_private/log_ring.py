@@ -29,7 +29,7 @@ class RingLogHandler(logging.Handler):
     def emit(self, record: logging.LogRecord):
         try:
             line = self.format(record)
-        except Exception:  # noqa: BLE001 - formatting must never raise out
+        except Exception:  # noqa: BLE001  # raylint: allow(swallow) cannot log from inside the log handler
             return
         with self._lock2:
             self._ring.append(line)

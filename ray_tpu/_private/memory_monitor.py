@@ -19,6 +19,7 @@ process's RSS. Everything is configurable:
 """
 
 from __future__ import annotations
+import logging
 
 import os
 import threading
@@ -26,6 +27,8 @@ import time
 from typing import Any, Callable, Dict, Optional
 
 from ray_tpu._private.config import _config
+
+logger = logging.getLogger("ray_tpu")
 
 
 def _read_meminfo_kb() -> Dict[str, int]:
@@ -145,8 +148,8 @@ class MemoryMonitor:
         while not self._stop.wait(self.refresh_ms / 1000.0):
             try:
                 self._sample()
-            except Exception:  # noqa: BLE001 - monitor must never die
-                pass
+            except Exception as e:  # noqa: BLE001 - monitor must never die
+                logger.debug("memory sample failed: %s", e)
 
     def _sample(self):
         used, total = self._usage_reader()

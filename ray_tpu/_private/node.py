@@ -193,7 +193,8 @@ class NodeSupervisor:
             if proc is not None:
                 try:
                     proc.wait(timeout=10)
-                except Exception:
+                except Exception as e:
+                    logger.debug("graceful stop timed out; killing: %s", e)
                     proc.kill()
         for name in ("supervisor.pid", "daemon.pid", "state.pid",
                      "address", "daemon.addr"):

@@ -20,6 +20,7 @@ host-granular TPU runtime:
 """
 
 from __future__ import annotations
+import logging
 
 import io
 import os
@@ -34,12 +35,14 @@ import cloudpickle
 from ray_tpu._private.config import _config
 from ray_tpu._private.ids import ObjectID
 
+logger = logging.getLogger("ray_tpu")
+
 
 def _is_device_array(value: Any) -> bool:
     try:
         import jax
         return isinstance(value, jax.Array)
-    except Exception:
+    except Exception:  # raylint: allow(swallow) capability probe: jax optional
         return False
 
 
@@ -47,7 +50,7 @@ def _is_numpy(value: Any) -> bool:
     try:
         import numpy as np
         return isinstance(value, np.ndarray)
-    except Exception:
+    except Exception:  # raylint: allow(swallow) capability probe: numpy optional
         return False
 
 
@@ -96,7 +99,8 @@ class ObjectStore:
                 from ray_tpu._native import NativeObjectStore
                 if NativeObjectStore.available():
                     self._native = NativeObjectStore(self._capacity)
-            except Exception:
+            except Exception as e:
+                logger.warning("native object store unavailable: %s", e)
                 self._native = None
 
     @staticmethod

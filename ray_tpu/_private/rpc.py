@@ -511,8 +511,8 @@ class RpcServer:
                     self._run_handler(ctx)
                 else:
                     self._pool.submit(self._run_handler, ctx)
-        except Exception:  # noqa: BLE001 — normal disconnect path
-            pass
+        except Exception as e:  # noqa: BLE001 — normal disconnect path
+            logger.debug("reader loop ended: %s", e)
         finally:
             with self._conn_lock:
                 self._conns.pop(conn_id, None)

@@ -508,7 +508,7 @@ class Runtime:
         try:
             action = self._try_dispatch(item)
             self._flush_dispatch_batches()  # inline path has no pass end
-        except Exception:  # Infeasible & friends: the loop's policy owns
+        except Exception:  # raylint: allow(swallow) Infeasible & friends: the queue path re-runs the policy
             return False   # error handling — re-run it there
         finally:
             self._dispatch_mutex.release()
@@ -749,7 +749,7 @@ class Runtime:
         try:
             import jax
             devs = jax.devices()
-        except Exception:
+        except Exception:  # raylint: allow(swallow) capability probe: no jax backend
             return None
         return devs[:n] if len(devs) >= n else devs
 
@@ -1208,8 +1208,8 @@ class Runtime:
                          function_name="", args=(), kwargs={},
                          options=state.options), node)
             target.release(state.options.resources)
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("resource release after actor death failed: %s", e)
 
     def _handle_actor_failure(self, state: ActorState, cause: BaseException):
         """Restart up to max_restarts (GcsActorManager::ReconstructActor)."""
@@ -1325,8 +1325,8 @@ class Runtime:
                 self._event_file = open(path, "a", buffering=1)
             try:
                 self._event_file.write(json.dumps(ev, default=str) + "\n")
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("event log write failed: %s", e)
 
     def events(self) -> List[dict]:
         return list(self._events)
@@ -1352,12 +1352,13 @@ class Runtime:
         for node in self.nodes.values():
             node.shutdown()
         self._util_pool.shutdown(wait=False, cancel_futures=True)
-        if self._event_file is not None:
-            try:
-                self._event_file.close()
-            except Exception:
-                pass
-            self._event_file = None
+        with self._event_file_lock:
+            if self._event_file is not None:
+                try:
+                    self._event_file.close()
+                except Exception as e:
+                    logger.debug("event log close failed: %s", e)
+                self._event_file = None
 
 
 # -- helpers -----------------------------------------------------------------

@@ -19,6 +19,7 @@ reference shares them between raylet and GCS.
 """
 
 from __future__ import annotations
+import logging
 
 import random
 import threading
@@ -27,6 +28,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from ray_tpu._private.config import _config
 from ray_tpu._private.ids import NodeID
 from ray_tpu._private.resources import NodeResources, ResourceSet
+
+logger = logging.getLogger("ray_tpu")
 
 _native_sched = None
 _native_checked = False
@@ -53,7 +56,8 @@ def _native():
                     _native_sched.sched_spread_select.restype = i64
                     _native_sched.sched_spread_select.argtypes = [
                         dp, up, dp, i64, i64, i64]
-            except Exception:
+            except Exception as e:
+                logger.warning("native scheduling lib unavailable: %s", e)
                 _native_sched = None
     return _native_sched
 

@@ -108,15 +108,15 @@ class StateClient:
                 # another thread may have already reconnected: probe
                 self._client.call(pb.PING, b"", timeout=5.0)
                 return
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("probe ping failed; reconnecting: %s", e)
             old = self._client
             self._client = RpcClient(self.address,
                                      auth_token=self._auth_token)
             try:
                 old.close()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("old client close failed: %s", e)
         with self._sub_lock:
             self._ensure_subscribed_locked(fresh=True)
 
@@ -130,8 +130,8 @@ class StateClient:
         if fresh and self._sub_client is not None:
             try:
                 self._sub_client.close()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("subscriber close failed: %s", e)
             self._sub_client = None
         if self._sub_client is None:
             try:
@@ -152,8 +152,8 @@ class StateClient:
         except Exception:
             try:
                 self._sub_client.close()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("subscriber close failed: %s", e)
             self._sub_client = None
             logger.warning(
                 "pubsub resubscribe to %s failed; events degrade to "

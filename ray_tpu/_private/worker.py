@@ -5,6 +5,7 @@ Parity with ``python/ray/_private/worker.py`` (``ray.init`` :1003, ``ray.get``
 """
 
 from __future__ import annotations
+import logging
 
 import os
 import threading
@@ -17,6 +18,8 @@ from ray_tpu._private.ids import JobID, TaskID
 from ray_tpu._private.resources import (CPU, TPU, ResourceSet)
 from ray_tpu._private.runtime import Runtime, task_context
 from ray_tpu.object_ref import ObjectRef
+
+logger = logging.getLogger("ray_tpu")
 
 _global_lock = threading.Lock()
 _global = None  # type: Optional["Worker"]
@@ -49,7 +52,7 @@ def _detect_num_tpus() -> int:
     try:
         import jax
         return len([d for d in jax.devices() if d.platform == "tpu"])
-    except Exception:
+    except Exception:  # raylint: allow(swallow) capability probe: no jax backend
         return 0
 
 
@@ -144,16 +147,16 @@ def shutdown():
     for hook in hooks:
         try:
             hook()
-        except Exception:
-            pass
+        except Exception as e:
+            logger.warning("shutdown hook failed: %s", e)
     with _global_lock:
         if _global is not None:
             head = getattr(_global, "dashboard_head", None)
             if head is not None:
                 try:
                     head.stop()
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("dashboard head stop failed: %s", e)
             elif getattr(_global, "dashboard_port", None) is not None:
                 from ray_tpu._private.state_server import stop_state_server
                 stop_state_server()

@@ -7,12 +7,15 @@ device-resident (zero-copy through the object store).
 """
 
 from __future__ import annotations
+import logging
 
 import os
 import pickle
 import shutil
 import tempfile
 from typing import Any, Dict, Optional
+
+logger = logging.getLogger("ray_tpu")
 
 
 class Checkpoint:
@@ -82,7 +85,8 @@ class Checkpoint:
             if os.path.exists(path):
                 shutil.rmtree(path)
             ckptr.save(os.path.abspath(path), arrays)
-        except Exception:
+        except Exception as e:
+            logger.debug("orbax save failed; using pickle fallback: %s", e)
             # Fallback: host-side pickle of numpy-fied leaves. Remove any
             # partially-written orbax dir first — _load_directory prefers
             # the directory form, so a corrupt one would shadow the pickle.

@@ -11,12 +11,15 @@ thread (the head-side ``Monitor`` process, ``monitor.py:125``).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from ray_tpu.autoscaler.node_provider import NodeProvider
+
+logger = logging.getLogger("ray_tpu")
 
 
 @dataclass
@@ -149,7 +152,9 @@ class StandardAutoscaler:
                 break
             try:
                 rid = self.provider.runtime_node_id(pid).hex()
-            except (AttributeError, KeyError):
+            except (AttributeError, KeyError) as e:
+                logger.debug("autoscaler: node %s has no runtime id yet "
+                             "(%s); skipping idle check", pid, e)
                 continue
             info = util.get(rid)
             if info is None or not info["idle"]:

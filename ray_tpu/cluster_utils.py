@@ -7,12 +7,15 @@ same role the reference's Cluster plays for multi-raylet tests.
 """
 
 from __future__ import annotations
+import logging
 
 import os
 from typing import Dict, Optional
 
 from ray_tpu._private import worker as _worker
 from ray_tpu._private.resources import CPU, TPU, ResourceSet
+
+logger = logging.getLogger("ray_tpu")
 
 
 class Cluster:
@@ -146,13 +149,15 @@ class ProcessCluster:
         for d in self.daemons:
             try:
                 d["proc"].wait(timeout=10)
-            except Exception:
+            except Exception as e:
+                logger.debug("daemon stop timed out; killing: %s", e)
                 d["proc"].kill()
         if self.state_proc.poll() is None:
             self.state_proc.terminate()
             try:
                 self.state_proc.wait(timeout=10)
-            except Exception:
+            except Exception as e:
+                logger.debug("state service stop timed out; killing: %s", e)
                 self.state_proc.kill()
 
 

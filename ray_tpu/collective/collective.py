@@ -216,5 +216,5 @@ def synchronize(group_name: str = "default"):
     try:
         import jax
         jax.effects_barrier()
-    except Exception:
+    except Exception:  # raylint: allow(swallow) capability probe: no jax backend
         pass

@@ -22,6 +22,7 @@ state-KV path remains as the small-tensor fallback.
 """
 
 from __future__ import annotations
+import logging
 
 import time
 from typing import Any, Dict, Optional
@@ -32,6 +33,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_tpu.collective.types import ReduceOp
+
+logger = logging.getLogger("ray_tpu")
 
 P2P_NS = b"tplane-p2p"
 
@@ -180,8 +183,8 @@ class XLAProcessGroup:
                 self._kv().kv_put(
                     f"{self.group_name}/addr/{self.rank}".encode(),
                     addr.encode(), overwrite=True, namespace=P2P_NS)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("p2p address publish failed: %s", e)
 
     def _peer_addr(self, rank: int) -> Optional[str]:
         try:
@@ -189,7 +192,8 @@ class XLAProcessGroup:
                 f"{self.group_name}/addr/{rank}".encode(),
                 namespace=P2P_NS)
             return raw.decode() if raw else None
-        except Exception:
+        except Exception as e:
+            logger.debug("peer address lookup failed: %s", e)
             return None
 
     def send(self, tensor, dst_rank: int):

@@ -9,12 +9,15 @@ timestamp so the head can mark stale reporters.
 """
 
 from __future__ import annotations
+import logging
 
 import json
 import os
 import threading
 import time
 from typing import Any, Dict, Optional
+
+logger = logging.getLogger("ray_tpu")
 
 _NS = b"node_stats"
 
@@ -92,8 +95,8 @@ class NodeReporterAgent:
             stats["object_store"] = {
                 "num_objects": len(getattr(store, "_entries", {})),
             }
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("object-store stats failed: %s", e)
         arena = getattr(rt, "host_arena", None)
         if arena is not None:
             try:
@@ -102,20 +105,20 @@ class NodeReporterAgent:
                                   "capacity_mb": round(cap / 1048576, 1),
                                   "objects": count,
                                   "owner": rt._arena_is_owner}
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("arena stats failed: %s", e)
         try:
             avail = rt.local_node.resources.available.to_dict()
             total = rt.local_node.resources.total.to_dict()
             stats["resources"] = {"available": avail, "total": total}
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("resource stats failed: %s", e)
         monitor = getattr(rt, "memory_monitor", None)
         if monitor is not None:
             try:
                 stats["memory_monitor"] = monitor.snapshot()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("memory-monitor stats failed: %s", e)
         return stats
 
     def publish_once(self):
@@ -128,7 +131,8 @@ class NodeReporterAgent:
         while not self._stop.wait(self.interval_s):
             try:
                 self.publish_once()
-            except Exception:
+            except Exception as e:
+                logger.debug("stats publish failed: %s", e)
                 if self._stop.is_set():
                     return
 
@@ -144,6 +148,6 @@ def collect_node_stats(state_client) -> Dict[str, Dict[str, Any]]:
                     out[key.hex()] = json.loads(blob)
                 except ValueError:
                     pass
-    except Exception:
-        pass
+    except Exception as e:
+        logger.debug("cluster stats read failed: %s", e)
     return out

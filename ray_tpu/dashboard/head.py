@@ -22,6 +22,7 @@ Endpoints:
 """
 
 from __future__ import annotations
+import logging
 
 import json
 import threading
@@ -30,6 +31,8 @@ import urllib.parse
 from typing import Optional
 
 from ray_tpu.dashboard.agent import collect_node_stats
+
+logger = logging.getLogger("ray_tpu")
 
 _PAGE = """<!DOCTYPE html>
 <html><head><title>ray_tpu dashboard</title><style>
@@ -274,12 +277,12 @@ class DashboardHead:
             self._httpd = None
         try:
             self.pool.close_all()
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("connection pool close failed: %s", e)
         try:
             self.state.close()
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("state client close failed: %s", e)
 
 
 def start_dashboard(state_addr: str, port: int = 0,

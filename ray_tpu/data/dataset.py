@@ -669,7 +669,7 @@ class Dataset:
     def __repr__(self):
         try:
             n = len(self._cached) if self._cached else len(self._block_refs)
-        except Exception:
+        except Exception:  # raylint: allow(swallow) repr must never raise
             n = "?"
         stages = "+".join(s.name for s in self._stages) or "read"
         return f"Dataset(blocks={n}, plan={stages})"

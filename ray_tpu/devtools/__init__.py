@@ -1,0 +1,19 @@
+"""Developer tooling: framework-aware static analysis + runtime watchdogs.
+
+Two enforcement layers for the concurrency invariants the runtime's design
+depends on (threads-as-workers inside a single device-owner daemon, see
+``_private/distributed.py``):
+
+- :mod:`ray_tpu.devtools.linter` — an AST lint engine with rules that know
+  about this framework's idioms (blocking calls in async bodies, lock-order
+  consistency, unguarded cross-thread state, silent exception swallows,
+  host-device syncs reachable from jitted step loops, proto/pb2 drift).
+  CLI: ``python -m ray_tpu.devtools.lint ray_tpu``.
+- :mod:`ray_tpu.devtools.lockwatch` — a runtime lock-order watchdog that
+  wraps ``threading.Lock``/``RLock`` creation, builds the cross-thread
+  lock-order graph actually exercised, and reports cycles (potential
+  deadlocks) and over-threshold holds.  Activated by ``RAY_TPU_LOCKWATCH=1``
+  so any test run doubles as its workload.
+"""
+
+from ray_tpu.devtools.linter import LintEngine, Finding  # noqa: F401

@@ -1,0 +1,837 @@
+"""Framework-aware AST lint engine.
+
+The engine walks every ``*.py`` file under the given roots, parses each
+once, and runs two kinds of rules over the parse trees:
+
+- **file rules** see one file at a time (R1, R3, R4, R5);
+- **project rules** see the whole tree at once and can correlate across
+  files (R2 lock-order consistency, R6 proto/pb2 drift).
+
+Suppression has two layers, mirroring the sanitizer stance of the native
+side (``run_sanitizers.sh``):
+
+- an inline justification comment on the finding line or the line above::
+
+      except Exception:  # raylint: allow(swallow) best-effort close
+          pass
+
+  The tag in ``allow(...)`` is the rule's short tag (``swallow``,
+  ``lock-order``, ...) or its id (``R4``); ``allow(all)`` suppresses every
+  rule on that line.  The justification text after the tag is *required
+  culture*, not enforced syntax.
+- a per-file allowlist baseline (``--baseline FILE``): lines of
+  ``RULE<whitespace>path`` that tolerate pre-existing findings while a
+  cleanup is in flight.  The shipped baseline is empty — the tree lints
+  clean — and CI fails on any finding not covered by one of the two.
+
+Rules (see ARCHITECTURE.md "Static analysis & concurrency invariants"):
+
+==== ============== ====================================================
+id   tag            what it catches
+==== ============== ====================================================
+R1   async-blocking blocking call (``time.sleep``, ``.result()``,
+                    lock ``.acquire()`` without timeout, ``ray_tpu.get``)
+                    inside an ``async def`` body
+R2   lock-order     two locks statically acquired in both A→B and B→A
+                    nesting orders anywhere in the tree
+R3   unguarded-state self-attribute written both from a thread-entry
+                    method and from on-thread code with no lock held
+R4   swallow        ``except Exception:`` that neither re-raises, logs,
+                    nor uses the caught exception
+R5   host-sync      host-device sync (``.item()``, ``float()``,
+                    ``np.asarray``, ``jax.device_get``) reachable from a
+                    jitted step function
+R6   proto-drift    field/enum-number drift between ``raytpu.proto`` and
+                    the committed ``raytpu_pb2.py``
+==== ============== ====================================================
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+__all__ = ["Finding", "LintEngine", "rule", "project_rule", "RULES",
+           "PROJECT_RULES"]
+
+_ALLOW_RE = re.compile(r"#\s*raylint:\s*allow\(([A-Za-z0-9_,\- ]+)\)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str          # "R4"
+    tag: str           # "swallow"
+    path: str          # path relative to the lint root
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule}({self.tag}): {self.message}"
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "tag": self.tag, "path": self.path,
+                "line": self.line, "message": self.message}
+
+
+class FileContext:
+    """One parsed source file plus the lookups rules keep re-needing."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.allow = self._collect_allows(source)
+        # name -> module it was imported from ("from ray_tpu import get")
+        self.from_imports: Dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    self.from_imports[alias.asname or alias.name] = node.module
+
+    @staticmethod
+    def _collect_allows(source: str) -> Dict[int, Set[str]]:
+        """line -> set of allowed tags, from ``# raylint: allow(tag)``."""
+        allows: Dict[int, Set[str]] = {}
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+            for tok in tokens:
+                if tok.type == tokenize.COMMENT:
+                    m = _ALLOW_RE.search(tok.string)
+                    if m:
+                        tags = {t.strip() for t in m.group(1).split(",")}
+                        allows.setdefault(tok.start[0], set()).update(tags)
+        except tokenize.TokenError:
+            pass
+        return allows
+
+    def allowed(self, line: int, rule_id: str, tag: str) -> bool:
+        """A finding is suppressed by an allow comment on its own line, the
+        line above, or the enclosing statement's first line (for multi-line
+        statements the AST anchors mid-construct)."""
+        for cand in (line, line - 1):
+            tags = self.allow.get(cand)
+            if tags and ({rule_id, tag, "all"} & tags):
+                return True
+        return False
+
+
+# --------------------------------------------------------------------------
+# rule registry
+
+RULES: List[Tuple[str, str, Callable]] = []           # (id, tag, fn(ctx))
+PROJECT_RULES: List[Tuple[str, str, Callable]] = []   # (id, tag, fn(ctxs, engine))
+
+
+def rule(rule_id: str, tag: str):
+    def deco(fn):
+        RULES.append((rule_id, tag, fn))
+        return fn
+    return deco
+
+
+def project_rule(rule_id: str, tag: str):
+    def deco(fn):
+        PROJECT_RULES.append((rule_id, tag, fn))
+        return fn
+    return deco
+
+
+# --------------------------------------------------------------------------
+# shared AST helpers
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """Best-effort dotted name for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+_LOCKISH = re.compile(r"(^|[._])(lock|mutex|cv|cond|sem)", re.IGNORECASE)
+
+
+def _is_lockish(expr_text: Optional[str]) -> bool:
+    return bool(expr_text and _LOCKISH.search(expr_text))
+
+
+def _has_kwarg(call: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in call.keywords)
+
+
+def _walk_pruned(root: ast.AST) -> Iterator[ast.AST]:
+    """``ast.walk`` that does not descend into nested def/lambda bodies
+    (those run in another context — executors, callbacks, later)."""
+    stack: List[ast.AST] = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def _funcs_with_class(tree: ast.Module):
+    """Yield (class_name_or_None, FunctionDef/AsyncFunctionDef)."""
+    def walk(node, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield cls, child
+                yield from walk(child, cls)
+            else:
+                yield from walk(child, cls)
+    yield from walk(tree, None)
+
+
+# --------------------------------------------------------------------------
+# R1: blocking calls inside async def bodies
+
+_BLOCKING_SLEEP = {"time.sleep", "sleep"}
+
+
+@rule("R1", "async-blocking")
+def check_async_blocking(ctx: FileContext) -> Iterator[Finding]:
+    """An ``async def`` body must not make blocking calls: they stall the
+    event loop the serve/router/long-poll layer multiplexes on.  Flags
+    ``time.sleep``, ``Future.result()``, lock ``.acquire()`` with no
+    timeout/non-blocking arg, and ``ray_tpu.get(...)``."""
+
+    def scan(body_node, fname):
+        for node in _walk_pruned(body_node):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = _dotted(node.func)
+            if dn in _BLOCKING_SLEEP and (
+                    dn != "sleep" or
+                    ctx.from_imports.get("sleep") == "time"):
+                yield node, f"blocking time.sleep() inside 'async def {fname}'"
+            elif isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                if attr == "result" and not node.args and \
+                        not _has_kwarg(node, "timeout"):
+                    yield node, (f"blocking Future.result() inside "
+                                 f"'async def {fname}' — await it instead")
+                elif attr == "acquire" and _is_lockish(_dotted(node.func.value)):
+                    if not node.args and not (_has_kwarg(node, "timeout") or
+                                              _has_kwarg(node, "blocking")):
+                        yield node, (f"lock .acquire() with no timeout inside "
+                                     f"'async def {fname}' can deadlock the "
+                                     f"event loop")
+                elif attr == "get" and _dotted(node.func.value) == "ray_tpu":
+                    yield node, (f"blocking ray_tpu.get() inside "
+                                 f"'async def {fname}' — resolve off-loop")
+            elif isinstance(node.func, ast.Name) and node.func.id == "get" and \
+                    ctx.from_imports.get("get", "").startswith("ray_tpu"):
+                yield node, (f"blocking ray_tpu.get() inside "
+                             f"'async def {fname}' — resolve off-loop")
+
+    for _cls, fn in _funcs_with_class(ctx.tree):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        for node, msg in scan(fn, fn.name):
+            if not ctx.allowed(node.lineno, "R1", "async-blocking"):
+                yield Finding("R1", "async-blocking", ctx.relpath,
+                              node.lineno, msg)
+
+
+# --------------------------------------------------------------------------
+# R2: statically inconsistent lock order (project rule)
+
+def _lock_identity(expr: ast.AST, cls: Optional[str]) -> Optional[str]:
+    text = _dotted(expr)
+    if not _is_lockish(text):
+        return None
+    if text.startswith("self."):
+        return f"{cls or '?'}.{text[5:]}"
+    return text
+
+
+def _iter_with_pairs(ctx: FileContext):
+    """Yield (outer_id, inner_id, lineno) for every nested lock ``with``."""
+    for cls, fn in _funcs_with_class(ctx.tree):
+        stack: List[str] = []
+
+        def visit(node):
+            pushed = 0
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lid = _lock_identity(item.context_expr, cls)
+                    if lid:
+                        for outer in stack:
+                            if outer != lid:
+                                yield (outer, lid, node.lineno)
+                        stack.append(lid)
+                        pushed += 1
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue  # nested defs run elsewhere/later
+                yield from visit(child)
+            for _ in range(pushed):
+                stack.pop()
+
+        for item in visit(fn):
+            yield item
+
+
+@project_rule("R2", "lock-order")
+def check_lock_order(ctxs: List[FileContext], _engine) -> Iterator[Finding]:
+    """If lock A is ever taken while holding B *and* B while holding A,
+    two threads interleaving those paths deadlock.  Lock identity is the
+    attribute path qualified by class name (``Router._lock``), so the rule
+    correlates orderings across files."""
+    edges: Dict[Tuple[str, str], List[Tuple[FileContext, int]]] = {}
+    for ctx in ctxs:
+        for outer, inner, line in _iter_with_pairs(ctx):
+            edges.setdefault((outer, inner), []).append((ctx, line))
+    seen: Set[Tuple[str, str]] = set()
+    for (a, b), sites in sorted(edges.items()):
+        if (b, a) in edges and (b, a) not in seen:
+            seen.add((a, b))
+            other = edges[(b, a)][0]
+            for ctx, line in sites:
+                if ctx.allowed(line, "R2", "lock-order"):
+                    continue
+                yield Finding(
+                    "R2", "lock-order", ctx.relpath, line,
+                    f"lock order {a} -> {b} here conflicts with "
+                    f"{b} -> {a} at {other[0].relpath}:{other[1]} "
+                    f"(potential deadlock)")
+
+
+# --------------------------------------------------------------------------
+# R3: unguarded cross-thread shared-state mutation
+
+def _self_attr_writes(fn: ast.AST) -> Iterator[Tuple[str, ast.AST]]:
+    for node in ast.walk(fn):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self":
+                yield t.attr, node
+
+
+def _guarded_by_lock(fn: ast.AST, write: ast.AST) -> bool:
+    """True if *write* sits inside a ``with <lock-ish>:`` in *fn*."""
+    class Visitor(ast.NodeVisitor):
+        def __init__(self):
+            self.depth = 0
+            self.guarded = False
+
+        def visit_With(self, node):
+            lockish = any(_is_lockish(_dotted(i.context_expr))
+                          for i in node.items)
+            self.depth += lockish
+            self.generic_visit(node)
+            self.depth -= lockish
+
+        visit_AsyncWith = visit_With
+
+        def generic_visit(self, node):
+            if node is write and self.depth > 0:
+                self.guarded = True
+            super().generic_visit(node)
+
+    v = Visitor()
+    v.visit(fn)
+    return v.guarded
+
+
+@rule("R3", "unguarded-state")
+def check_unguarded_state(ctx: FileContext) -> Iterator[Finding]:
+    """Inside one class, an attribute REBOUND both by a thread-entry method
+    (a ``threading.Thread(target=self.x)`` target, an executor-submitted
+    method, or ``run`` of a Thread subclass) and by on-thread code has two
+    concurrent writers; every such write must hold a lock.  Single-writer
+    attributes (the daemon owns them) are fine — the GIL makes the store
+    itself atomic, ordering is what needs the lock."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        methods = {n.name: n for n in node.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        # 1. thread-entry methods
+        entries: Set[str] = set()
+        base_names = {_dotted(b) for b in node.bases}
+        if {"threading.Thread", "Thread"} & base_names and "run" in methods:
+            entries.add("run")
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            dn = _dotted(sub.func)
+            cand = None
+            if dn in ("threading.Thread", "Thread"):
+                for kw in sub.keywords:
+                    if kw.arg == "target":
+                        cand = kw.value
+            elif isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr in ("submit", "call_soon_threadsafe"):
+                cand = sub.args[0] if sub.args else None
+            if isinstance(cand, ast.Attribute) and \
+                    isinstance(cand.value, ast.Name) and \
+                    cand.value.id == "self" and cand.attr in methods:
+                entries.add(cand.attr)
+        if not entries:
+            continue
+        # 2. close entries over same-class self.method() calls
+        reach = set(entries)
+        frontier = list(entries)
+        while frontier:
+            m = frontier.pop()
+            for sub in ast.walk(methods[m]):
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Attribute) and \
+                        isinstance(sub.func.value, ast.Name) and \
+                        sub.func.value.id == "self" and \
+                        sub.func.attr in methods and \
+                        sub.func.attr not in reach:
+                    reach.add(sub.func.attr)
+                    frontier.append(sub.func.attr)
+        # 3. writers per attribute, split by side of the thread boundary
+        writes: Dict[str, List[Tuple[str, ast.AST]]] = {}
+        for mname, fn in methods.items():
+            if mname == "__init__":
+                continue
+            for attr, wnode in _self_attr_writes(fn):
+                writes.setdefault(attr, []).append((mname, wnode))
+        for attr, sites in sorted(writes.items()):
+            owners = {m for m, _ in sites}
+            off = owners & reach
+            on = owners - reach
+            if not off or not on:
+                continue  # single side owns it
+            for mname, wnode in sites:
+                if _guarded_by_lock(methods[mname], wnode):
+                    continue
+                if ctx.allowed(wnode.lineno, "R3", "unguarded-state"):
+                    continue
+                side = "thread-entry" if mname in reach else "on-thread"
+                yield Finding(
+                    "R3", "unguarded-state", ctx.relpath, wnode.lineno,
+                    f"self.{attr} written from {side} method "
+                    f"'{mname}' without a lock, but also written from "
+                    f"{'on-thread' if side == 'thread-entry' else 'thread-entry'}"
+                    f" methods {sorted(on if side == 'thread-entry' else off)}"
+                    f" of class {node.name}")
+
+
+# --------------------------------------------------------------------------
+# R4: silent exception swallows
+
+_LOG_ATTRS = {"debug", "info", "warning", "warn", "error", "exception",
+              "critical", "log", "record", "print_exc", "print_exception"}
+
+
+def _handler_is_silent(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return False
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr in _LOG_ATTRS:
+                return False
+            if isinstance(fn, ast.Name) and fn.id in ("print", "warn"):
+                return False
+        if handler.name and isinstance(node, ast.Name) and \
+                node.id == handler.name and isinstance(node.ctx, ast.Load):
+            return False  # the exception object is used, not dropped
+    return True
+
+
+def _broad_catch(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    types = handler.type.elts if isinstance(handler.type, ast.Tuple) \
+        else [handler.type]
+    for t in types:
+        if _dotted(t) in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+@rule("R4", "swallow")
+def check_swallow(ctx: FileContext) -> Iterator[Finding]:
+    """A broad ``except`` that neither re-raises, logs, nor *uses* the
+    caught exception hides faults — exactly the ones chaos tests try to
+    surface in daemon threads and RPC/scheduler/object-store paths.  Either
+    handle it visibly or justify with ``# raylint: allow(swallow) <why>``."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _broad_catch(node) or not _handler_is_silent(node):
+            continue
+        if ctx.allowed(node.lineno, "R4", "swallow"):
+            continue
+        yield Finding(
+            "R4", "swallow", ctx.relpath, node.lineno,
+            "broad except swallows the exception silently: re-raise, log "
+            "with context, or justify with '# raylint: allow(swallow) <why>'")
+
+
+# --------------------------------------------------------------------------
+# R5: host-device sync reachable from jitted step functions
+
+_SYNC_CALLS = {"jax.device_get", "device_get", "np.asarray", "numpy.asarray",
+               "onp.asarray", "np.array", "numpy.array"}
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pmap", "pmap",
+              "jax.experimental.pjit.pjit"}
+_TRACED_HOFS = {"jax.lax.scan", "lax.scan", "jax.lax.fori_loop",
+                "lax.fori_loop", "jax.lax.while_loop", "lax.while_loop",
+                "jax.lax.cond", "lax.cond", "jax.grad", "jax.value_and_grad",
+                "jax.checkpoint", "jax.remat"}
+
+
+def _jit_decorated(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        dn = _dotted(target)
+        if dn in _JIT_NAMES:
+            return True
+        if dn in ("functools.partial", "partial") and \
+                isinstance(dec, ast.Call) and dec.args and \
+                _dotted(dec.args[0]) in _JIT_NAMES:
+            return True
+    return False
+
+
+@rule("R5", "host-sync")
+def check_host_sync(ctx: FileContext) -> Iterator[Finding]:
+    """``.item()`` / ``float()`` / ``np.asarray`` / ``jax.device_get``
+    inside a function reachable from a jitted train/bench step either
+    fails tracing or — worse — silently forces a device→host sync per
+    step.  Roots are jit/pmap-decorated functions and functions handed to
+    ``jax.jit``/``lax.scan``-style tracers; reachability is the module-
+    local call graph."""
+    module_fns: Dict[str, ast.AST] = {}
+    for _cls, fn in _funcs_with_class(ctx.tree):
+        module_fns.setdefault(fn.name, fn)
+
+    roots: Set[str] = set()
+    for name, fn in module_fns.items():
+        if _jit_decorated(fn):
+            roots.add(name)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dn = _dotted(node.func)
+        if dn in _JIT_NAMES and node.args:
+            arg = _dotted(node.args[0])
+            if arg in module_fns:
+                roots.add(arg)
+        elif dn in _TRACED_HOFS and node.args:
+            arg = _dotted(node.args[0])
+            if arg in module_fns:
+                roots.add(arg)
+    if not roots:
+        return
+
+    # module-local call-graph closure (plain Name calls only)
+    reach = set(roots)
+    frontier = list(roots)
+    while frontier:
+        fname = frontier.pop()
+        for node in ast.walk(module_fns[fname]):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                callee = node.func.id
+                if callee in module_fns and callee not in reach:
+                    reach.add(callee)
+                    frontier.append(callee)
+
+    for fname in sorted(reach):
+        fn = module_fns[fname]
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            msg = None
+            dn = _dotted(node.func)
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "item" and not node.args:
+                msg = ".item() forces a device->host sync"
+            elif dn in _SYNC_CALLS:
+                msg = f"{dn}() copies device data to host"
+            elif isinstance(node.func, ast.Name) and \
+                    node.func.id in ("float", "int") and node.args and \
+                    not isinstance(node.args[0], ast.Constant):
+                msg = (f"{node.func.id}() on a traced value forces a "
+                       f"device->host sync")
+            if msg and not ctx.allowed(node.lineno, "R5", "host-sync"):
+                yield Finding(
+                    "R5", "host-sync", ctx.relpath, node.lineno,
+                    f"{msg} inside '{fname}', reachable from jitted "
+                    f"root(s) {sorted(roots & reach)}")
+
+
+# --------------------------------------------------------------------------
+# R6: proto <-> pb2 wire-schema drift (project rule)
+
+def parse_proto_text(source: str) -> Dict[str, Dict[str, int]]:
+    """Parse message fields and enum values out of .proto text.
+
+    Returns ``{"Msg": {"field": number}, "Enum": {"VALUE": number}}`` with
+    nested messages flattened as ``Outer.Inner``.
+    """
+    src = re.sub(r"//[^\n]*", "", source)
+    src = re.sub(r"/\*.*?\*/", "", src, flags=re.S)
+    field_re = re.compile(
+        r"(?:repeated\s+|optional\s+|required\s+)?"
+        r"(?:map\s*<[^>]+>|[\w.]+)\s+(\w+)\s*=\s*(\d+)\s*(?:\[[^\]]*\])?\s*;$")
+    enum_val_re = re.compile(r"(\w+)\s*=\s*(\d+)\s*;$")
+    # one token per block open / close / terminated statement
+    token_re = re.compile(
+        r"\b(message|enum|oneof)\s+(\w+)\s*\{|(\{)|(\})|([^{};]+;)")
+    out: Dict[str, Dict[str, int]] = {}
+    stack: List[Tuple[str, str]] = []  # (kind, qualified name)
+
+    for m in token_re.finditer(src):
+        if m.group(1):
+            kind, name = m.group(1), m.group(2)
+            if kind == "oneof":
+                # oneof members belong to the enclosing message
+                stack.append(("oneof", stack[-1][1] if stack else name))
+            else:
+                parent = stack[-1][1] + "." if stack and \
+                    stack[-1][0] == "message" else ""
+                qual = parent + name
+                out.setdefault(qual, {})
+                stack.append((kind, qual))
+        elif m.group(3):
+            stack.append(("block", stack[-1][1] if stack else ""))
+        elif m.group(4):
+            if stack:
+                stack.pop()
+        elif stack:
+            stmt = " ".join(m.group(5).split())
+            kind, qual = stack[-1]
+            if kind in ("message", "oneof"):
+                fm = field_re.match(stmt)
+                if fm:
+                    out[qual][fm.group(1)] = int(fm.group(2))
+            elif kind == "enum":
+                em = enum_val_re.match(stmt)
+                if em:
+                    out[qual][em.group(1)] = int(em.group(2))
+    return out
+
+
+def parse_pb2_descriptor(pb2_source: str) -> Dict[str, Dict[str, int]]:
+    """Extract the serialized FileDescriptorProto from generated pb2 source
+    and flatten it to the same shape as :func:`parse_proto_text`.
+
+    Works on the source text (no import), so fixture copies never collide
+    with the process-wide protobuf descriptor pool.
+    """
+    tree = ast.parse(pb2_source)
+    blob: Optional[bytes] = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "AddSerializedFile" and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, bytes):
+            blob = node.args[0].value
+            break
+    if blob is None:
+        raise ValueError("no AddSerializedFile(...) blob in pb2 source")
+    from google.protobuf import descriptor_pb2
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.MergeFromString(blob)
+
+    out: Dict[str, Dict[str, int]] = {}
+
+    def walk_msg(msg, prefix):
+        qual = prefix + msg.name
+        fields = out.setdefault(qual, {})
+        for f in msg.field:
+            fields[f.name] = f.number
+        for nested in msg.nested_type:
+            if nested.options.map_entry:
+                continue  # synthetic map<>-entry message
+            walk_msg(nested, qual + ".")
+        for enum in msg.enum_type:
+            out[qual + "." + enum.name] = {v.name: v.number
+                                           for v in enum.value}
+
+    for msg in fdp.message_type:
+        walk_msg(msg, "")
+    for enum in fdp.enum_type:
+        out[enum.name] = {v.name: v.number for v in enum.value}
+    return out
+
+
+@project_rule("R6", "proto-drift")
+def check_proto_drift(ctxs: List[FileContext], engine) -> Iterator[Finding]:
+    """The committed ``raytpu_pb2.py`` must agree with ``raytpu.proto`` on
+    every field and enum number: daemons deserialize each other's frames by
+    number, so silent drift corrupts the wire, not a test."""
+    pairs = engine.proto_pairs
+    if pairs is None:
+        pairs = []
+        for ctx in ctxs:
+            if os.path.basename(ctx.path) != "raytpu_pb2.py":
+                continue
+            proto = os.path.join(os.path.dirname(ctx.path), "raytpu.proto")
+            if os.path.exists(proto):
+                pairs.append((proto, ctx.path, ctx.relpath))
+    for proto_path, pb2_path, relpath in pairs:
+        with open(proto_path, encoding="utf-8") as f:
+            want = parse_proto_text(f.read())
+        with open(pb2_path, encoding="utf-8") as f:
+            got = parse_pb2_descriptor(f.read())
+        for qual, fields in sorted(want.items()):
+            if qual not in got:
+                yield Finding("R6", "proto-drift", relpath, 1,
+                              f"{qual} declared in raytpu.proto but absent "
+                              f"from the generated pb2")
+                continue
+            for name, num in sorted(fields.items()):
+                gnum = got[qual].get(name)
+                if gnum is None:
+                    yield Finding(
+                        "R6", "proto-drift", relpath, 1,
+                        f"{qual}.{name} (= {num}) missing from pb2 — "
+                        f"run ray_tpu.protocol.regenerate()")
+                elif gnum != num:
+                    yield Finding(
+                        "R6", "proto-drift", relpath, 1,
+                        f"{qual}.{name}: proto says {num}, pb2 says {gnum} "
+                        f"— wire numbers drifted, regenerate")
+        for qual, fields in sorted(got.items()):
+            for name in sorted(set(fields) - set(want.get(qual, {}))):
+                yield Finding(
+                    "R6", "proto-drift", relpath, 1,
+                    f"{qual}.{name} present in pb2 but not in raytpu.proto")
+
+
+# --------------------------------------------------------------------------
+# engine
+
+class LintEngine:
+    def __init__(self, roots: Iterable[str], baseline_path: Optional[str] = None,
+                 only_rules: Optional[Set[str]] = None,
+                 proto_pairs: Optional[List[Tuple[str, str, str]]] = None):
+        self.roots = [os.path.abspath(r) for r in roots]
+        self.baseline = self._load_baseline(baseline_path)
+        self.only_rules = only_rules
+        # explicit (proto_path, pb2_path, relpath) triples override R6's
+        # autodiscovery — the drift tests point this at mutated fixtures
+        self.proto_pairs = proto_pairs
+        self.errors: List[str] = []
+
+    @staticmethod
+    def _load_baseline(path: Optional[str]) -> Set[Tuple[str, str]]:
+        entries: Set[Tuple[str, str]] = set()
+        if not path or not os.path.exists(path):
+            return entries
+        with open(path, encoding="utf-8") as f:
+            for raw in f:
+                line = raw.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                parts = line.split(None, 1)
+                if len(parts) == 2:
+                    entries.add((parts[0], parts[1].strip()))
+        return entries
+
+    def _want(self, rule_id: str, tag: str) -> bool:
+        return not self.only_rules or \
+            bool({rule_id, tag} & self.only_rules)
+
+    def _iter_files(self) -> Iterator[Tuple[str, str]]:
+        for root in self.roots:
+            if os.path.isfile(root):
+                yield root, os.path.basename(root)
+                continue
+            base = os.path.dirname(root.rstrip(os.sep))
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in ("__pycache__", ".git"))
+                for fname in sorted(filenames):
+                    if fname.endswith(".py"):
+                        full = os.path.join(dirpath, fname)
+                        yield full, os.path.relpath(full, base)
+
+    def run(self) -> List[Finding]:
+        ctxs: List[FileContext] = []
+        for path, rel in self._iter_files():
+            try:
+                with open(path, encoding="utf-8") as f:
+                    ctxs.append(FileContext(path, rel, f.read()))
+            except (SyntaxError, UnicodeDecodeError) as e:
+                self.errors.append(f"{rel}: unparseable: {e}")
+        findings: List[Finding] = []
+        for ctx in ctxs:
+            for rule_id, tag, fn in RULES:
+                if self._want(rule_id, tag):
+                    findings.extend(fn(ctx))
+        for rule_id, tag, fn in PROJECT_RULES:
+            if self._want(rule_id, tag):
+                findings.extend(fn(ctxs, self))
+        findings = [f for f in findings
+                    if (f.rule, f.path) not in self.baseline]
+        findings.sort(key=lambda f: (f.path, f.line, f.rule))
+        return findings
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.devtools.lint",
+        description="framework-aware static analysis for ray_tpu")
+    parser.add_argument("roots", nargs="*", default=["ray_tpu"],
+                        help="files or directories to lint")
+    parser.add_argument("--json", action="store_true",
+                        help="emit findings as a JSON array")
+    parser.add_argument("--baseline", default=None,
+                        help="allowlist file of 'RULE path' lines")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule ids/tags to run "
+                             "(default: all)")
+    parser.add_argument("--write-baseline", default=None, metavar="FILE",
+                        help="write current findings as a baseline and exit 0")
+    args = parser.parse_args(argv)
+
+    only = {r.strip() for r in args.rules.split(",")} if args.rules else None
+    engine = LintEngine(args.roots or ["ray_tpu"], args.baseline, only)
+    findings = engine.run()
+
+    if args.write_baseline:
+        with open(args.write_baseline, "w", encoding="utf-8") as f:
+            f.write("# raylint baseline — tolerated pre-existing findings\n")
+            for rule_id, path in sorted({(x.rule, x.path) for x in findings}):
+                f.write(f"{rule_id} {path}\n")
+        print(f"wrote {args.write_baseline} "
+              f"({len(findings)} findings baselined)")
+        return 0
+
+    if args.json:
+        print(json.dumps([f.to_json() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        counts: Dict[str, int] = {}
+        for f in findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        summary = ", ".join(f"{k}: {v}" for k, v in sorted(counts.items()))
+        print(f"raylint: {len(findings)} finding(s)"
+              + (f" ({summary})" if summary else ""))
+        for err in engine.errors:
+            print(f"raylint: warning: {err}")
+    return 1 if findings else 0

@@ -1,0 +1,381 @@
+"""Runtime lock-order watchdog.
+
+The runtime's threads-as-workers stance means deadlock safety rests on
+every pair of locks being taken in one consistent order across all
+threads.  This module checks that *empirically*: when installed it wraps
+``threading.Lock``/``threading.RLock`` creation (for callers inside the
+ray_tpu package and its test suite) in a proxy that records, per thread,
+which locks are held when another is acquired.  Those observations form a
+directed graph over lock *creation sites*; a cycle in the graph is a
+potential deadlock even if no run has hit it yet.  It also flags holds
+longer than a threshold — long holds under the device-owner daemon stall
+every worker thread behind them.
+
+Activation::
+
+    RAY_TPU_LOCKWATCH=1 python -m pytest tests/ ...          # any workload
+    RAY_TPU_LOCKWATCH_OUT=/tmp/lockwatch.json ...            # JSON report
+    RAY_TPU_LOCKWATCH_HOLD_S=0.5 ...                         # hold threshold
+    RAY_TPU_LOCKWATCH_ALL=1 ...                              # wrap every caller
+
+``ray_tpu/__init__`` installs the watchdog before importing any submodule
+when ``RAY_TPU_LOCKWATCH`` is set, so module-level locks are wrapped too.
+At process exit a one-line summary goes to stderr (details when cycles
+were seen) and, if ``RAY_TPU_LOCKWATCH_OUT`` is set, the full report is
+written there as JSON.
+
+Two cycle granularities:
+
+- **cross-site**: lock site A was held while acquiring site B somewhere,
+  and B while acquiring A somewhere else — the classic ABBA.
+- **same-site**: two *instances* created at the same line were each held
+  while acquiring the other.  Site-level analysis cannot order these, so
+  the proxy tracks instance pairs; a consistent hierarchy (always parent
+  before child) stays clean, an inversion is reported.
+
+Unit-test surface: :func:`wrap` wraps a single lock with an explicit
+name, no installation required.
+"""
+
+from __future__ import annotations
+
+import _thread
+import atexit
+import itertools
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = ["install", "uninstall", "installed", "wrap", "Lock", "RLock",
+           "report", "cycles", "reset"]
+
+# raw primitives so the watchdog never traces itself
+_graph_lock = _thread.allocate_lock()
+_tls = threading.local()
+_uid_counter = itertools.count(1)
+
+_edges: Dict[Tuple[str, str], int] = {}            # (site_a, site_b) -> count
+_edge_threads: Dict[Tuple[str, str], str] = {}     # example thread name
+_same_site_pairs: Set[Tuple[int, int]] = set()     # (uid_held, uid_acquired)
+_same_site_of: Dict[Tuple[int, int], str] = {}     # pair -> site
+_long_holds: List[dict] = []
+_wrapped_count = 0
+
+_orig_lock = None
+_orig_rlock = None
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _hold_threshold() -> float:
+    try:
+        return float(os.environ.get("RAY_TPU_LOCKWATCH_HOLD_S", "1.0"))
+    except ValueError:
+        return 1.0
+
+
+def _held_stack() -> list:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+def _note_acquire(proxy: "_LockProxy") -> None:
+    held = _held_stack()
+    for entry in held:
+        if entry[0] is proxy:       # RLock re-entry: no new ordering info
+            entry[2] += 1
+            return
+    if held:
+        with _graph_lock:
+            for other, _, _ in held:
+                if other._site != proxy._site:
+                    key = (other._site, proxy._site)
+                    _edges[key] = _edges.get(key, 0) + 1
+                    _edge_threads.setdefault(
+                        key, threading.current_thread().name)
+                else:
+                    pair = (other._uid, proxy._uid)
+                    _same_site_pairs.add(pair)
+                    _same_site_of[pair] = proxy._site
+    held.append([proxy, time.monotonic(), 1])
+
+
+def _note_release(proxy: "_LockProxy", full: bool = False) -> None:
+    held = _held_stack()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i][0] is proxy:
+            held[i][2] -= 1
+            if full or held[i][2] <= 0:
+                dt = time.monotonic() - held[i][1]
+                del held[i]
+                if dt > _hold_threshold():
+                    with _graph_lock:
+                        _long_holds.append({
+                            "site": proxy._site,
+                            "seconds": round(dt, 3),
+                            "thread": threading.current_thread().name,
+                        })
+            return
+    # release of a lock this thread never acquired (hand-off patterns on
+    # primitive locks): nothing to unwind
+
+
+class _LockProxy:
+    """Wraps a primitive lock; mirrors its API, records ordering."""
+
+    __slots__ = ("_inner", "_site", "_uid", "__weakref__")
+
+    def __init__(self, inner, site: str):
+        self._inner = inner
+        self._site = site
+        self._uid = next(_uid_counter)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            _note_acquire(self)
+        return ok
+
+    def release(self) -> None:
+        _note_release(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<lockwatch {type(self._inner).__name__} {self._site}>"
+
+
+class _RLockProxy(_LockProxy):
+    """RLock flavour: also speaks ``Condition``'s private protocol so a
+    ``threading.Condition`` built on a wrapped RLock keeps working (and
+    keeps the held-stack honest across ``wait()``)."""
+
+    __slots__ = ()
+
+    def _release_save(self):
+        _note_release(self, full=True)     # wait() drops all recursion levels
+        return self._inner._release_save()
+
+    def _acquire_restore(self, state):
+        self._inner._acquire_restore(state)
+        _note_acquire(self)
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+
+def wrap(lock=None, name: Optional[str] = None):
+    """Wrap one lock explicitly (tests, ad-hoc probes).
+
+    ``lock`` defaults to a fresh primitive lock; ``name`` defaults to the
+    caller's ``file:line`` site.
+    """
+    global _wrapped_count
+    if lock is None:
+        lock = (_orig_lock or _thread.allocate_lock)()
+    site = name or _caller_site(2)
+    with _graph_lock:
+        _wrapped_count += 1
+    if hasattr(lock, "_is_owned") or "RLock" in type(lock).__name__:
+        return _RLockProxy(lock, site)
+    return _LockProxy(lock, site)
+
+
+def _caller_site(depth: int) -> str:
+    frame = sys._getframe(depth)
+    path = frame.f_code.co_filename
+    rel = os.path.basename(os.path.dirname(path)) + "/" + os.path.basename(path)
+    return f"{rel}:{frame.f_lineno}"
+
+
+def _should_wrap(filename: str) -> bool:
+    if os.environ.get("RAY_TPU_LOCKWATCH_ALL"):
+        return True
+    norm = filename.replace(os.sep, "/")
+    return filename.startswith(_PKG_ROOT) or "/tests/" in norm
+
+
+def Lock():
+    """Factory installed over ``threading.Lock``."""
+    global _wrapped_count
+    inner = (_orig_lock or _thread.allocate_lock)()
+    frame = sys._getframe(1)
+    if not _should_wrap(frame.f_code.co_filename):
+        return inner
+    with _graph_lock:
+        _wrapped_count += 1
+    return _LockProxy(inner, _caller_site(2))
+
+
+def RLock():
+    """Factory installed over ``threading.RLock``."""
+    global _wrapped_count
+    inner = (_orig_rlock or threading._PyRLock)()  # type: ignore[attr-defined]
+    frame = sys._getframe(1)
+    if not _should_wrap(frame.f_code.co_filename):
+        return inner
+    with _graph_lock:
+        _wrapped_count += 1
+    return _RLockProxy(inner, _caller_site(2))
+
+
+def installed() -> bool:
+    return _orig_lock is not None
+
+
+def install() -> None:
+    """Patch ``threading.Lock``/``RLock`` with recording factories.
+
+    Locks created by callers outside the ray_tpu package and its tests
+    are returned unwrapped (stdlib and third-party internals keep their
+    raw primitives) unless ``RAY_TPU_LOCKWATCH_ALL`` is set.
+    """
+    global _orig_lock, _orig_rlock
+    if installed():
+        return
+    _orig_lock = threading.Lock
+    _orig_rlock = threading.RLock
+    threading.Lock = Lock
+    threading.RLock = RLock
+    atexit.register(_exit_report)
+
+
+def uninstall() -> None:
+    global _orig_lock, _orig_rlock
+    if not installed():
+        return
+    threading.Lock = _orig_lock
+    threading.RLock = _orig_rlock
+    _orig_lock = _orig_rlock = None
+    atexit.unregister(_exit_report)
+
+
+def reset() -> None:
+    """Clear all recorded observations (keeps installation state)."""
+    global _wrapped_count
+    with _graph_lock:
+        _edges.clear()
+        _edge_threads.clear()
+        _same_site_pairs.clear()
+        _same_site_of.clear()
+        _long_holds.clear()
+        _wrapped_count = 0
+
+
+def _sccs(nodes: List[str], succ: Dict[str, List[str]]) -> List[List[str]]:
+    """Iterative Tarjan strongly-connected components."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = itertools.count()
+
+    for root in nodes:
+        if root in index:
+            continue
+        work = [(root, iter(succ.get(root, ())))]
+        index[root] = low[root] = next(counter)
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = next(counter)
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(succ.get(nxt, ()))))
+                    advanced = True
+                    break
+                elif nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                out.append(comp)
+    return out
+
+
+def cycles() -> List[dict]:
+    """Cycles in the observed lock-order graph (potential deadlocks)."""
+    with _graph_lock:
+        edge_keys = list(_edges)
+        same_pairs = set(_same_site_pairs)
+        same_of = dict(_same_site_of)
+    succ: Dict[str, List[str]] = {}
+    nodes: Set[str] = set()
+    for a, b in edge_keys:
+        succ.setdefault(a, []).append(b)
+        nodes.update((a, b))
+    found: List[dict] = []
+    for comp in _sccs(sorted(nodes), succ):
+        if len(comp) > 1:
+            found.append({"kind": "site-order", "sites": sorted(comp)})
+    reported: Set[Tuple[int, int]] = set()
+    for a, b in same_pairs:
+        if (b, a) in same_pairs and (b, a) not in reported:
+            reported.add((a, b))
+            found.append({"kind": "same-site-inversion",
+                          "sites": [same_of[(a, b)]]})
+    return found
+
+
+def report() -> dict:
+    with _graph_lock:
+        edges = [{"from": a, "to": b, "count": n,
+                  "thread": _edge_threads.get((a, b), "")}
+                 for (a, b), n in sorted(_edges.items())]
+        holds = list(_long_holds)
+        wrapped = _wrapped_count
+    return {"wrapped_locks": wrapped, "edges": edges, "cycles": cycles(),
+            "long_holds": holds}
+
+
+def _exit_report() -> None:
+    rep = report()
+    n_cycles = len(rep["cycles"])
+    print(f"LOCKWATCH: {rep['wrapped_locks']} locks wrapped, "
+          f"{len(rep['edges'])} order edges, {n_cycles} cycles, "
+          f"{len(rep['long_holds'])} long holds", file=sys.stderr)
+    if n_cycles:
+        for cyc in rep["cycles"]:
+            print(f"LOCKWATCH CYCLE ({cyc['kind']}): "
+                  + " -> ".join(cyc["sites"]), file=sys.stderr)
+        for e in rep["edges"]:
+            print(f"LOCKWATCH edge: {e['from']} -> {e['to']} "
+                  f"x{e['count']} [{e['thread']}]", file=sys.stderr)
+    out = os.environ.get("RAY_TPU_LOCKWATCH_OUT")
+    if out:
+        try:
+            with open(out, "w", encoding="utf-8") as f:
+                json.dump(rep, f, indent=2)
+        except OSError as e:
+            print(f"LOCKWATCH: cannot write {out}: {e}", file=sys.stderr)

@@ -13,6 +13,7 @@ manager object.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import signal
 import subprocess
@@ -21,6 +22,8 @@ import time
 import uuid
 from dataclasses import asdict, dataclass, field
 from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger("ray_tpu")
 
 
 class JobStatus:
@@ -78,7 +81,9 @@ class JobManager:
                 if info.status not in JobStatus.TERMINAL:
                     info.status = JobStatus.FAILED
                 self._jobs[info.job_id] = info
-            except (json.JSONDecodeError, TypeError, OSError):
+            except (json.JSONDecodeError, TypeError, OSError) as e:
+                logger.warning("job manager: dropping unreadable job "
+                               "record %s: %s", name, e)
                 continue
 
     # -- API ----------------------------------------------------------------
@@ -139,8 +144,9 @@ class JobManager:
             self._persist(info)
         try:
             os.killpg(proc.pid, signal.SIGTERM)
-        except ProcessLookupError:
-            pass
+        except ProcessLookupError as e:
+            logger.info("job manager: job %s process group already gone "
+                        "during stop: %s", job_id, e)
         return True
 
     def get_job_status(self, job_id: str) -> str:
@@ -162,7 +168,9 @@ class JobManager:
         try:
             with open(info.log_path) as f:
                 return f.read()
-        except OSError:
+        except OSError as e:
+            logger.debug("job manager: no logs for %s at %s: %s",
+                         job_id, info.log_path, e)
             return ""
 
     def list_jobs(self) -> List[JobInfo]:
@@ -215,7 +223,9 @@ class JobSubmissionClient:
                     f.seek(pos)
                     chunk = f.read()
                     pos = f.tell()
-            except OSError:
+            except OSError as e:
+                logger.debug("job log tail: %s unreadable yet: %s",
+                             info.log_path, e)
                 chunk = ""
             if chunk:
                 yield chunk

@@ -79,7 +79,7 @@ class ObjectRef:
         if owner is not None:
             try:
                 owner.reference_counter.remove_local_ref(self._id)
-            except Exception:
+            except Exception:  # raylint: allow(swallow) interpreter teardown: owner runtime may be gone
                 pass
 
 

@@ -10,6 +10,7 @@ point-to-point traffic can cross slices (DCN) if needed.
 """
 
 from __future__ import annotations
+import logging
 
 import math
 from dataclasses import dataclass, field
@@ -18,6 +19,8 @@ from typing import List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 from jax.sharding import Mesh
+
+logger = logging.getLogger("ray_tpu")
 
 # Canonical axis order, outermost (slowest-varying, DCN-tolerant) first.
 AXIS_ORDER = ("pipe", "data", "fsdp", "expert", "seq", "tensor")
@@ -80,7 +83,8 @@ def build_mesh(config: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
             arr = mesh_utils.create_device_mesh(shape, devices=devices)
         else:
             arr = np.array(devices).reshape(shape)
-    except Exception:
+    except Exception as e:
+        logger.debug("mesh_utils failed; naive reshape fallback: %s", e)
         arr = np.array(devices).reshape(shape)
     return Mesh(arr, AXIS_ORDER)
 

@@ -16,6 +16,7 @@ group and allreduces its flattened gradient pytree every SGD iteration
 """
 
 from __future__ import annotations
+import logging
 
 from typing import Any, Dict, List, Optional
 
@@ -32,6 +33,8 @@ from ray_tpu.rl.policy import Policy
 from ray_tpu.rl.ppo import PPOConfig
 from ray_tpu.rl.rollout_worker import RolloutWorker
 from ray_tpu.rl.sample_batch import SampleBatch
+
+logger = logging.getLogger("ray_tpu")
 
 
 class DDPPOConfig(PPOConfig):
@@ -229,5 +232,5 @@ class DDPPO(Algorithm):
         for w in getattr(self, "_workers", []):
             try:
                 ray_tpu.kill(w)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("worker kill failed: %s", e)

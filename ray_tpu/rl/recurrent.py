@@ -124,7 +124,7 @@ def _branch_step(branch, config: Dict[str, Any], obs: jax.Array,
         q = feat @ ap["wq"]                        # [B, d]
         k = (frames + ap["pos"]) @ ap["wk"]        # [B, K, d]
         v = frames @ ap["wv"]
-        att = jnp.einsum("bd,bkd->bk", q, k) / jnp.sqrt(float(d))
+        att = jnp.einsum("bd,bkd->bk", q, k) / (d ** 0.5)
         att = att + (1.0 - valid) * -1e9           # mask empty slots
         att = jax.nn.softmax(att, axis=-1)
         out = jnp.tanh(feat + jnp.einsum("bk,bkd->bd", att, v))

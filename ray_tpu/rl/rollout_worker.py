@@ -8,6 +8,7 @@ worker's JAX-CPU context. The learner never sees an env.
 """
 
 from __future__ import annotations
+import logging
 
 from typing import Any, Callable, Dict, List, Optional
 
@@ -17,6 +18,8 @@ from ray_tpu.rl.env import VectorEnv, make_env
 from ray_tpu.rl.policy import Policy
 from ray_tpu.rl.postprocessing import compute_gae
 from ray_tpu.rl.sample_batch import SampleBatch, concat_samples
+
+logger = logging.getLogger("ray_tpu")
 
 
 class RolloutWorker:
@@ -318,8 +321,8 @@ class WorkerSet:
         for w in self.remote_workers:
             try:
                 ray_tpu.kill(w)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("worker kill failed: %s", e)
         self.remote_workers = []
 
 

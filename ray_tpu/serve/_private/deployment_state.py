@@ -67,8 +67,8 @@ class DeploymentState:
                 try:
                     ray_tpu.get(info.handle.reconfigure.remote(
                         config.user_config))
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.warning("in-place reconfigure failed: %s", e)
 
     def set_num_replicas(self, n: int) -> None:
         cfg = self.config.autoscaling_config
@@ -96,12 +96,12 @@ class DeploymentState:
         try:
             ray_tpu.get(info.handle.prepare_for_shutdown.remote(
                 self.config.graceful_shutdown_timeout_s), timeout=None)
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("graceful replica shutdown failed: %s", e)
         try:
             ray_tpu.kill(info.handle)
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("replica kill failed: %s", e)
 
     def _check_health(self) -> List[ReplicaInfo]:
         """Probe all replicas concurrently; returns the live ones.
@@ -116,7 +116,8 @@ class DeploymentState:
         for info in self.replicas:
             try:
                 probes.append((info, info.handle.check_health.remote()))
-            except Exception:
+            except Exception as e:
+                logger.debug("health probe submit failed: %s", e)
                 probes.append((info, None))
         refs = [r for _, r in probes if r is not None]
         if refs:
@@ -189,8 +190,8 @@ class DeploymentState:
             try:
                 m = ray_tpu.get(info.handle.get_metrics.remote(), timeout=5)
                 total += m["num_ongoing_requests"]
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("replica metrics fetch failed: %s", e)
         return total
 
     def status(self) -> dict:

@@ -28,6 +28,7 @@ response.
 """
 
 from __future__ import annotations
+import logging
 
 import json
 import threading
@@ -37,6 +38,8 @@ from typing import Dict, Optional
 from ray_tpu.serve._private.long_poll import LongPollClient
 from ray_tpu.serve.controller import ROUTE_TABLE_KEY
 from ray_tpu.serve.handle import DeploymentHandle
+
+logger = logging.getLogger("ray_tpu")
 
 
 class HTTPProxy:
@@ -179,8 +182,8 @@ class HTTPProxy:
         for handle in dropped:
             try:
                 handle.shutdown()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("handle shutdown failed: %s", e)
 
     def _match(self, path: str) -> Optional[str]:
         with self._lock:

@@ -11,6 +11,7 @@ so blocked listeners don't stall control-loop method calls).
 """
 
 from __future__ import annotations
+import logging
 
 import threading
 import time
@@ -55,6 +56,8 @@ class LongPollHost:
 
 import weakref
 
+logger = logging.getLogger("ray_tpu")
+
 _live_clients: "weakref.WeakSet" = weakref.WeakSet()
 
 
@@ -94,7 +97,8 @@ class LongPollClient:
                 ref = self._controller.listen_for_change.remote(
                     dict(self._snapshot_ids))
                 updates = self._ray.get(ref, timeout=60)
-            except Exception:
+            except Exception as e:
+                logger.debug("long poll failed; retrying: %s", e)
                 if self._stopped.is_set():
                     return
                 time.sleep(0.2)
@@ -103,8 +107,8 @@ class LongPollClient:
                 self._snapshot_ids[key] = snapshot_id
                 try:
                     self._listeners[key](obj)
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.warning("long-poll listener raised: %s", e)
 
     def stop(self) -> None:
         self._stopped.set()

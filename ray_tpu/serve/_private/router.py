@@ -7,6 +7,7 @@ Replica membership updates arrive via long-poll from the controller.
 """
 
 from __future__ import annotations
+import logging
 
 import threading
 from typing import Any, Dict, List, Optional
@@ -14,6 +15,8 @@ from typing import Any, Dict, List, Optional
 import ray_tpu
 from ray_tpu.serve._private.long_poll import LongPollClient
 from ray_tpu.serve.controller import _replica_key
+
+logger = logging.getLogger("ray_tpu")
 
 
 class Router:
@@ -94,8 +97,8 @@ class Router:
             info = ray_tpu.get(self._controller.get_replica_handles.remote(
                 self._deployment_name), timeout=10)
             self._apply(info)
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("membership refresh failed: %s", e)
 
     def shutdown(self) -> None:
         self._poller.stop()

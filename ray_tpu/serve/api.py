@@ -8,6 +8,7 @@ deploys it, ``serve.start`` brings up the controller and HTTP proxy.
 """
 
 from __future__ import annotations
+import logging
 
 import threading
 from typing import Any, Callable, Dict, List, Optional
@@ -16,6 +17,8 @@ import ray_tpu
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
 from ray_tpu.serve.handle import DeploymentHandle
+
+logger = logging.getLogger("ray_tpu")
 
 _client_lock = threading.Lock()
 _controller = None
@@ -32,7 +35,7 @@ def start(detached: bool = True, http_host: Optional[str] = "127.0.0.1",
                 ray_tpu.init()
             try:
                 _controller = ray_tpu.get_actor(CONTROLLER_NAME)
-            except Exception:
+            except Exception:  # raylint: allow(swallow) no controller yet: create one below
                 _controller = ray_tpu.remote(ServeController).options(
                     name=CONTROLLER_NAME, max_concurrency=64).remote()
                 # Wait until the controller is live.
@@ -234,6 +237,6 @@ def shutdown() -> None:
             try:
                 ray_tpu.get(_controller.graceful_shutdown.remote())
                 ray_tpu.kill(_controller)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("controller shutdown failed: %s", e)
             _controller = None

@@ -57,12 +57,12 @@ class DeploymentConfig:
         import pickle
         try:
             code = inspect.getsource(func_or_class)
-        except Exception:
+        except Exception:  # raylint: allow(swallow) source unavailable: fall back to qualname
             code = getattr(func_or_class, "__qualname__",
                            repr(func_or_class))
         try:
             payload = pickle.dumps(
                 (code, init_args, init_kwargs, self.ray_actor_options))
-        except Exception:
+        except Exception:  # raylint: allow(swallow) unpicklable config: fall back to repr
             payload = repr((code, init_args, init_kwargs)).encode()
         return hashlib.sha1(payload).hexdigest()[:12]

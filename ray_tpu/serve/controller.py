@@ -9,6 +9,7 @@ pushes routing tables to handles/proxies via long-poll
 """
 
 from __future__ import annotations
+import logging
 
 import threading
 import time
@@ -17,6 +18,8 @@ from typing import Any, Dict, Optional
 from ray_tpu.serve._private.deployment_state import DeploymentState
 from ray_tpu.serve._private.long_poll import LongPollHost
 from ray_tpu.serve.config import DeploymentConfig
+
+logger = logging.getLogger("ray_tpu")
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 ROUTE_TABLE_KEY = "route_table"
@@ -108,8 +111,8 @@ class ServeController:
         while not self._shutdown.is_set():
             try:
                 self._run_control_loop_once()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.warning("control loop iteration failed: %s", e)
             self._shutdown.wait(self._period)
 
     def _run_control_loop_once(self) -> None:

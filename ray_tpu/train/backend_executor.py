@@ -10,6 +10,7 @@ detection surfaced to the trainer for restart-from-checkpoint
 """
 
 from __future__ import annotations
+import logging
 
 import os
 import threading
@@ -19,6 +20,8 @@ import ray_tpu
 from ray_tpu import exceptions as exc
 from ray_tpu.util.placement_group import placement_group, remove_placement_group
 from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+logger = logging.getLogger("ray_tpu")
 
 _FINISHED = "__finished__"
 _GROUP_SEQ = 0
@@ -67,7 +70,8 @@ class RayTrainWorker:
                     per = len(devs) // workers_per_host
                     local = devs[local_rank * per:(local_rank + 1) * per]
                     mesh = build_mesh(MeshConfig(data=len(local)), local)
-        except Exception:
+        except Exception as e:
+            logger.debug("mesh detection failed; no local mesh: %s", e)
             mesh = None
         self.session = session_mod._init_session(
             world_rank=self.rank, world_size=self.world_size,
@@ -219,11 +223,11 @@ class BackendExecutor:
         for w in self.workers:
             try:
                 ray_tpu.kill(w)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("worker kill failed: %s", e)
         if self.pg is not None:
             try:
                 remove_placement_group(self.pg)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("placement group removal failed: %s", e)
         self.workers = []

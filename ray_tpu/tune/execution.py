@@ -10,6 +10,7 @@ persists experiment state for resume (``trial_runner.py:671,1240``).
 """
 
 from __future__ import annotations
+import logging
 
 import json
 import os
@@ -22,6 +23,8 @@ from ray_tpu.tune.schedulers import CONTINUE, PAUSE, STOP, FIFOScheduler
 from ray_tpu.tune.trainable import Trainable, wrap_function
 from ray_tpu.tune.trial import (ERROR, PAUSED, PENDING, RUNNING, TERMINATED,
                                 Trial)
+
+logger = logging.getLogger("ray_tpu")
 
 
 @ray_tpu.remote
@@ -122,7 +125,8 @@ class TrialRunner:
     def _derive_concurrency(self) -> int:
         try:
             avail = ray_tpu.cluster_resources()
-        except Exception:
+        except Exception as e:
+            logger.debug("cluster_resources unavailable; defaulting: %s", e)
             return 4
         cpus = avail.get("CPU", 4)
         per = self.resources_per_trial.get(
@@ -169,12 +173,12 @@ class TrialRunner:
                 if save:
                     trial.checkpoint = ray_tpu.get(trial._actor.save.remote())
                 ray_tpu.get(trial._actor.stop.remote())
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("trial save/stop failed: %s", e)
             try:
                 ray_tpu.kill(trial._actor)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("trial actor kill failed: %s", e)
         trial._actor = None
         trial._future = None
         trial.status = status
@@ -202,7 +206,8 @@ class TrialRunner:
         reset_ok = False
         try:
             reset_ok = ray_tpu.get(trial._actor.reset.remote(new_config))
-        except Exception:
+        except Exception as e:
+            logger.debug("trial reset failed; will restart: %s", e)
             reset_ok = False
         if not reset_ok:
             self._stop_trial(trial, status=PAUSED)

@@ -7,6 +7,7 @@ Parity with ``python/ray/tune/trainable/trainable.py`` (class API:
 """
 
 from __future__ import annotations
+import logging
 
 import os
 import queue
@@ -16,6 +17,8 @@ import uuid
 from typing import Any, Callable, Dict, Optional
 
 from ray_tpu.tune import session as tune_session
+
+logger = logging.getLogger("ray_tpu")
 
 RESULT_DONE = "done"
 TRAINING_ITERATION = "training_iteration"
@@ -111,7 +114,6 @@ class FunctionTrainable(Trainable):
     def setup(self, config: Dict[str, Any]):
         self._results: "queue.Queue" = queue.Queue()
         self._continue: "queue.Queue" = queue.Queue()
-        self._error: Optional[BaseException] = None
         self._finished = False
         self._last_metrics: Dict[str, Any] = {}
         self._last_checkpoint: Optional[Dict[str, Any]] = None
@@ -123,7 +125,7 @@ class FunctionTrainable(Trainable):
         try:
             self._fn(self.config)
         except BaseException as e:  # noqa: BLE001 - propagated to driver
-            self._error = e
+            self._results.put(e)
         finally:
             tune_session._shutdown_session()
             self._results.put(None)  # sentinel: function returned
@@ -146,10 +148,12 @@ class FunctionTrainable(Trainable):
             self._thread = threading.Thread(target=self._runner, daemon=True)
             self._thread.start()
         item = self._results.get()
+        if isinstance(item, BaseException):
+            self._finished = True
+            self._results.get()  # drain the completion sentinel
+            raise item
         if item is None:
             self._finished = True
-            if self._error is not None:
-                raise self._error
             # final result: the last reported metrics, marked done
             # (reference function_trainable.py final-result semantics)
             final = dict(self._last_metrics)
@@ -171,8 +175,8 @@ class FunctionTrainable(Trainable):
             # let the fn thread run to completion on next report
             try:
                 self._continue.put_nowait(True)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("continue signal failed: %s", e)
 
 
 def wrap_function(fn: Callable) -> type:

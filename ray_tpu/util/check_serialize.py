@@ -31,7 +31,7 @@ def _serializable(obj: Any) -> bool:
     try:
         cloudpickle.dumps(obj)
         return True
-    except Exception:
+    except Exception:  # raylint: allow(swallow) the whole point is try-pickle
         return False
 
 

@@ -76,7 +76,7 @@ class ClientServer:
                                     "error": RuntimeError(
                                         "response serialization failed: "
                                         f"{type(e).__name__}: {e}")})
-                        except BaseException:
+                        except BaseException:  # raylint: allow(swallow) socket dead: no channel left to report on
                             pass
 
                 try:

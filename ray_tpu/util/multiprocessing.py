@@ -12,6 +12,7 @@ their docstrings.
 """
 
 from __future__ import annotations
+import logging
 
 import itertools
 import threading
@@ -21,6 +22,8 @@ from multiprocessing import TimeoutError
 from typing import Any, Callable, Iterable, Iterator, List, Optional
 
 import ray_tpu
+
+logger = logging.getLogger("ray_tpu")
 
 __all__ = ["Pool", "AsyncResult", "TimeoutError"]
 
@@ -257,8 +260,8 @@ class Pool:
         for ref in self._outstanding:
             try:
                 ray_tpu.cancel(ref)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("cancel of outstanding chunk failed: %s", e)
         self._outstanding.clear()
 
     def join(self) -> None:

@@ -14,6 +14,7 @@ of failure entirely.)
 """
 
 from __future__ import annotations
+import logging
 
 import collections
 import threading
@@ -24,6 +25,8 @@ from queue import Empty, Full
 from typing import Any, List, Optional
 
 import ray_tpu
+
+logger = logging.getLogger("ray_tpu")
 
 _POLL_S = 0.005
 
@@ -170,7 +173,7 @@ class Queue:
             if not force:
                 try:
                     ray_tpu.get(self.actor.qsize.remote())
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("queue drain probe failed: %s", e)
             ray_tpu.kill(self.actor)
         self.actor = None

@@ -55,7 +55,7 @@ def run_async(dag, *, workflow_id: Optional[str] = None) -> str:
         # unbounded state behind. The run itself persists SUCCESS/FAILED.
         try:
             run(dag, workflow_id=workflow_id)
-        except BaseException:
+        except BaseException:  # raylint: allow(swallow) executor already persisted FAILED in storage
             pass  # recorded in storage as FAILED by the executor
         finally:
             _async_runs.pop(workflow_id, None)

@@ -9,6 +9,11 @@
 # transparent. The object-store .so loads into the Python interpreter, so
 # its sanitizer runtime must be LD_PRELOADed; leak checking is disabled
 # there (CPython itself "leaks" by ASAN's definition).
+#
+# The static (no-execution) counterpart of this gate is
+# ./run_static_analysis.sh: raylint over the Python tree, the lockwatch
+# deadlock watchdog over tier-1, and gcc -fanalyzer over the same native
+# translation units sanitized here.
 set -euo pipefail
 cd "$(dirname "$0")"
 

@@ -1,0 +1,323 @@
+"""Tests for ray_tpu.devtools: the raylint engine (R1-R6) and lockwatch.
+
+Each rule gets one fixture that must fire and one that must stay quiet;
+lockwatch gets a real two-thread A->B / B->A inversion; R6 gets a drift
+test that mutates a wire field number in a copy of raytpu.proto.
+"""
+
+import os
+import re
+import textwrap
+import threading
+
+import pytest
+
+from ray_tpu.devtools import lockwatch
+from ray_tpu.devtools.linter import (LintEngine, parse_proto_text)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROTO = os.path.join(REPO, "ray_tpu", "protocol", "raytpu.proto")
+PB2 = os.path.join(REPO, "ray_tpu", "protocol", "raytpu_pb2.py")
+
+
+def run_rule(tmp_path, rule_id, source, name="fixture.py"):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(source))
+    eng = LintEngine([str(path)], only_rules={rule_id})
+    findings = eng.run()
+    assert not eng.errors, eng.errors
+    return findings
+
+
+# -- R1: blocking calls in async def ----------------------------------------
+
+def test_r1_fires_on_blocking_sleep_in_async(tmp_path):
+    findings = run_rule(tmp_path, "R1", """\
+        import time
+
+        async def handler():
+            time.sleep(0.5)
+    """)
+    assert [f.rule for f in findings] == ["R1"]
+    assert "time.sleep" in findings[0].message
+
+
+def test_r1_quiet_on_awaited_sleep_and_sync_code(tmp_path):
+    findings = run_rule(tmp_path, "R1", """\
+        import asyncio
+        import time
+
+        async def handler():
+            await asyncio.sleep(0.5)
+
+        def plain():
+            time.sleep(0.5)  # fine: not on the event loop
+
+        async def bounded(fut, lock):
+            fut.result(timeout=1.0)
+            lock.acquire(timeout=1.0)
+    """)
+    assert findings == []
+
+
+# -- R2: inconsistent lock-acquisition order ---------------------------------
+
+def test_r2_fires_on_inverted_nested_with(tmp_path):
+    findings = run_rule(tmp_path, "R2", """\
+        import threading
+
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def forward():
+            with lock_a:
+                with lock_b:
+                    pass
+
+        def backward():
+            with lock_b:
+                with lock_a:
+                    pass
+    """)
+    assert findings and all(f.rule == "R2" for f in findings)
+
+
+def test_r2_quiet_on_consistent_order(tmp_path):
+    findings = run_rule(tmp_path, "R2", """\
+        import threading
+
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def forward():
+            with lock_a:
+                with lock_b:
+                    pass
+
+        def also_forward():
+            with lock_a:
+                with lock_b:
+                    pass
+    """)
+    assert findings == []
+
+
+# -- R3: unguarded cross-thread shared state ---------------------------------
+
+def test_r3_fires_on_two_sided_unguarded_write(tmp_path):
+    findings = run_rule(tmp_path, "R3", """\
+        import threading
+
+        class Worker:
+            def start(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+
+            def _run(self):
+                self._status = "running"
+
+            def cancel(self):
+                self._status = "cancelled"
+    """)
+    assert findings and all(f.rule == "R3" for f in findings)
+    assert any("_status" in f.message for f in findings)
+
+
+def test_r3_quiet_when_both_writers_hold_the_lock(tmp_path):
+    findings = run_rule(tmp_path, "R3", """\
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._status = "new"
+
+            def start(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+
+            def _run(self):
+                with self._lock:
+                    self._status = "running"
+
+            def cancel(self):
+                with self._lock:
+                    self._status = "cancelled"
+    """)
+    assert findings == []
+
+
+# -- R4: silent exception swallows -------------------------------------------
+
+def test_r4_fires_on_silent_pass(tmp_path):
+    findings = run_rule(tmp_path, "R4", """\
+        def fragile():
+            try:
+                risky()
+            except Exception:
+                pass
+    """)
+    assert [f.rule for f in findings] == ["R4"]
+
+
+def test_r4_quiet_on_logged_justified_or_narrow(tmp_path):
+    findings = run_rule(tmp_path, "R4", """\
+        import logging
+
+        logger = logging.getLogger("ray_tpu")
+
+        def logged():
+            try:
+                risky()
+            except Exception as e:
+                logger.warning("risky failed: %s", e)
+
+        def justified():
+            try:
+                risky()
+            except Exception:  # raylint: allow(swallow) fixture says why
+                pass
+
+        def narrow():
+            try:
+                risky()
+            except KeyError:
+                pass
+    """)
+    assert findings == []
+
+
+# -- R5: host-device syncs reachable from jitted code -------------------------
+
+def test_r5_fires_on_float_in_jitted_fn(tmp_path):
+    findings = run_rule(tmp_path, "R5", """\
+        import jax
+
+        def helper(x):
+            return float(x)
+
+        @jax.jit
+        def step(x):
+            return helper(x) + x.item()
+    """)
+    assert findings and all(f.rule == "R5" for f in findings)
+    lines = sorted(f.line for f in findings)
+    assert len(lines) == 2  # float() in helper AND .item() in step
+
+
+def test_r5_quiet_without_jitted_root(tmp_path):
+    findings = run_rule(tmp_path, "R5", """\
+        def metrics(x):
+            return float(x)  # host-side code may sync freely
+    """)
+    assert findings == []
+
+
+# -- R6: proto <-> pb2 wire-schema drift --------------------------------------
+
+def test_r6_quiet_on_committed_pair(tmp_path):
+    eng = LintEngine([], only_rules={"R6"},
+                     proto_pairs=[(PROTO, PB2, "protocol/raytpu_pb2.py")])
+    assert eng.run() == []
+
+
+def test_r6_fires_when_field_number_mutated(tmp_path):
+    src = open(PROTO, encoding="utf-8").read()
+    # bump the first scalar field number in the file to a fresh value
+    mutated, n = re.subn(r"(=\s*)(\d+)(\s*;)", r"\g<1>9999\g<3>", src, count=1)
+    assert n == 1
+    bad = tmp_path / "raytpu.proto"
+    bad.write_text(mutated)
+    eng = LintEngine([], only_rules={"R6"},
+                     proto_pairs=[(str(bad), PB2, "protocol/raytpu_pb2.py")])
+    findings = eng.run()
+    assert findings and all(f.rule == "R6" for f in findings)
+    assert any("9999" in f.message or "drifted" in f.message
+               for f in findings)
+
+
+def test_proto_parser_sees_real_schema():
+    schema = parse_proto_text(open(PROTO, encoding="utf-8").read())
+    assert "TaskSpecMsg" in schema
+    assert any(schema.values())
+
+
+# -- lockwatch ----------------------------------------------------------------
+
+def test_lockwatch_detects_ab_ba_cycle_across_threads():
+    lockwatch.reset()
+    a = lockwatch.wrap(name="fixture:lock_a")
+    b = lockwatch.wrap(name="fixture:lock_b")
+    first_done = threading.Event()
+
+    def t1():
+        with a:
+            with b:
+                pass
+        first_done.set()
+
+    def t2():
+        first_done.wait(timeout=10)
+        with b:
+            with a:
+                pass
+
+    threads = [threading.Thread(target=t1), threading.Thread(target=t2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    try:
+        cys = lockwatch.cycles()
+        assert any(c["kind"] == "site-order" and
+                   set(c["sites"]) == {"fixture:lock_a", "fixture:lock_b"}
+                   for c in cys), cys
+        rep = lockwatch.report()
+        assert rep["cycles"]
+    finally:
+        lockwatch.reset()
+
+
+def test_lockwatch_quiet_on_consistent_order():
+    lockwatch.reset()
+    a = lockwatch.wrap(name="fixture:ordered_a")
+    b = lockwatch.wrap(name="fixture:ordered_b")
+
+    def use():
+        with a:
+            with b:
+                pass
+
+    threads = [threading.Thread(target=use) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    try:
+        assert lockwatch.cycles() == []
+    finally:
+        lockwatch.reset()
+
+
+def test_lockwatch_reports_long_hold(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_LOCKWATCH_HOLD_S", "0.01")
+    lockwatch.reset()
+    lk = lockwatch.wrap(name="fixture:slow_lock")
+    import time as _time
+    with lk:
+        _time.sleep(0.05)
+    try:
+        holds = lockwatch.report()["long_holds"]
+        assert any(h["site"] == "fixture:slow_lock" for h in holds), holds
+    finally:
+        lockwatch.reset()
+
+
+def test_cli_exits_zero_on_clean_tree(tmp_path):
+    clean = tmp_path / "clean.py"
+    clean.write_text("def ok():\n    return 1\n")
+    from ray_tpu.devtools.linter import main
+    assert main([str(clean)]) == 0
+    bad = tmp_path / "bad.py"
+    bad.write_text("try:\n    pass\nexcept Exception:\n    pass\n")
+    assert main([str(bad), "--json"]) == 1
