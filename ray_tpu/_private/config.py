@@ -110,6 +110,31 @@ _config.define("heartbeat_interval_ms", int, 100, "node heartbeat period")
 _config.define("num_heartbeats_timeout", int, 30, "missed heartbeats before a node is dead")
 _config.define("health_check_period_ms", int, 1000, "actor health check period")
 
+# -- RPC / retry policy ---------------------------------------------------------
+# The shared backoff policy (_private/backoff.py): exponential backoff with
+# full jitter, bounded by an overall deadline budget. Every retry loop in the
+# runtime resolves its pacing from these four knobs unless it overrides them.
+_config.define("rpc_connect_timeout_s", float, 10.0,
+               "TCP connect timeout for RpcClient dials")
+_config.define("rpc_call_deadline_s", float, 0.0,
+               "default per-call reply deadline when call() passes no "
+               "timeout; 0 disables (task-push replies legitimately take "
+               "as long as the task runs)")
+_config.define("backoff_base_ms", int, 50, "first retry delay upper bound")
+_config.define("backoff_max_ms", int, 5000, "retry delay cap")
+_config.define("backoff_multiplier", float, 2.0, "delay growth per attempt")
+_config.define("backoff_deadline_s", float, 30.0,
+               "default overall retry budget; retries stop when spent")
+_config.define("state_reconnect_deadline_s", float, 15.0,
+               "StateClient redial budget across a state-service restart")
+_config.define("task_retry_max_delay_ms", int, 2000,
+               "cap on the jittered exponential resubmission delay "
+               "(base is task_retry_delay_ms)")
+_config.define("circuit_failure_threshold", int, 3,
+               "consecutive failures before a peer's circuit breaker opens")
+_config.define("circuit_reset_s", float, 5.0,
+               "open-breaker hold time before the half-open probe")
+
 _config.define("daemon_admission_queue_limit", int, 1000,
                "pending tasks a daemon accepts before spilling back "
                "(backpressure: one daemon must not absorb the cluster)")

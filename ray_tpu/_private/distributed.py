@@ -55,7 +55,9 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import cloudpickle
 
+from ray_tpu import chaos
 from ray_tpu import exceptions as exc
+from ray_tpu._private.backoff import BackoffPolicy, BreakerBoard
 from ray_tpu._private.config import _config
 from ray_tpu._private.ids import (ActorID, JobID, NodeID, ObjectID,
                                   PlacementGroupID, TaskID)
@@ -69,6 +71,7 @@ from ray_tpu._private.scheduler import Infeasible, NodeState
 from ray_tpu._private.state_client import StateClient
 from ray_tpu._private.task_spec import TaskOptions, TaskSpec
 from ray_tpu.protocol import pb
+from ray_tpu.util import metrics as _metrics
 
 logger = logging.getLogger("ray_tpu")
 
@@ -228,6 +231,28 @@ class DistributedRuntime(Runtime):
         # settles their fate (the submitter-side analogue of the lease
         # policy avoiding known-bad raylets).
         self._suspect_addrs: Dict[str, float] = {}
+        # Per-peer circuit breakers: after circuit_failure_threshold
+        # consecutive transport failures a peer's breaker OPENs, optional
+        # traffic (object pushes) to it is shed immediately instead of
+        # timing out, and the address is marked suspect for scheduling
+        # until a half-open probe succeeds.
+        self.breakers = BreakerBoard(on_open=self._on_breaker_open)
+        # Control-plane health, dashboard-visible (not just log warnings).
+        self.heartbeat_misses = 0          # consecutive failed beats
+        self.heartbeat_last_success = 0.0  # epoch seconds of last ack
+        node_tag = self.local_node.node_id.hex()[:8]
+        self._hb_miss_gauge = _metrics.Gauge(
+            "heartbeat_consecutive_misses",
+            "consecutive failed heartbeats to the state service",
+            tag_keys=("node",)).set_default_tags({"node": node_tag})
+        self._hb_success_gauge = _metrics.Gauge(
+            "heartbeat_last_success_timestamp",
+            "epoch seconds of the last acknowledged heartbeat",
+            tag_keys=("node",)).set_default_tags({"node": node_tag})
+        self._breaker_gauge = _metrics.Gauge(
+            "peer_breaker_state",
+            "per-peer circuit breaker state (0=closed 1=half-open 2=open)",
+            tag_keys=("peer",))
 
         # Register with the state service.
         info = pb.NodeInfo(node_id=self.local_node.node_id.binary(),
@@ -495,8 +520,18 @@ class DistributedRuntime(Runtime):
     # ------------------------------------------------------------- lifecycle
 
     def _heartbeat_loop(self):
+        # Misses push the NEXT beat out by a jittered backoff on top of the
+        # interval: a down state service is probed gently instead of being
+        # hammered at full heartbeat rate by every node at once.
+        miss_policy = BackoffPolicy(base_s=self._hb_interval,
+                                    max_s=max(4 * self._hb_interval, 5.0),
+                                    deadline_s=0)
+        node_tag = self.local_node.node_id.hex()[:8]
         while not self._hb_stop.wait(self._hb_interval):
             try:
+                if chaos.ENABLED and chaos.inject(
+                        "state.heartbeat", node=node_tag) == "drop":
+                    raise RpcConnectionError("chaos: heartbeat dropped")
                 # Explicit zeros for exhausted resources: ResourceSet
                 # arithmetic drops zero entries, and an empty availability
                 # map reads as "no update" at the state service — a fully
@@ -524,11 +559,23 @@ class DistributedRuntime(Runtime):
                         except Exception as e:
                             logger.debug("location re-publish failed: %s", e)
                             break
+                self.heartbeat_misses = 0
+                self.heartbeat_last_success = time.time()
+                self._hb_miss_gauge.set(0)
+                self._hb_success_gauge.set(self.heartbeat_last_success)
             except Exception:
                 if self._hb_stop.is_set():
                     return
-                logger.warning("heartbeat to state service failed",
+                self.heartbeat_misses += 1
+                self._hb_miss_gauge.set(self.heartbeat_misses)
+                logger.warning("heartbeat to state service failed "
+                               "(%d consecutive)", self.heartbeat_misses,
                                exc_info=True)
+                extra = miss_policy.delay_for(self.heartbeat_misses - 1)
+                if extra > 0 and self._hb_stop.wait(extra):
+                    return
+            for peer, code in self.breakers.snapshot().items():
+                self._breaker_gauge.set(code, tags={"peer": peer})
 
     def _view_loop(self):
         while not self._hb_stop.wait(self._view_refresh):
@@ -751,19 +798,25 @@ class DistributedRuntime(Runtime):
         REMOVE_BORROW would pin the object at the owner forever (borrows
         gate _on_zero), a dropped ADD_BORROW lets the owner free an object
         we hold — neither may be lost to a transient failure. Gives up only
-        when the peer is (presumed) dead: node-death cleanup reclaims the
-        state on both sides then."""
-        for pause in (0.0, 0.2, 0.5, 1.0, 2.0):
-            if pause:
-                time.sleep(pause)
+        when the peer is (presumed) dead or the backoff budget is spent:
+        node-death cleanup reclaims the state on both sides then."""
+        policy = BackoffPolicy(base_s=0.2, max_s=2.0, deadline_s=5.0,
+                               attempt_timeout_s=10.0)
+        state = policy.start()
+        while True:
             if self._hb_stop.is_set() or self._peer_presumed_dead(peer):
                 return False
             try:
-                self.pool.get(peer).call(method, body, timeout=10)
+                self.pool.get(peer).call(method, body,
+                                         timeout=state.attempt_timeout())
+                self.breakers.record_success(peer)
                 return True
-            except Exception:
+            except Exception as e:
                 logger.debug("borrow %s for %s to %s failed", kind, oid,
                              peer, exc_info=True)
+                self.breakers.record_failure(peer)
+                if not policy.classify(e) or not state.sleep():
+                    break
         logger.warning("borrow %s for %s to live peer %s kept failing",
                        kind, oid, peer)
         return False
@@ -926,10 +979,18 @@ class DistributedRuntime(Runtime):
                     addrs.append(a)
         except Exception as e:
             logger.debug("get_locations failed: %s", e)
+        if len(addrs) > 1:
+            # Deprioritize (never skip: correctness first) sources whose
+            # circuit breaker is open — a healthy replica answers without
+            # paying a dead host's connect timeout first.
+            addrs.sort(key=lambda a: self.breakers.get(a).state_code() == 2)
         for addr in addrs:
             try:
                 value, err = self._fetch_from(addr, oid)
-            except (RpcConnectionError, RpcRemoteError, TimeoutError):
+                self.breakers.record_success(addr)
+            except (RpcConnectionError, RpcRemoteError, TimeoutError) as e:
+                if not isinstance(e, RpcRemoteError):
+                    self.breakers.record_failure(addr)
                 continue
             if err is not None:
                 raise err
@@ -957,6 +1018,13 @@ class DistributedRuntime(Runtime):
         ``object_manager.cc`` pull chunking) — sequential
         request-per-chunk pays a full round trip of dead air per 8 MB.
         Returns (value | _FETCH_MISS, error_or_none)."""
+        if chaos.ENABLED:
+            try:
+                if chaos.inject("object.fetch", peer=addr,
+                                object=oid.hex()[:8]) == "drop":
+                    return _FETCH_MISS, None  # "source didn't have it"
+            except chaos.ChaosConnectionReset as e:
+                raise RpcConnectionError(str(e)) from e
         client = self.pool.get(addr)
         arena_key = self.host_arena_key
         first_box: Dict[str, bytearray] = {}
@@ -1580,6 +1648,7 @@ class DistributedRuntime(Runtime):
         spilled = False
         try:
             self._suspect_addrs.pop(addr, None)  # proven alive
+            self.breakers.record_success(addr)
             rep = pb.PushTaskReply()
             rep.ParseFromString(env.body)
             if rep.status == "spillback":
@@ -1656,6 +1725,7 @@ class DistributedRuntime(Runtime):
         # alive until then).
         with self._view_lock:
             self._suspect_addrs[addr] = time.monotonic() + 10.0
+        self.breakers.record_failure(addr)
         with self.lock:
             if self._task_finalized(spec.task_id) or spec.attempt != attempt:
                 # Superseded: our executor may still have deserialized the
@@ -1678,8 +1748,10 @@ class DistributedRuntime(Runtime):
                     f"node hosting actor died ({addr})"))
             if spec.should_retry(cause) and not cancel.is_set():
                 spec.attempt += 1
-                self.offload(lambda: self.submit_actor_task(
-                    spec.actor_id, spec))
+                self._after_backoff(
+                    spec.attempt - 1,
+                    lambda: self.offload(lambda: self.submit_actor_task(
+                        spec.actor_id, spec)))
                 return
             died = exc.ActorDiedError(
                 f"actor call {spec.function_name} lost: {cause}")
@@ -1694,9 +1766,12 @@ class DistributedRuntime(Runtime):
             spec.attempt += 1
             self.emit_event("TASK_RETRY", task=spec.function_name,
                             attempt=spec.attempt, reason="node_died")
-            with self._pending_cv:
-                self._pending.append({"spec": spec, "cancel": cancel})
-                self._pending_cv.notify_all()
+
+            def _enqueue():
+                with self._pending_cv:
+                    self._pending.append({"spec": spec, "cancel": cancel})
+                    self._pending_cv.notify_all()
+            self._after_backoff(spec.attempt - 1, _enqueue)
             return
         for rid in spec.return_ids:
             self.seal_error(rid, cause, self.local_node)
@@ -1705,8 +1780,30 @@ class DistributedRuntime(Runtime):
         self._unpin_args(spec)
         self._fire_completion(spec)
 
+    def _after_backoff(self, attempt: int, fn: Callable[[], None]):
+        """Run ``fn`` after the shared resubmission backoff for retry
+        number ``attempt`` (jittered exponential; immediate when zero).
+        Timer-per-retry is fine here: node-death resubmissions are rare."""
+        delay = self._retry_backoff.delay_for(attempt)
+        if delay <= 0:
+            fn()
+            return
+        t = threading.Timer(delay, fn)
+        t.daemon = True
+        t.start()
+
+    def _on_breaker_open(self, addr: str):
+        """A peer's circuit breaker just OPENed (consecutive transport
+        failures): shed scheduling traffic to it until the half-open probe
+        succeeds — the existing suspect-address exclusion is the mechanism."""
+        logger.warning("circuit breaker OPEN for peer %s", addr)
+        with self._view_lock:
+            self._suspect_addrs[addr] = (time.monotonic()
+                                         + _config.get("circuit_reset_s"))
+
     def _on_peer_conn_close(self, addr: str, error: Exception):
         # call_async callbacks fire individually; nothing global needed here.
+        self.breakers.record_failure(addr)
         logger.debug("peer connection to %s closed: %s", addr, error)
 
     def _fail_inflight_to(self, addr: str, reason: str):
@@ -2970,6 +3067,13 @@ class _PushManager:
         self.pushes_initiated = 0  # monotone; observable in tests/metrics
 
     def maybe_push(self, addr: str, oid: ObjectID, threshold: int):
+        # Pushes are optional: shed them outright while the peer's circuit
+        # breaker is open instead of tying up a push worker on timeouts
+        # (the pull path stays authoritative if the peer is actually fine).
+        # Passive state check, NOT allow(): a push must never claim the
+        # half-open probe slot — task pushes are the probe traffic.
+        if self.rt.breakers.get(addr).state_code() == 2:
+            return
         with self._cv:
             if self._closed or (addr, oid) in self._active:
                 return
@@ -2985,6 +3089,10 @@ class _PushManager:
             client = self.rt.pool.get(addr)
             offset = 0
             while offset < len(payload) or offset == 0:
+                if chaos.ENABLED and chaos.inject(
+                        "object.push", peer=addr,
+                        object=oid.hex()[:8]) == "drop":
+                    return  # abandon the push; pull path authoritative
                 chunk = bytes(payload[offset:offset + FETCH_CHUNK])
                 eof = offset + len(chunk) >= len(payload)
                 with self._cv:
@@ -3013,10 +3121,12 @@ class _PushManager:
                     return  # receiver already has it
                 offset += len(chunk)
                 if eof:
+                    self.rt.breakers.record_success(addr)
                     return
         except Exception as e:
             logger.debug("object push failed; pull path authoritative: %s", e)
-            pass  # pull path remains authoritative
+            if isinstance(e, (ConnectionError, TimeoutError, OSError)):
+                self.rt.breakers.record_failure(addr)
         finally:
             with self._cv:
                 self._active.discard((addr, oid))

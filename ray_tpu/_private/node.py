@@ -155,7 +155,7 @@ class NodeSupervisor:
                     "head" if self.head else "worker", self.state_addr,
                     self.run_dir)
         while not self._stop:
-            time.sleep(0.25)
+            time.sleep(0.25)  # raylint: allow(bare-retry) liveness poll cadence; restarts pace via RESTART_BACKOFF_S
             now = time.monotonic()
             for name, proc, restart in (
                     ("state", self.state_proc,
@@ -172,7 +172,7 @@ class NodeSupervisor:
                     name, proc.returncode, backoff, restarts[name] + 1)
                 deadline = time.monotonic() + backoff
                 while time.monotonic() < deadline and not self._stop:
-                    time.sleep(0.1)
+                    time.sleep(0.1)  # raylint: allow(bare-retry) interruptible slice of the RESTART_BACKOFF_S wait
                 if self._stop:
                     break
                 try:

@@ -32,6 +32,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import cloudpickle
 
+from ray_tpu import chaos
 from ray_tpu._private.config import _config
 from ray_tpu._private.ids import ObjectID
 
@@ -232,6 +233,11 @@ class ObjectStore:
 
     def get(self, object_id: ObjectID, timeout: Optional[float] = None) -> Any:
         """Blocking fetch. Raises the stored exception for error objects."""
+        if chaos.ENABLED and chaos.inject(
+                "object.store.get", object=object_id.hex()[:8]) == "drop":
+            # simulate local loss (eviction race): callers fall back to
+            # remote fetch / lineage reconstruction
+            raise ObjectLostError(f"{object_id} dropped by chaos schedule")
         with self._lock:
             entry = self._entries.get(object_id)
         if entry is None:

@@ -23,6 +23,8 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, Optional, Tuple
 
+from ray_tpu import chaos
+from ray_tpu._private.config import _config
 from ray_tpu.protocol import pb
 
 logger = logging.getLogger("ray_tpu")
@@ -45,6 +47,11 @@ def default_auth_token() -> Optional[bytes]:
 
 class RpcConnectionError(ConnectionError):
     pass
+
+
+def _method_name(method: int) -> str:
+    return (pb.Method.Name(method) if method in pb.Method.values()
+            else str(method))
 
 
 class RpcRemoteError(RuntimeError):
@@ -128,13 +135,17 @@ class _Pending:
 class RpcClient:
     """One outgoing connection; thread-safe calls multiplexed by seq."""
 
-    def __init__(self, address: str, connect_timeout: float = 10.0,
+    def __init__(self, address: str, connect_timeout: Optional[float] = None,
                  on_push: Optional[Callable[[pb.Envelope], None]] = None,
                  on_close: Optional[Callable[[Exception], None]] = None,
                  auth_token: Optional[bytes] = None):
         host, port = address.rsplit(":", 1)
         self.address = address
+        if connect_timeout is None:
+            connect_timeout = _config.get("rpc_connect_timeout_s")
         try:
+            if chaos.ENABLED:
+                chaos.inject("rpc.client.connect", peer=address)
             self._sock = socket.create_connection((host, int(port)),
                                                   timeout=connect_timeout)
         except OSError as e:
@@ -181,6 +192,13 @@ class RpcClient:
         own reference to the buffer the sink handed out). ``raw``:
         bulk-lane payload to ship WITH the request (gather-write, no
         protobuf copy)."""
+        if timeout is None:
+            # rpc_call_deadline_s=0 (the default) keeps unbounded waits:
+            # task-push replies land at task completion, which can be
+            # arbitrarily far out.
+            default = _config.get("rpc_call_deadline_s")
+            if default > 0:
+                timeout = default
         pending = _Pending()
         pending.raw_sink = raw_sink
         with self._plock:
@@ -253,6 +271,10 @@ class RpcClient:
 
     def fail_pending(self, seqs, error: Exception) -> None:
         """Settle reserved seqs whose batch never reached the wire."""
+        if (isinstance(error, RpcConnectionError)
+                and self.address not in str(error)):
+            error = RpcConnectionError(
+                f"connection to {self.address}: {error}")
         for seq in seqs:
             with self._plock:
                 pending = self._pending.pop(seq, None)
@@ -272,16 +294,38 @@ class RpcClient:
     # -- internals ------------------------------------------------------------
 
     def _send(self, env: pb.Envelope, raw=None):
+        if chaos.ENABLED:
+            try:
+                act = chaos.inject("rpc.client.send", peer=self.address,
+                                   method=_method_name(env.method))
+            except chaos.ChaosConnectionReset as e:
+                # A real peer reset kills the whole connection, not one
+                # frame — tear down so pending calls fail like the wire did.
+                self._shutdown(e)
+                raise RpcConnectionError(
+                    f"send to {self.address} failed: {e}") from e
+            if act == "drop":
+                return  # frame "lost on the wire"; the caller times out
         with self._wlock:
             try:
                 send_frame(self._sock, env, raw=raw)
             except OSError as e:
-                raise RpcConnectionError(str(e)) from e
+                raise RpcConnectionError(
+                    f"send to {self.address} failed: {e}") from e
 
     def _read_loop(self):
         try:
             while True:
                 env = read_frame(self._sock)
+                if chaos.ENABLED:
+                    # reset raises -> caught below -> _shutdown, exactly a
+                    # mid-stream peer reset; drop discards the frame (after
+                    # draining its bulk lane to keep framing intact).
+                    if chaos.inject("rpc.client.recv",
+                                    peer=self.address) == "drop":
+                        if env.raw_len:
+                            _read_exact(self._sock, env.raw_len)
+                        continue
                 raw_pending = None
                 if env.raw_len:
                     if env.raw_len > MAX_FRAME:
@@ -339,11 +383,13 @@ class RpcClient:
             self._sock.close()
         except OSError:
             pass
+        err = RpcConnectionError(
+            f"connection to {self.address} lost: {exc}")
         for p in pending.values():
             cb = getattr(p, "callback", None)
             if cb is not None:
                 try:
-                    cb(None, RpcConnectionError(str(exc)))
+                    cb(None, err)
                 except Exception:
                     logger.exception("rpc callback failed on close")
             else:
@@ -399,6 +445,20 @@ class RpcContext:
         if self._done:
             return
         self._done = True
+        if chaos.ENABLED:
+            try:
+                act = chaos.inject("rpc.server.send",
+                                   method=_method_name(self.method))
+            except chaos.ChaosConnectionReset:
+                # kill the connection instead of replying: the client sees
+                # a reset with this request in flight
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                return
+            if act == "drop":
+                return  # reply "lost on the wire"; the caller times out
         try:
             with self._wlock:
                 send_frame(self._sock, env, raw=raw)
@@ -502,6 +562,12 @@ class RpcServer:
                         raise RpcConnectionError(
                             f"raw payload too large: {env.raw_len}")
                     raw = _read_exact(sock, env.raw_len)
+                if chaos.ENABLED:
+                    # reset raises -> finally below closes the socket, the
+                    # server-side version of a mid-request peer reset
+                    if chaos.inject("rpc.server.recv", conn=str(conn_id),
+                                    method=_method_name(env.method)) == "drop":
+                        continue  # request "never arrived"
                 if env.method == pb.AUTH:
                     continue  # redundant re-auth: ignore
                 ctx = RpcContext(self, sock, wlock, env)

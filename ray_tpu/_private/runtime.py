@@ -46,7 +46,9 @@ import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from ray_tpu import chaos
 from ray_tpu import exceptions as exc
+from ray_tpu._private.backoff import BackoffPolicy
 from ray_tpu._private.config import _config
 from ray_tpu._private.ids import (ActorID, JobID, NodeID, ObjectID,
                                   PlacementGroupID, TaskID)
@@ -228,6 +230,14 @@ class Runtime:
 
         self.hybrid_policy = HybridPolicy()
         self.spread_policy = SpreadPolicy()
+
+        # Shared retry pacing (see _private/backoff.py): task retries and
+        # actor restarts take a jittered exponential delay from these
+        # instead of a fixed task_retry_delay_ms sleep.
+        self._retry_backoff = BackoffPolicy(
+            base_s=_config.get("task_retry_delay_ms") / 1e3,
+            max_s=_config.get("task_retry_max_delay_ms") / 1e3,
+            deadline_s=0)
 
         # Pending queue of tasks waiting for resources / dependencies.
         self._pending: List[dict] = []
@@ -775,6 +785,12 @@ class Runtime:
         try:
             if cancel.is_set():
                 raise exc.TaskCancelledError(spec.task_id)
+            if chaos.ENABLED:
+                # delay stalls the worker; error fails the task (retryable
+                # per retry_exceptions); exit kills this PROCESS mid-task —
+                # the injected host-loss scenario resubmission must survive
+                chaos.inject("task.execute", task=spec.task_id.hex()[:8],
+                             name=spec.function_name)
             args = _resolve_refs(spec.args, self)
             kwargs = _resolve_refs(spec.kwargs, self)
             env = _materialize_env(spec)
@@ -834,7 +850,10 @@ class Runtime:
             return
         if spec.should_retry(e):
             spec.attempt += 1
-            delay = _config.get("task_retry_delay_ms") / 1e3
+            # jittered exponential via the shared policy: simultaneous
+            # failures (a died dependency, an OOM kill) don't retry in
+            # lockstep
+            delay = self._retry_backoff.delay_for(spec.attempt - 1)
             self.emit_event("TASK_RETRY", task=spec.function_name,
                             attempt=spec.attempt)
             timer = threading.Timer(delay, lambda: self.submit_task(spec))
@@ -899,6 +918,9 @@ class Runtime:
 
     def _place_and_start_actor(self, state: ActorState, restart: bool = False):
         deadline = time.monotonic() + _config.get("worker_lease_timeout_s")
+        pause = BackoffPolicy(base_s=0.005, max_s=0.05, deadline_s=0,
+                              jitter=False)
+        attempt = 0
         request = state.options.resources
         spec_like = TaskSpec(
             task_id=TaskID.for_actor_task(self.job_id, state.actor_id),
@@ -922,7 +944,8 @@ class Runtime:
                     f"could not place actor {state.cls.__name__} "
                     f"(resources {request})"))
                 return
-            time.sleep(0.005)
+            time.sleep(pause.delay_for(attempt))
+            attempt += 1
         state.node_id = node_id
         state.devices = self._assign_devices(request, node)
         self._start_actor_on_node(state, node, request)
@@ -1238,7 +1261,12 @@ class Runtime:
             old_mailbox.put(None)
         self.emit_event("ACTOR_RESTART", actor=state.cls.__name__,
                         attempt=state.restart_count)
-        delay = _config.get("actor_restart_delay_ms") / 1e3
+        # escalate the restart delay with the restart count (shared policy:
+        # jittered exponential from actor_restart_delay_ms)
+        delay = BackoffPolicy(
+            base_s=_config.get("actor_restart_delay_ms") / 1e3,
+            max_s=_config.get("task_retry_max_delay_ms") / 1e3,
+            deadline_s=0).delay_for(max(0, state.restart_count - 1))
         timer = threading.Timer(
             delay, lambda: self._util_pool.submit(
                 self._place_and_start_actor, state, True))

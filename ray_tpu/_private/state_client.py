@@ -15,7 +15,11 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
-from ray_tpu._private.rpc import RpcClient, RpcConnectionError
+from ray_tpu import chaos
+from ray_tpu._private.backoff import BackoffPolicy
+from ray_tpu._private.config import _config
+from ray_tpu._private.rpc import (RpcClient, RpcConnectionError,
+                                  _method_name)
 from ray_tpu.protocol import pb
 
 logger = logging.getLogger("ray_tpu")
@@ -82,21 +86,44 @@ class StateClient:
     # ------------------------------------------------------------------ core
 
     def _call(self, method: int, msg=None, timeout: float = 30.0,
-              retry: bool = True) -> bytes:
-        """``retry``: reconnect and re-send once on a connection error —
+              retry: bool = True, deadline_s: Optional[float] = None) -> bytes:
+        """``retry``: reconnect and re-send on a connection error —
         at-least-once semantics. The state service's mutating handlers
         are upserts and its subscribers handle duplicate events
         idempotently, so the retry is safe EXCEPT for compare-and-set
         writes (``kv_put(overwrite=False)``), which pass retry=False: a
-        replayed CAS would misreport the original success as a loss."""
+        replayed CAS would misreport the original success as a loss.
+
+        The first failure retries immediately (the common case: a completed
+        service restart left a dead socket behind); further attempts are
+        paced by the shared backoff policy until ``deadline_s`` (default:
+        ``state_reconnect_deadline_s``) is spent, so calls issued DURING a
+        restart ride it out instead of failing."""
         body = msg.SerializeToString() if msg is not None else b""
-        try:
-            return self._client.call(method, body, timeout=timeout).body
-        except RpcConnectionError:
-            if self._closed or not retry:
-                raise
-            self._reconnect()
-            return self._client.call(method, body, timeout=timeout).body
+        state = None
+        while True:
+            try:
+                if chaos.ENABLED:
+                    chaos.inject("state.call", method=_method_name(method))
+                return self._client.call(method, body, timeout=timeout).body
+            except (RpcConnectionError, chaos.ChaosConnectionReset) as e:
+                if self._closed or not retry:
+                    raise
+                if state is None:
+                    if deadline_s is None:
+                        deadline_s = _config.get("state_reconnect_deadline_s")
+                    state = BackoffPolicy(deadline_s=deadline_s).start()
+                elif not state.sleep():
+                    raise RpcConnectionError(
+                        f"state service at {self.address} unreachable after "
+                        f"{state.attempt} attempts over "
+                        f"{state.elapsed():.1f}s: {e}") from e
+                try:
+                    self._reconnect()
+                except (RpcConnectionError, OSError) as re:
+                    # still down — the next loop iteration fails fast on the
+                    # dead client and burns backoff budget above
+                    logger.debug("state reconnect attempt failed: %s", re)
 
     def _reconnect(self):
         """Replace the dead request connection (single flight: concurrent
@@ -111,6 +138,8 @@ class StateClient:
             except Exception as e:
                 logger.debug("probe ping failed; reconnecting: %s", e)
             old = self._client
+            if chaos.ENABLED:
+                chaos.inject("state.reconnect", peer=self.address)
             self._client = RpcClient(self.address,
                                      auth_token=self._auth_token)
             try:
@@ -196,7 +225,10 @@ class StateClient:
         if available is not None:
             req.available.amounts.update(available)
         rep = pb.HeartbeatReply()
-        rep.ParseFromString(self._call(pb.HEARTBEAT, req, timeout=10.0))
+        # small retry budget: a missed beat is recoverable, so don't wedge
+        # the heartbeat thread for the full reconnect deadline
+        rep.ParseFromString(self._call(pb.HEARTBEAT, req, timeout=10.0,
+                                       deadline_s=5.0))
         return rep.recognized
 
     def list_nodes(self) -> List[pb.NodeInfo]:

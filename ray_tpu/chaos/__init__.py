@@ -1,0 +1,129 @@
+"""Seeded, deterministic fault injection for ray_tpu (see engine.py for the
+spec grammar and determinism contract).
+
+Call-site pattern — every injection point in the runtime is guarded by one
+module-level bool so the disabled path costs a single attribute check::
+
+    from ray_tpu import chaos
+    ...
+    if chaos.ENABLED:
+        if chaos.inject("rpc.client.send", peer=self.address) == "drop":
+            return   # silently discard the frame
+
+Activation:
+
+- ``RAY_TPU_CHAOS=<seed>:<spec>`` in the environment (picked up at import,
+  inherited by spawned daemons/workers so cluster-wide schedules work), or
+- programmatically: ``chaos.configure(seed, spec)`` / ``chaos.install(
+  schedule)`` / ``chaos.clear()``.
+
+Injection-point catalog (the ``ARCHITECTURE.md`` "Failure model" section is
+the authoritative doc):
+
+====================  =====================================================
+point                 labels / where
+====================  =====================================================
+rpc.client.connect    peer — RpcClient dial, before the TCP connect
+rpc.client.send       peer, method — before a request/push frame is written
+rpc.client.recv       peer — after a reply/push frame is read off the wire
+rpc.server.recv       peer — server side, after a request frame is read
+rpc.server.send       peer, method — before a reply frame is written
+state.call            method — StateClient._call, before the RPC
+state.reconnect       peer — StateClient._reconnect, before re-dialing
+state.heartbeat       node — daemon heartbeat loop, before each beat
+object.push           peer, object — distributed pusher, per chunk
+object.fetch          peer, object — distributed fetch, per source attempt
+object.store.get      object — local ObjectStore.get
+task.execute          task, name — worker, before user code runs
+====================  =====================================================
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+from ray_tpu.chaos.engine import (ChaosConnectionReset, ChaosError,
+                                  FaultRule, FaultSchedule, parse_env,
+                                  parse_spec)
+
+__all__ = [
+    "ENABLED", "ChaosError", "ChaosConnectionReset", "FaultRule",
+    "FaultSchedule", "parse_spec", "parse_env", "configure", "install",
+    "clear", "inject", "schedule", "trace_lines", "trace_text",
+]
+
+logger = logging.getLogger("ray_tpu")
+
+#: Fast-path guard — False means every injection point is a no-op attribute
+#: check. Only mutated via install()/clear().
+ENABLED = False
+
+_schedule: Optional[FaultSchedule] = None
+
+
+def install(sched: FaultSchedule) -> FaultSchedule:
+    """Install ``sched`` as the process-wide schedule and enable injection."""
+    global ENABLED, _schedule
+    _schedule = sched
+    ENABLED = True
+    return sched
+
+
+def configure(seed: int, spec: str) -> FaultSchedule:
+    """Compile ``spec`` with ``seed`` and install it."""
+    return install(parse_spec(seed, spec))
+
+
+def clear():
+    """Disable injection and drop the schedule."""
+    global ENABLED, _schedule
+    ENABLED = False
+    _schedule = None
+
+
+def schedule() -> Optional[FaultSchedule]:
+    return _schedule
+
+
+def inject(point: str, **labels) -> Optional[str]:
+    """Consult the schedule at a named injection point.
+
+    Returns ``"drop"`` (caller discards the event), ``"delay"`` (the sleep
+    already happened), or ``None`` (no fault). Raises
+    :class:`ChaosConnectionReset` / :class:`ChaosError`, or exits the
+    process, per the matched rule's action.
+    """
+    sched = _schedule
+    if sched is None:
+        return None
+    return sched.fire(point, labels)
+
+
+def trace_lines():
+    """Trace lines of the installed schedule ([] when none)."""
+    sched = _schedule
+    return sched.trace_lines() if sched is not None else []
+
+
+def trace_text() -> str:
+    sched = _schedule
+    return sched.trace_text() if sched is not None else ""
+
+
+def _init_from_env():
+    value = os.environ.get("RAY_TPU_CHAOS")
+    if not value:
+        return
+    try:
+        install(parse_env(value))
+    except ValueError:
+        # A typo in the spec must not silently run the workload fault-free:
+        # fail loudly at import.
+        raise
+    logger.warning("chaos: fault injection ENABLED from RAY_TPU_CHAOS=%s",
+                   value)
+
+
+_init_from_env()

@@ -43,6 +43,9 @@ R5   host-sync      host-device sync (``.item()``, ``float()``,
                     jitted step function
 R6   proto-drift    field/enum-number drift between ``raytpu.proto`` and
                     the committed ``raytpu_pb2.py``
+R7   bare-retry     hand-rolled retry loop: constant ``time.sleep`` inside
+                    a loop that also catches exceptions (use
+                    ``ray_tpu._private.backoff.BackoffPolicy``)
 ==== ============== ====================================================
 """
 
@@ -580,6 +583,73 @@ def check_host_sync(ctx: FileContext) -> Iterator[Finding]:
 
 
 # --------------------------------------------------------------------------
+# R7: hand-rolled retry loops (constant sleep + except in the same loop)
+
+def _const_sleep_arg(node: ast.Call, ctx: FileContext) -> Optional[ast.AST]:
+    """Return the argument node if *node* is a ``time.sleep(...)`` call,
+    else None.  Accepts ``sleep`` imported from ``time``."""
+    dn = _dotted(node.func)
+    if dn == "time.sleep":
+        pass
+    elif dn == "sleep" and ctx.from_imports.get("sleep") == "time":
+        pass
+    else:
+        return None
+    return node.args[0] if node.args else None
+
+
+@rule("R7", "bare-retry")
+def check_bare_retry(ctx: FileContext) -> Iterator[Finding]:
+    """A loop that catches exceptions and paces itself with a constant
+    ``time.sleep`` is a hand-rolled retry: no jitter (thundering herd on
+    recovery), no cap, no deadline budget.  That also covers the
+    ``for delay in (0.1, 0.5, 2.0): ... sleep(delay)`` ladder — a
+    hard-coded schedule with the same problems.  Use
+    ``ray_tpu._private.backoff.BackoffPolicy`` / ``retry_call`` instead,
+    or justify with ``# raylint: allow(bare-retry) <why>``."""
+
+    def loop_const_names(loop: ast.AST) -> Set[str]:
+        """Names bound by a ``for X in (const, ...)`` header."""
+        if not isinstance(loop, ast.For):
+            return set()
+        it = loop.iter
+        if isinstance(it, (ast.Tuple, ast.List)) and it.elts and \
+                all(isinstance(e, ast.Constant) and
+                    isinstance(e.value, (int, float)) for e in it.elts):
+            if isinstance(loop.target, ast.Name):
+                return {loop.target.id}
+        return set()
+
+    for loop in ast.walk(ctx.tree):
+        if not isinstance(loop, (ast.While, ast.For)):
+            continue
+        body_nodes = [n for stmt in loop.body for n in _walk_pruned(stmt)]
+        if not any(isinstance(n, ast.ExceptHandler) for n in body_nodes):
+            continue
+        const_names = loop_const_names(loop)
+        for node in body_nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            arg = _const_sleep_arg(node, ctx)
+            if arg is None:
+                continue
+            constant = (
+                isinstance(arg, ast.Constant) and
+                isinstance(arg.value, (int, float))) or (
+                isinstance(arg, ast.Name) and arg.id in const_names)
+            if not constant:
+                continue
+            if ctx.allowed(node.lineno, "R7", "bare-retry"):
+                continue
+            yield Finding(
+                "R7", "bare-retry", ctx.relpath, node.lineno,
+                "constant time.sleep() paces a retry loop (loop also "
+                "catches exceptions): no jitter, cap, or deadline — use "
+                "ray_tpu._private.backoff.BackoffPolicy, or justify with "
+                "'# raylint: allow(bare-retry) <why>'")
+
+
+# --------------------------------------------------------------------------
 # R6: proto <-> pb2 wire-schema drift (project rule)
 
 def parse_proto_text(source: str) -> Dict[str, Dict[str, int]]:
@@ -786,7 +856,9 @@ class LintEngine:
                 findings.extend(fn(ctxs, self))
         findings = [f for f in findings
                     if (f.rule, f.path) not in self.baseline]
-        findings.sort(key=lambda f: (f.path, f.line, f.rule))
+        # nested loops can both see one sleep/handler — report each site once
+        findings = sorted(set(findings),
+                          key=lambda f: (f.path, f.line, f.rule))
         return findings
 
 

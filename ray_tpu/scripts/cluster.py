@@ -44,7 +44,7 @@ def read_address(run_dir: str = DEFAULT_RUN_DIR,
             pass
         if time.monotonic() >= deadline:
             return None
-        time.sleep(0.05)
+        time.sleep(0.05)  # raylint: allow(bare-retry) local file-appearance poll, deadline-bounded
 
 
 def start(head: bool = False, address: str = "",
@@ -142,7 +142,7 @@ def start(head: bool = False, address: str = "",
             if daemon_addr:
                 break
         except OSError:
-            time.sleep(0.1)
+            time.sleep(0.1)  # raylint: allow(bare-retry) local file-appearance poll, deadline-bounded
     if not daemon_addr:
         raise TimeoutError(f"daemon did not start (see {log_path})")
     return addr

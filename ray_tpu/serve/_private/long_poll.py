@@ -17,6 +17,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ray_tpu._private.backoff import BackoffPolicy
+
 
 class LongPollHost:
     def __init__(self):
@@ -92,6 +94,8 @@ class LongPollClient:
         self._thread.start()
 
     def _loop(self) -> None:
+        poll_backoff = BackoffPolicy(base_s=0.2, max_s=5.0, deadline_s=0)
+        errors = 0
         while not self._stopped.is_set():
             try:
                 ref = self._controller.listen_for_change.remote(
@@ -101,8 +105,10 @@ class LongPollClient:
                 logger.debug("long poll failed; retrying: %s", e)
                 if self._stopped.is_set():
                     return
-                time.sleep(0.2)
+                errors += 1
+                self._stopped.wait(poll_backoff.delay_for(errors - 1))
                 continue
+            errors = 0
             for key, (snapshot_id, obj) in updates.items():
                 self._snapshot_ids[key] = snapshot_id
                 try:

@@ -17,6 +17,7 @@ from typing import Any, Callable, Dict, Optional
 
 import ray_tpu
 from ray_tpu import exceptions as exc
+from ray_tpu._private.backoff import BackoffPolicy
 from ray_tpu.air.checkpoint import Checkpoint
 from ray_tpu.air.config import (FailureConfig, Result, RunConfig,
                                 ScalingConfig)
@@ -58,6 +59,7 @@ class JaxTrainer:
         if not ray_tpu.is_initialized():
             ray_tpu.init()
         failures = 0
+        restart_backoff = BackoffPolicy(base_s=0.1, max_s=2.0, deadline_s=0)
         max_failures = self.run_config.failure_config.max_failures
         checkpoint = self._resume_from
         history = []
@@ -100,7 +102,7 @@ class JaxTrainer:
                                   error=e, metrics_history=history)
                 # Elastic restart from the latest checkpoint
                 # (reference: backend_executor.py:510-531).
-                time.sleep(0.1)
+                time.sleep(restart_backoff.delay_for(failures - 1))
                 continue
             finally:
                 # Never leak the worker group / placement group, whatever

@@ -49,4 +49,13 @@ ASAN_OPTIONS="detect_leaks=0:abort_on_error=1" \
 LD_PRELOAD="$RT_LIB" \
 python -m pytest tests/test_scheduling.py -q
 
+echo "== chaos gate (core suite under a fixed delay-only fault schedule) =="
+# Deterministic, delay-only: shifts timing on RPC sends and heartbeats
+# without dropping anything, so correctness tests must still pass. A
+# failure here means a path depends on lucky timing, not on its retries.
+# Seed is fixed so the perturbation is reproducible run-to-run.
+RAY_TPU_CHAOS="20260805:rpc.client.send@3%7=delay(0.02);state.heartbeat@2%3=delay(0.05);object.push@2%5=delay(0.01)" \
+JAX_PLATFORMS=cpu \
+python -m pytest tests/test_core.py tests/test_actors.py -q
+
 echo "sanitizer pass ($KIND) complete"

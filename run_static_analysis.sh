@@ -2,7 +2,7 @@
 # Static-analysis gate for ray_tpu (ARCHITECTURE.md "Static analysis &
 # concurrency invariants"). Three stages, all must pass:
 #
-#   1. raylint — the framework-aware AST linter (R1..R6) over the Python
+#   1. raylint — the framework-aware AST linter (R1..R7) over the Python
 #      tree plus bench.py; any non-allowlisted finding fails the gate.
 #   2. lockwatch — the tier-1 test suite once under RAY_TPU_LOCKWATCH=1;
 #      every process summary line must report zero lock-order cycles.

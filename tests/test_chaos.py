@@ -1,0 +1,595 @@
+"""Chaos engine + unified backoff/deadline/breaker policy tests.
+
+Three layers:
+
+1. engine unit tests — spec grammar, trigger semantics, seeded
+   determinism (same seed => byte-identical fault trace);
+2. backoff/breaker unit tests — jittered delays, deadline budgets,
+   retry_call classification, circuit state machine under a fake clock;
+3. integration — injected resets/drops through the real RPC stack, a
+   StateClient surviving a state-service restart, and a multi-process
+   cluster completing a workload after chaos kills a node mid-run.
+
+An autouse fixture snapshots/restores the process-wide schedule so these
+tests compose with an ambient ``RAY_TPU_CHAOS`` gate (run_sanitizers.sh
+runs other suites under a delay-only schedule; this suite manages its
+own).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import chaos
+from ray_tpu._private.backoff import (BackoffPolicy, BreakerBoard,
+                                      CircuitBreaker, retry_call)
+from ray_tpu._private.rpc import (RpcClient, RpcConnectionError, RpcServer)
+from ray_tpu._private.state_client import StateClient, start_state_service
+from ray_tpu.chaos.engine import (ChaosConnectionReset, ChaosError,
+                                  parse_env, parse_spec)
+from ray_tpu.cluster_utils import ProcessCluster
+from ray_tpu.protocol import pb
+
+
+@pytest.fixture(autouse=True)
+def _isolate_chaos():
+    """Each test starts fault-free and restores whatever schedule (e.g.
+    from an ambient RAY_TPU_CHAOS gate) was installed before it."""
+    prev = chaos.schedule()
+    chaos.clear()
+    yield
+    if prev is not None:
+        chaos.install(prev)
+    else:
+        chaos.clear()
+
+
+# -- engine: grammar ----------------------------------------------------------
+
+def test_parse_spec_fields():
+    sched = parse_spec(42, "rpc.client.send[method=PUSH_*]@3%5=delay(0.25); "
+                           "task.execute@2+=drop")
+    assert sched.seed == 42 and len(sched.rules) == 2
+    r0, r1 = sched.rules
+    assert (r0.point_glob, r0.label_key, r0.label_glob) == \
+        ("rpc.client.send", "method", "PUSH_*")
+    assert (r0.trig_kind, r0.trig_n, r0.trig_m) == ("every", 3, 5)
+    assert (r0.action, r0.arg) == ("delay", 0.25)
+    assert (r1.trig_kind, r1.trig_n, r1.action) == ("from", 2, "drop")
+
+
+def test_parse_env_roundtrip():
+    sched = parse_env("7:task.execute@1=exit(3)")
+    assert sched.seed == 7
+    r = sched.rules[0]
+    assert (r.action, r.arg, r.trig_kind) == ("exit", 3, "nth")
+
+
+@pytest.mark.parametrize("bad", [
+    "no-action-here",
+    "p@x=drop",                 # bad trigger
+    "p@0=drop",                 # ordinal must be >= 1
+    "p@2%0=drop",               # zero modulus
+    "p@1=explode",              # unknown action
+    "p@1=delay",                # delay needs seconds
+    "p@1=delay(-1)",            # negative delay
+    "p@1=drop(5)",              # drop takes no argument
+    "",                         # no rules at all
+])
+def test_parse_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_spec(1, bad)
+
+
+@pytest.mark.parametrize("bad_env", ["nocolon", "abc:p@1=drop", ":p@1=drop"])
+def test_parse_env_rejects(bad_env):
+    with pytest.raises(ValueError):
+        parse_env(bad_env)
+
+
+# -- engine: trigger semantics -----------------------------------------------
+
+def _fire_seq(sched, n, point="p", **labels):
+    out = []
+    for _ in range(n):
+        try:
+            out.append(sched.fire(point, labels))
+        except ChaosConnectionReset:
+            out.append("reset")
+        except ChaosError:
+            out.append("error")
+    return out
+
+
+def test_trigger_nth_is_one_shot():
+    sched = parse_spec(1, "p@2=drop")
+    assert _fire_seq(sched, 5) == [None, "drop", None, None, None]
+
+
+def test_trigger_from():
+    sched = parse_spec(1, "p@3+=drop")
+    assert _fire_seq(sched, 5) == [None, None, "drop", "drop", "drop"]
+
+
+def test_trigger_every():
+    sched = parse_spec(1, "p@2%3=drop")
+    assert _fire_seq(sched, 9) == [None, "drop", None, None, "drop",
+                                   None, None, "drop", None]
+
+
+def test_point_glob_and_label_filter():
+    sched = parse_spec(1, "rpc.client.*@1+=drop; "
+                          "state.call[method=HEART*]@1+=drop")
+    assert sched.fire("rpc.client.send", {"peer": "x"}) == "drop"
+    assert sched.fire("rpc.server.send", {}) is None
+    assert sched.fire("state.call", {"method": "KV_GET"}) is None
+    assert sched.fire("state.call", {"method": "HEARTBEAT"}) == "drop"
+
+
+def test_actions_raise_typed_exceptions():
+    sched = parse_spec(1, "r@1=reset; e@1=error(boom)")
+    with pytest.raises(ChaosConnectionReset) as ri:
+        sched.fire("r", {})
+    assert isinstance(ri.value, ConnectionError)   # transport-shaped
+    with pytest.raises(ChaosError, match="boom"):
+        sched.fire("e", {})
+
+
+def test_delay_sleeps_and_reports():
+    sched = parse_spec(1, "d@1=delay(0.05)")
+    t0 = time.monotonic()
+    assert sched.fire("d", {}) == "delay"
+    assert time.monotonic() - t0 >= 0.04
+
+
+def test_first_rule_wins_but_later_counters_advance():
+    # Both rules match every "p" event; rule#0 fires first on event 2,
+    # rule#1's counter still advanced so its @2 one-shot is spent.
+    sched = parse_spec(1, "p@2=drop; p@2=delay(0)")
+    assert _fire_seq(sched, 4) == [None, "drop", None, None]
+    assert sched.rules[1].count == 4 and not \
+        any("rule#1" in ln for ln in sched.trace_lines())
+
+
+# -- engine: determinism ------------------------------------------------------
+
+def test_same_seed_byte_identical_trace():
+    spec = "p@p0.4=drop; q@2%3=delay(0)"
+    a, b = parse_spec(99, spec), parse_spec(99, spec)
+    for sched in (a, b):
+        for i in range(50):
+            sched.fire("p", {"k": str(i % 3)})
+            sched.fire("q", {})
+    assert a.trace_text() == b.trace_text()
+    assert a.trace_lines()  # the schedule actually fired
+
+
+def test_different_seed_different_prob_decisions():
+    spec = "p@p0.5=drop"
+    a, b = parse_spec(1, spec), parse_spec(2, spec)
+    seq_a = _fire_seq(a, 64)
+    seq_b = _fire_seq(b, 64)
+    assert seq_a != seq_b          # deterministic given the seeds above
+    assert "drop" in seq_a and "drop" in seq_b
+
+
+def test_prob_rules_draw_even_when_another_rule_fires():
+    # An earlier always-firing rule must not desync a later prob rule:
+    # its counter and RNG stream advance on every MATCHING event, so the
+    # decision stream is a pure function of (seed, rule index, ordinal).
+    spec = "p@1+=delay(0); p@p0.5=drop"
+    a = parse_spec(7, spec)
+    _fire_seq(a, 32)
+    assert a.rules[1].count == 32          # advanced despite never winning
+    # a fresh schedule's rule#1, driven directly, reproduces the stream
+    b = parse_spec(7, spec)
+    direct = [b.rules[1].should_fire() for _ in range(32)]
+    c = parse_spec(7, spec)
+    via_fire = []
+    for _ in range(32):
+        c.fire("p", {})
+        via_fire.append(c.rules[1].count)
+    assert c.rules[1].count == 32
+    assert any(direct) and not all(direct)  # p0.5 over 32 draws mixes
+
+
+def test_trace_file_identical_across_processes(tmp_path):
+    """Acceptance: two subprocess runs with the same RAY_TPU_CHAOS and the
+    same event sequence write byte-identical trace files."""
+    snippet = (
+        "from ray_tpu import chaos\n"
+        "for i in range(20):\n"
+        "    try:\n"
+        "        chaos.inject('p', k=str(i % 4))\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    traces = []
+    for run in ("a", "b"):
+        path = tmp_path / f"trace-{run}.log"
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu",
+                   RAY_TPU_CHAOS="123:p@p0.5=drop;p@3%4=error(x)",
+                   RAY_TPU_CHAOS_TRACE=str(path))
+        subprocess.run([sys.executable, "-c", snippet], env=env, check=True,
+                       timeout=120)
+        # strip the pid prefix — it is the one legitimately varying field
+        lines = [ln.split("] ", 1)[1] for ln in
+                 path.read_text().splitlines()]
+        traces.append("\n".join(lines))
+    assert traces[0] == traces[1] and traces[0]
+
+
+# -- module API ---------------------------------------------------------------
+
+def test_configure_install_clear():
+    assert chaos.ENABLED is False
+    assert chaos.inject("p") is None          # no schedule -> no-op
+    chaos.configure(5, "p@1=drop")
+    assert chaos.ENABLED is True
+    assert chaos.inject("p") == "drop"
+    assert chaos.trace_lines() and "p" in chaos.trace_text()
+    chaos.clear()
+    assert chaos.ENABLED is False and chaos.schedule() is None
+
+
+# -- backoff policy -----------------------------------------------------------
+
+def test_delay_for_bounds_and_cap():
+    p = BackoffPolicy(base_s=0.1, max_s=0.8, multiplier=2.0, deadline_s=0,
+                      jitter=False)
+    assert [p.delay_for(i) for i in range(5)] == [0.1, 0.2, 0.4, 0.8, 0.8]
+    j = BackoffPolicy(base_s=0.1, max_s=0.8, multiplier=2.0, deadline_s=0,
+                      seed=3)
+    st = j.start()
+    for i in range(20):
+        d = st.next_delay()
+        assert 0.0 <= d <= min(0.8, 0.1 * 2 ** i)
+
+
+def test_seeded_backoff_deterministic():
+    mk = lambda: BackoffPolicy(base_s=0.1, max_s=5.0, deadline_s=0,
+                               seed=42).start()
+    a, b = mk(), mk()
+    assert [a.next_delay() for _ in range(10)] == \
+        [b.next_delay() for _ in range(10)]
+
+
+def test_deadline_budget_exhausts():
+    now = [0.0]
+    clock = lambda: now[0]
+    st = BackoffPolicy(base_s=1.0, max_s=1.0, deadline_s=10.0,
+                       jitter=False).start(clock)
+    assert st.remaining() == 10.0
+    now[0] = 9.5
+    assert st.next_delay() == 0.5          # clamped: never sleep past it
+    now[0] = 10.1
+    assert st.next_delay() is None         # budget spent
+    assert st.sleep(lambda s: None) is False
+
+
+def test_max_attempts_bounds():
+    st = BackoffPolicy(base_s=0.0, max_s=0.0, deadline_s=0,
+                       max_attempts=3).start()
+    assert st.next_delay() is not None
+    assert st.next_delay() is not None
+    assert st.next_delay() is None         # 3rd failed attempt: give up
+
+
+def test_attempt_timeout_is_min_of_per_attempt_and_remaining():
+    now = [0.0]
+    st = BackoffPolicy(base_s=0.1, deadline_s=10.0,
+                       attempt_timeout_s=3.0).start(lambda: now[0])
+    assert st.attempt_timeout() == 3.0
+    now[0] = 8.0
+    assert st.attempt_timeout() == pytest.approx(2.0)
+    unbounded = BackoffPolicy(base_s=0.1, deadline_s=0).start(lambda: 0.0)
+    assert unbounded.attempt_timeout() is None
+
+
+def test_retry_call_retries_then_succeeds():
+    calls, slept = [], []
+    def fn(timeout):
+        calls.append(timeout)
+        if len(calls) < 3:
+            raise ConnectionError("flaky")
+        return "ok"
+    out = retry_call(fn, BackoffPolicy(base_s=0.01, max_s=0.01, deadline_s=0),
+                     sleep=slept.append)
+    assert out == "ok" and len(calls) == 3 and len(slept) == 2
+
+
+def test_retry_call_non_retryable_raises_once():
+    calls = []
+    def fn(timeout):
+        calls.append(1)
+        raise ValueError("handler bug")
+    with pytest.raises(ValueError):
+        retry_call(fn, BackoffPolicy(base_s=0.01, deadline_s=5))
+    assert len(calls) == 1
+
+
+def test_retry_call_budget_exhausted_reraises_original():
+    def fn(timeout):
+        raise TimeoutError("still down")
+    with pytest.raises(TimeoutError, match="still down"):
+        retry_call(fn, BackoffPolicy(base_s=0.0, max_s=0.0, deadline_s=0,
+                                     max_attempts=4), sleep=lambda s: None)
+
+
+def test_classification_defaults():
+    p = BackoffPolicy()
+    from ray_tpu._private.rpc import RpcRemoteError
+    assert p.classify(ConnectionError())
+    assert p.classify(ChaosConnectionReset())
+    assert p.classify(TimeoutError())
+    assert p.classify(OSError())
+    assert not p.classify(RpcRemoteError("remote handler raised"))
+    assert not p.classify(ValueError())
+
+
+# -- circuit breaker ----------------------------------------------------------
+
+def test_breaker_full_cycle():
+    now = [0.0]
+    br = CircuitBreaker(failure_threshold=3, reset_s=5.0, clock=lambda: now[0])
+    assert br.state == "closed" and br.allow()
+    assert br.record_failure() is False
+    assert br.record_failure() is False
+    assert br.record_failure() is True     # edge: third consecutive opens it
+    assert br.state == "open" and not br.allow() and br.state_code() == 2
+    now[0] = 5.1
+    assert br.state == "half_open" and br.state_code() == 1
+    assert br.allow() is True              # the single probe
+    assert br.allow() is False             # everyone else still shed
+    br.record_success()
+    assert br.state == "closed" and br.allow()
+
+
+def test_breaker_failed_probe_reopens():
+    now = [0.0]
+    br = CircuitBreaker(failure_threshold=1, reset_s=2.0, clock=lambda: now[0])
+    br.record_failure()
+    now[0] = 2.5
+    assert br.allow()                      # probe goes out...
+    assert br.record_failure() is True     # ...and fails: straight back open
+    assert br.state == "open" and not br.allow()
+    now[0] = 3.0                           # reset clock restarted at 2.5
+    assert br.state == "open"
+    now[0] = 4.6
+    assert br.state == "half_open"
+
+
+def test_breaker_success_resets_failure_run():
+    br = CircuitBreaker(failure_threshold=3, reset_s=5.0)
+    br.record_failure(); br.record_failure()
+    br.record_success()                    # run broken: counter resets
+    assert br.record_failure() is False and br.state == "closed"
+
+
+def test_breaker_board_on_open_and_snapshot():
+    now = [0.0]
+    opened = []
+    board = BreakerBoard(failure_threshold=2, reset_s=5.0,
+                         clock=lambda: now[0], on_open=opened.append)
+    board.record_failure("a:1")
+    assert opened == []
+    board.record_failure("a:1")
+    assert opened == ["a:1"]
+    board.record_success("b:2")
+    assert board.snapshot() == {"a:1": 2, "b:2": 0}
+    assert not board.allow("a:1") and board.allow("b:2")
+    board.drop("a:1")
+    assert board.snapshot() == {"b:2": 0}
+
+
+# -- integration: RPC layer ---------------------------------------------------
+
+@pytest.fixture()
+def echo_server():
+    def handler(ctx):
+        ctx.reply(ctx.body)
+    srv = RpcServer(handler, auth_token=b"")
+    yield srv
+    srv.close()
+
+
+def test_rpc_injected_send_reset_fails_call_with_peer_address(echo_server):
+    chaos.configure(3, "rpc.client.send@2=reset")
+    client = RpcClient(echo_server.address, auth_token=b"")
+    try:
+        assert client.call(pb.PING, b"x", timeout=10).body == b"x"
+        with pytest.raises(RpcConnectionError) as ei:
+            client.call(pb.PING, b"y", timeout=10)
+        assert echo_server.address in str(ei.value)
+        assert client.closed                    # reset tore the conn down
+    finally:
+        client.close()
+    # the one-shot rule is spent: a fresh client recovers cleanly
+    c2 = RpcClient(echo_server.address, auth_token=b"")
+    try:
+        assert c2.call(pb.PING, b"z", timeout=10).body == b"z"
+    finally:
+        c2.close()
+    trace = chaos.trace_text()
+    assert "rpc.client.send" in trace and "reset" in trace
+
+
+def test_rpc_injected_reply_drop_times_out(echo_server):
+    chaos.configure(3, "rpc.server.send@1=drop")
+    client = RpcClient(echo_server.address, auth_token=b"")
+    try:
+        with pytest.raises(TimeoutError):
+            client.call(pb.PING, b"x", timeout=0.5)
+        # connection survives a dropped reply; next call works
+        assert client.call(pb.PING, b"y", timeout=10).body == b"y"
+    finally:
+        client.close()
+
+
+def test_rpc_injected_connect_reset_names_peer(echo_server):
+    chaos.configure(3, "rpc.client.connect@1=reset")
+    with pytest.raises(RpcConnectionError) as ei:
+        RpcClient(echo_server.address, auth_token=b"")
+    assert echo_server.address in str(ei.value)
+
+
+# -- integration: state client ------------------------------------------------
+
+def _state_service_available() -> bool:
+    try:
+        from ray_tpu._native.build import build_state_service
+        build_state_service()
+        return True
+    except Exception:  # raylint: allow(swallow) any build failure means "skip"
+        return False
+
+
+needs_state_service = pytest.mark.skipif(
+    not _state_service_available(),
+    reason="state-service binary cannot be built here (protoc/g++ missing)")
+
+@needs_state_service
+def test_state_client_retries_through_injected_reset(tmp_path):
+    proc, addr = start_state_service(data_dir=str(tmp_path / "s"))
+    client = StateClient(addr)
+    try:
+        client.kv_put(b"k", b"v1")
+        # every state.call RPC attempt #2 and #5 dies mid-flight; the
+        # unified retry path reconnects and the calls still succeed
+        chaos.configure(3, "state.call@2=reset; state.call@5=reset")
+        assert client.kv_get(b"k") == b"v1"
+        client.kv_put(b"k", b"v2")
+        assert client.kv_get(b"k") == b"v2"
+        assert "state.call" in chaos.trace_text()
+    finally:
+        client.close()
+        if proc.poll() is None:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+
+@needs_state_service
+def test_state_client_survives_service_restart(tmp_path):
+    proc, addr = start_state_service(data_dir=str(tmp_path / "s"))
+    client = StateClient(addr)
+    try:
+        client.kv_put(b"durable", b"yes")
+        port = int(addr.rsplit(":", 1)[1])
+        proc.kill()
+        proc.wait(timeout=10)
+        proc, addr2 = start_state_service(port=port,
+                                          data_dir=str(tmp_path / "s"))
+        assert addr2 == addr
+        # the client's socket is dead; _call must reconnect within its
+        # deadline budget and read the journal-recovered value
+        assert client.kv_get(b"durable") == b"yes"
+    finally:
+        client.close()
+        if proc.poll() is None:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+
+@needs_state_service
+def test_state_client_gives_up_with_budget_in_error(tmp_path):
+    proc, addr = start_state_service(data_dir=str(tmp_path / "s"))
+    client = StateClient(addr)
+    try:
+        client.kv_put(b"k", b"v")
+        proc.kill()
+        proc.wait(timeout=10)
+        t0 = time.monotonic()
+        with pytest.raises(RpcConnectionError) as ei:
+            client._call(pb.KV_GET,
+                         pb.KvGetRequest(ns=b"", key=b"k"),
+                         timeout=5.0, deadline_s=2.0)
+        msg = str(ei.value)
+        assert "unreachable" in msg and addr in msg
+        assert time.monotonic() - t0 < 30
+    finally:
+        client.close()
+
+
+# -- integration: cluster under chaos ----------------------------------------
+
+def test_in_process_task_retry_under_injected_execute_faults():
+    """Single-process runtime: chaos faults the first two task executions;
+    retry_exceptions + the jittered resubmission backoff must converge to
+    the right answers with the one-shot rules spent."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    try:
+        chaos.configure(17, "task.execute@1=error(injected worker fault); "
+                            "task.execute@3=error(injected worker fault)")
+
+        @ray_tpu.remote(max_retries=5, retry_exceptions=[ChaosError])
+        def f(i):
+            return i * 10
+
+        assert ray_tpu.get([f.remote(i) for i in range(6)],
+                           timeout=60) == [i * 10 for i in range(6)]
+        trace = chaos.trace_lines()
+        assert len([ln for ln in trace if "task.execute" in ln]) == 2
+    finally:
+        chaos.clear()
+        ray_tpu.shutdown()
+
+
+@needs_state_service
+def test_mid_flight_resubmission_under_injected_rpc_resets():
+    """Driver-side chaos resets the task-push connections mid-run; the
+    resubmission + reconnect paths must still complete the workload."""
+    ray_tpu.shutdown()
+    c = ProcessCluster(num_daemons=2, num_cpus=2)
+    ray_tpu.init(address=c.address)
+    try:
+        chaos.configure(11, "rpc.client.send[method=PUSH_TASK*]@3=reset; "
+                            "rpc.client.send[method=PUSH_TASK*]@9=reset")
+
+        @ray_tpu.remote
+        def f(i):
+            return i + 1
+
+        out = ray_tpu.get([f.remote(i) for i in range(12)], timeout=120)
+        assert out == list(range(1, 13))
+        assert "rpc.client.send" in chaos.trace_text()
+    finally:
+        chaos.clear()
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+@needs_state_service
+def test_node_loss_mid_run_completes_after_resubmission(monkeypatch):
+    """Chaos hard-kills one daemon (os._exit from its heartbeat loop, the
+    process-death shape of a lost host) while tasks are in flight; the
+    driver must resubmit onto the survivor and finish with correct
+    results."""
+    ray_tpu.shutdown()
+    c = ProcessCluster(num_daemons=1, num_cpus=2, heartbeat_timeout_ms=2000,
+                       daemon_heartbeat_s=0.25)
+    # only the second daemon carries the chaos schedule: it exits at its
+    # 8th heartbeat (~2s in), deterministically
+    monkeypatch.setenv("RAY_TPU_CHAOS", "3:state.heartbeat@8=exit(41)")
+    c.add_daemon()
+    monkeypatch.delenv("RAY_TPU_CHAOS")
+    doomed = c.daemons[-1]["proc"]
+    ray_tpu.init(address=c.address)
+    try:
+        @ray_tpu.remote(max_retries=5)
+        def slow(i):
+            time.sleep(0.4)
+            return i * i
+
+        refs = [slow.remote(i) for i in range(12)]
+        out = ray_tpu.get(refs, timeout=180)
+        assert out == [i * i for i in range(12)]
+        assert doomed.wait(timeout=60) == 41   # chaos did kill the node
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()

@@ -236,6 +236,74 @@ def test_r6_fires_when_field_number_mutated(tmp_path):
                for f in findings)
 
 
+# -- R7: hand-rolled retry loops ---------------------------------------------
+
+def test_r7_fires_on_constant_sleep_retry_loop(tmp_path):
+    findings = run_rule(tmp_path, "R7", """\
+        import time
+
+        def fetch(fn):
+            while True:
+                try:
+                    return fn()
+                except ConnectionError:
+                    time.sleep(0.5)
+        """)
+    assert len(findings) == 1
+    assert findings[0].tag == "bare-retry"
+    assert "BackoffPolicy" in findings[0].message
+
+
+def test_r7_fires_on_hardcoded_delay_ladder(tmp_path):
+    findings = run_rule(tmp_path, "R7", """\
+        from time import sleep
+
+        def fetch(fn):
+            for delay in (0.1, 0.5, 2.0):
+                try:
+                    return fn()
+                except OSError:
+                    sleep(delay)
+        """)
+    assert len(findings) == 1
+
+
+def test_r7_quiet_on_poll_policy_and_allow(tmp_path):
+    findings = run_rule(tmp_path, "R7", """\
+        import time
+
+        def plain_poll():
+            while True:
+                time.sleep(0.01)  # no except handler in the loop
+
+        def policy_paced(fn, policy):
+            state = policy.start()
+            while True:
+                try:
+                    return fn()
+                except ConnectionError:
+                    if not state.sleep():
+                        raise
+
+        def justified(fn):
+            while True:
+                try:
+                    return fn()
+                except ConnectionError:
+                    time.sleep(1)  # raylint: allow(bare-retry) spec-fixed cadence
+
+        def variable_delay(fn, policy):
+            attempt = 0
+            while True:
+                try:
+                    return fn()
+                except ConnectionError:
+                    time.sleep(policy.delay_for(attempt))
+                    attempt += 1
+        """)
+    assert findings == []
+
+
 def test_proto_parser_sees_real_schema():
     schema = parse_proto_text(open(PROTO, encoding="utf-8").read())
     assert "TaskSpecMsg" in schema

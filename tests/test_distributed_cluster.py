@@ -161,7 +161,7 @@ def test_daemon_death_actor_restart(cluster):
             pid2, _ = ray_tpu.get(s.bump.remote(), timeout=10)
             break
         except ray_tpu.exceptions.RayTpuError:
-            time.sleep(0.5)
+            time.sleep(0.5)  # raylint: allow(bare-retry) deadline-bounded test poll
     assert pid2 is not None and pid2 != pid1, "actor must restart elsewhere"
 
 
@@ -405,7 +405,7 @@ def test_state_service_restart_cluster_survives(tmp_path):
             except (ray_tpu.exceptions.RayTpuError, TimeoutError,
                     RpcConnectionError, OSError):
                 # the reconnection window surfaces several shapes
-                time.sleep(0.5)
+                time.sleep(0.5)  # raylint: allow(bare-retry) deadline-bounded test poll
         assert out == [1, 2, 3, 4]
         # the actor (state preserved in its daemon) keeps serving
         assert ray_tpu.get(k.bump.remote(), timeout=60) == 2
