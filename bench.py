@@ -229,17 +229,20 @@ def _wait_for_backend() -> bool:
     on a daemon thread with a timeout: a dead tunnel makes jax.devices()
     BLOCK (not raise), and a hung probe must count as a failed attempt.
 
-    The whole window is bounded by a wall-clock deadline
-    (``BENCH_PROBE_DEADLINE_S``, default 300 s), not an attempt count: an
-    unbounded retry ladder starved the CPU-pinned sections for ~20 min
-    whenever the tunnel was down. Returns True when the backend answered,
-    False when the deadline elapsed — the caller degrades instead of
-    raising.
+    The window is bounded BOTH by a wall-clock deadline
+    (``BENCH_PROBE_DEADLINE_S``, default 300 s) and an attempt cap
+    (``BENCH_PROBE_MAX_ATTEMPTS``, default 3): a tunnel that fails fast
+    can burn many attempts without touching the deadline (BENCH_r05: 17
+    consecutive failures ate the whole run until ``timeout -k`` killed it
+    with rc=124), and a down tunnel virtually never recovers within a
+    probe window anyway. Returns True when the backend answered, False
+    when either bound is hit — the caller degrades instead of raising.
     """
     import threading
 
     deadline_s = _env_float("BENCH_PROBE_DEADLINE_S", 300.0)
     delay_s = _env_float("BENCH_PROBE_DELAY_S", 15.0)
+    max_attempts = _env_int("BENCH_PROBE_MAX_ATTEMPTS", 3)
     t_start = time.monotonic()
 
     def probe() -> bool:
@@ -264,6 +267,10 @@ def _wait_for_backend() -> bool:
             return True
         _emit({"metric": "backend_probe_failed", "value": attempt,
                "unit": "attempts"})
+        if attempt >= max_attempts:
+            _emit({"metric": "backend_probe_gave_up", "value": attempt,
+                   "unit": "attempts"})
+            return False
         remaining = deadline_s - (time.monotonic() - t_start)
         if remaining <= 0:
             return False

@@ -162,6 +162,38 @@ def run_cluster():
         c.shutdown()
 
 
+def check_against(baseline_path: str, tolerance: float) -> int:
+    """Regression gate: compare this run's metrics against a tracked
+    baseline. Throughput-style metrics (tasks/s, GB/s, calls/s) must stay
+    >= baseline * tolerance; latency metrics (``_us``) are inverted and
+    must stay <= baseline / tolerance. Metrics missing from either side
+    are skipped (a cluster-less environment still gates the inproc set).
+    Returns the number of regressions (process exit code)."""
+    with open(baseline_path) as f:
+        baseline = {row["metric"]: row["value"] for row in json.load(f)}
+    measured = {row["metric"]: row["value"] for row in RESULTS}
+    failures = []
+    for metric, base in sorted(baseline.items()):
+        got = measured.get(metric)
+        if got is None or base <= 0:
+            continue
+        if metric.endswith("_us"):
+            ok = got <= base / tolerance
+            bound = f"<= {base / tolerance:.2f}"
+        else:
+            ok = got >= base * tolerance
+            bound = f">= {base * tolerance:.2f}"
+        status = "ok" if ok else "REGRESSION"
+        print(f"[check] {metric}: {got:.2f} vs baseline {base:.2f} "
+              f"(need {bound}) {status}", flush=True)
+        if not ok:
+            failures.append(metric)
+    if failures:
+        print(f"[check] {len(failures)} regression(s): "
+              f"{', '.join(failures)}", flush=True)
+    return len(failures)
+
+
 def main():
     # Honor JAX_PLATFORMS even when a site hook pre-registered a device
     # plugin that overrides the default platform (same pin host_daemon
@@ -177,6 +209,12 @@ def main():
     ap.add_argument("--mode", choices=["inproc", "cluster", "both"],
                     default="both")
     ap.add_argument("--out", default=None)
+    ap.add_argument("--check", default=None, metavar="BASELINE_JSON",
+                    help="compare against a tracked baseline; exit nonzero "
+                         "on regression beyond --tolerance")
+    ap.add_argument("--tolerance", type=float, default=0.7,
+                    help="allowed fraction of a throughput baseline "
+                         "(latency baselines are inverted)")
     args = ap.parse_args()
     if args.mode in ("inproc", "both"):
         run_inproc()
@@ -185,6 +223,8 @@ def main():
     if args.out:
         with open(args.out, "w") as f:
             json.dump(RESULTS, f, indent=1)
+    if args.check:
+        raise SystemExit(min(check_against(args.check, args.tolerance), 125))
 
 
 if __name__ == "__main__":

@@ -106,6 +106,27 @@ class _ArenaOps:
         self.seal(object_id)
         return True
 
+    def put_pieces(self, object_id: bytes, pieces,
+                   total: int) -> bool:
+        """Create + scatter-write + seal: land an already-fragmented
+        payload (pickle-5 out-of-band buffers plus framing) in the arena
+        WITHOUT assembling it contiguously first — the only copy is the
+        one into the arena pages. ``pieces`` must cover exactly
+        ``total`` bytes in order. False if the id exists; raises
+        MemoryError when the arena is full (caller evicts then retries)."""
+        view = self.create(object_id, total)
+        if view is None:
+            return False
+        pos = 0
+        for p in pieces:
+            mv = memoryview(p).cast("B")
+            n = len(mv)
+            if n:
+                view[pos:pos + n] = mv
+            pos += n
+        self.seal(object_id)
+        return True
+
     def get(self, object_id: bytes) -> Optional[memoryview]:
         """Zero-copy read. The object is pinned until ``release``."""
         key = self._key(object_id)

@@ -138,16 +138,41 @@ _config.define("circuit_reset_s", float, 5.0,
 _config.define("daemon_admission_queue_limit", int, 1000,
                "pending tasks a daemon accepts before spilling back "
                "(backpressure: one daemon must not absorb the cluster)")
-_config.define("task_push_batching", bool, False,
+_config.define("task_push_batching", bool, True,
                "coalesce task pushes into one TaskBatchMsg frame per "
-               "daemon per dispatch pass; helps many-core hosts (fewer "
-               "syscalls/wakeups), hurts single-core ones (serializes "
-               "admission on the reader thread) — measured both ways")
+               "daemon (fewer syscalls/reader wakeups on both sides); "
+               "the linger flusher (task_push_flush_ms) bounds the "
+               "latency a lone task waits for the frame to fill")
+_config.define("task_push_flush_ms", float, 0.25,
+               "max linger before a queued task-push batch is shipped; "
+               "<= 0 flushes synchronously at every dispatch (one frame "
+               "per pass, the pre-linger behavior)")
 _config.define("inline_dispatch", bool, False,
                "dispatch ref-free tasks inline on the submitting thread "
                "when the dispatcher is idle; wins on many-core hosts "
                "(skips two context switches), loses on saturated ones "
                "(defeats the dispatcher's batched passes)")
+
+# -- Data plane (bulk object transfer) -------------------------------------------
+_config.define("data_streams_per_peer", int, 4,
+               "extra raw data connections per peer for chunked object "
+               "transfers; multi-GB fetches stripe across them instead of "
+               "head-of-line-blocking the multiplexed control socket. "
+               "0 disables the pool (chunks ride the control connection)")
+_config.define("fetch_chunk_bytes", int, 8 * 1024 * 1024,
+               "chunk size for FETCH_OBJECT/PUSH_OBJECT streaming")
+_config.define("data_socket_buffer_bytes", int, 0,
+               "SO_SNDBUF/SO_RCVBUF for data-plane sockets; 0 auto-sizes "
+               "to the configured fetch chunk (the kernel caps silently "
+               "at net.core.[rw]mem_max)")
+
+# -- Control plane batching ------------------------------------------------------
+_config.define("state_batch_max", int, 64,
+               "object-directory ops coalesced into one state-service "
+               "write burst before an immediate flush")
+_config.define("state_batch_flush_ms", float, 2.0,
+               "max latency an enqueued directory op waits for batching; "
+               "<= 0 disables batching (every op is a synchronous RPC)")
 
 # -- Host-shared object plane ---------------------------------------------------
 _config.define("arena_enabled", bool, True,

@@ -59,6 +59,9 @@ from ray_tpu import chaos
 from ray_tpu import exceptions as exc
 from ray_tpu._private.backoff import BackoffPolicy, BreakerBoard
 from ray_tpu._private.config import _config
+from ray_tpu._private.framing import (FRAME_MAGIC as _FRAME_MAGIC,
+                                      FramedPayload, dumps_framed,
+                                      loads_framed)
 from ray_tpu._private.ids import (ActorID, JobID, NodeID, ObjectID,
                                   PlacementGroupID, TaskID)
 from ray_tpu._private.resources import NodeResources, ResourceSet
@@ -73,16 +76,94 @@ from ray_tpu._private.task_spec import TaskOptions, TaskSpec
 from ray_tpu.protocol import pb
 from ray_tpu.util import metrics as _metrics
 
+# raylint: hot-path  (bulk-transfer module: R8 flags hidden payload copies)
+
 logger = logging.getLogger("ray_tpu")
 
 INLINE_RESULT_MAX = 256 * 1024  # results below this ride in the reply
-FETCH_CHUNK = 8 * 1024 * 1024
+FETCH_CHUNK = 8 * 1024 * 1024  # legacy default; see fetch_chunk_bytes knob
+# First fetch request asks for at most this much: it exists to reveal
+# total_size (and catch small objects in one round trip) — a full chunk
+# here would be copied into the striped destination afterwards.
+_FETCH_PROBE_BYTES = 256 * 1024
 FN_NS = b"fun"  # KV namespace of the function table
 NAMED_FN_NS = b"namedfn"  # cross-language named-function registry
+
+# Framed-serialization helpers live in framing.py (single owner of the
+# RTF5 layout); the old local names remain as aliases for callers/tests.
+_dumps_framed = dumps_framed
+_loads_framed = loads_framed
+
+
+def _fetch_chunk() -> int:
+    return _config.get("fetch_chunk_bytes") or FETCH_CHUNK
+
+
+def _data_sock_buf() -> int:
+    """SO_SNDBUF/SO_RCVBUF for bulk-transfer sockets: explicit knob, else
+    sized to one fetch chunk so a whole chunk can be in flight per stream
+    (the kernel silently caps at net.core.[rw]mem_max)."""
+    n = _config.get("data_socket_buffer_bytes")
+    if n > 0:
+        return n
+    return min(max(_fetch_chunk(), 1 << 20), 64 << 20)
+
+
+class _DataStreamPool:
+    """Per-peer pool of raw data connections (``data_streams_per_peer``).
+
+    Chunked object transfers stripe across these instead of serializing
+    behind the multiplexed control socket's single reader/writer — the
+    reference separates object-manager data connections from the raylet
+    control channel for the same reason. Streams are plain authenticated
+    ``RpcClient``s (same FETCH_OBJECT protocol), created lazily per peer
+    and replaced on failure; with the pool disabled (size 0) callers fall
+    back to the control connection."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._streams: Dict[str, List[RpcClient]] = {}
+
+    def clients(self, address: str) -> List[RpcClient]:
+        n = _config.get("data_streams_per_peer")
+        if n <= 0:
+            return []
+        with self._lock:
+            pool = [c for c in self._streams.get(address, ())
+                    if not c.closed]
+            while len(pool) < n:
+                try:
+                    pool.append(RpcClient(
+                        address, sock_buf_bytes=_data_sock_buf()))
+                except (OSError, RpcConnectionError):
+                    break  # peer unreachable: callers use what exists
+            self._streams[address] = pool
+            return list(pool)
+
+    def drop(self, address: str) -> None:
+        with self._lock:
+            pool = self._streams.pop(address, [])
+        for c in pool:
+            c.close()
+
+    def close_all(self) -> None:
+        with self._lock:
+            pools = list(self._streams.values())
+            self._streams.clear()
+        for pool in pools:
+            for c in pool:
+                c.close()
 
 
 def _fn_key(payload: bytes) -> bytes:
     return hashlib.sha256(payload).digest()
+
+
+# Pre-pickled constants for the task-push hot loop: the no-arg call shape
+# and retry_exceptions=True are by far the commonest options, and pickling
+# them fresh per push showed up in submission profiles.
+_EMPTY_ARGS_PICKLE = cloudpickle.dumps(((), {}))
+_RETRY_ALL_PICKLE = cloudpickle.dumps(True)
 
 
 class _PgBundleKey:
@@ -148,6 +229,13 @@ class DistributedRuntime(Runtime):
         # dispatcher thread, whose pass-end hook reads these.
         self._push_batch: Dict[str, list] = {}
         self._push_batch_lock = threading.Lock()
+        # Linger flusher for task-push batches: dispatch hooks only STAMP a
+        # deadline; this thread ships the accumulated frame when it expires,
+        # so a burst of submissions (inline path included) coalesces into
+        # one frame per daemon instead of one per task.
+        self._push_flush_cv = threading.Condition()
+        self._push_flush_due: Optional[float] = None
+        self._push_flusher: Optional[threading.Thread] = None
         super().__init__(job_id=job_id)
         self.is_driver = is_driver
         self.namespace = namespace
@@ -169,8 +257,12 @@ class DistributedRuntime(Runtime):
                             pb.ACTOR_CALL, pb.ADD_BORROW,
                             pb.REMOVE_BORROW, pb.RELEASE_PIN, pb.PING,
                             pb.CANCEL_TASK, pb.RESERVE_BUNDLE,
-                            pb.FREE_BUNDLE, pb.FREE_OBJECT})
+                            pb.FREE_BUNDLE, pb.FREE_OBJECT},
+            sock_buf_bytes=_data_sock_buf())
         self.address = self.server.address
+        # Raw data connections for chunk striping (separate from `pool`,
+        # whose one connection per peer is the multiplexed control lane).
+        self._data_streams = _DataStreamPool()
 
         # Cluster view: node_id bytes -> (pb.NodeInfo, NodeResources view).
         self._states_memo = None  # (monotonic_ts, [NodeState]) micro-TTL
@@ -298,7 +390,9 @@ class DistributedRuntime(Runtime):
         # Proactive pushes of large task args to the executing daemon
         # (reference: push_manager.h), window-limited per peer.
         self._push_mgr = _PushManager(self)
-        self._incoming_pushes: Dict[ObjectID, io.BytesIO] = {}
+        # In-flight incoming pushes: oid -> [store recv-buffer view,
+        # bytes filled]. The view is the object's final resting place.
+        self._incoming_pushes: Dict[ObjectID, list] = {}
         self._incoming_push_seen: Dict[ObjectID, float] = {}
         self._incoming_pushes_lock = threading.Lock()
 
@@ -454,31 +548,47 @@ class DistributedRuntime(Runtime):
             s.close()
 
     @staticmethod
-    def _arena_payload_key(oid: ObjectID, payload: bytes) -> bytes:
+    def _arena_payload_key(oid: ObjectID, payload) -> bytes:
         """Content-bound arena key: a reconstructed object whose bytes
         differ (e.g. a recomputed result embedding a fresh pid) must NOT
         alias the stale entry of its predecessor."""
         h = hashlib.blake2b(digest_size=16)
         h.update(oid.binary())
-        h.update(hashlib.blake2b(payload, digest_size=16).digest())
+        ph = hashlib.blake2b(digest_size=16)
+        if isinstance(payload, FramedPayload):
+            # pieces cover [0, len) in order: hashing them sequentially
+            # IS hashing the materialized frame
+            for p in payload.pieces:
+                ph.update(p)
+        else:
+            ph.update(payload)
+        h.update(ph.digest())
         return h.digest()
 
-    def _arena_put(self, key: bytes, payload: bytes) -> bool:
+    def _arena_put(self, key: bytes, payload) -> bool:
         """Best-effort drop of a serialized payload into the shared arena.
         The owner evicts LRU (sealed, unpinned) entries to make room; a
-        client simply gives up on full (it cannot evict others' objects)."""
+        client simply gives up on full (it cannot evict others' objects).
+        ``payload`` is contiguous bytes or a ``FramedPayload`` (scatter-
+        written into the arena slot without materializing the frame)."""
         arena = self.host_arena
         if arena is None:
             return False
-        try:
+
+        def _write():
+            if isinstance(payload, FramedPayload):
+                return arena.put_pieces(key, payload.pieces, len(payload))
             return arena.put(key, payload)
+
+        try:
+            return _write()
         except MemoryError:
             if not self._arena_is_owner:
                 return False
             try:
                 for victim in arena.evict_candidates(len(payload)):
                     arena.delete(victim)
-                return arena.put(key, payload)
+                return _write()
             except MemoryError:
                 return False
         except Exception as e:
@@ -735,8 +845,15 @@ class DistributedRuntime(Runtime):
         except Exception as e:
             logger.debug("mark_node_dead failed: %s", e)
         super().shutdown()
+        with self._push_flush_cv:
+            self._push_flush_cv.notify_all()  # release the linger flusher
+        try:
+            self._flush_push_batches()  # don't strand queued pushes
+        except Exception as e:  # raylint: allow(swallow) teardown
+            logger.debug("shutdown push-batch flush failed: %s", e)
         self.server.close()
         self.pool.close_all()
+        self._data_streams.close_all()
         try:
             self.state.close()
         except Exception as e:
@@ -880,6 +997,11 @@ class DistributedRuntime(Runtime):
 
     # --------------------------------------------------------- object plane
 
+    # get_objects() overlaps blocking resolutions here: remote fetches
+    # (striped across the data-stream pool) and pushed-task waits gain
+    # real parallelism on the wire.
+    _concurrent_get = True
+
     def put_object(self, value: Any, owner_node: Optional[Node] = None) -> ObjectID:
         oid = super().put_object(value, owner_node=self.local_node)
         self._owner_addr[oid] = self.address
@@ -996,8 +1118,11 @@ class DistributedRuntime(Runtime):
                 raise err
             if value is not _FETCH_MISS:
                 # Cache locally + advertise (pull-through caching like the
-                # reference's local plasma copy after a pull).
-                self.local_node.store.put(oid, value)
+                # reference's local plasma copy after a pull). A striped
+                # fetch sealed the frame into the store already — put()
+                # would re-serialize the value it just decoded.
+                if not self.local_node.store.contains(oid):
+                    self.local_node.store.put(oid, value)
                 with self.lock:
                     self.object_locations[oid] = self.local_node.node_id
                 self._location_hints[oid] = addr
@@ -1012,11 +1137,17 @@ class DistributedRuntime(Runtime):
     def _fetch_from(self, addr: str, oid: ObjectID):
         """Pull of a pickled object. Same-host owners serve through the
         shared arena (one shm read, zero payload bytes on the wire);
-        otherwise chunked TCP with ALL remaining chunks requested
-        concurrently after the first reply reveals total_size (the
-        reference chunk-parallelizes transfers the same way,
-        ``object_manager.cc`` pull chunking) — sequential
-        request-per-chunk pays a full round trip of dead air per 8 MB.
+        otherwise chunked TCP: a small probe request reveals total_size,
+        then ALL remaining chunks are requested concurrently, STRIPED
+        round-robin across the peer's data-stream pool so a multi-GB pull
+        is not serialized behind one socket's reader thread (the reference
+        chunk-parallelizes transfers the same way, ``object_manager.cc``
+        pull chunking). Chunks recv_into the object's final resting place
+        — a store recv buffer (native arena when it fits) — and the store
+        serves the sealed frame in place: zero reassembly copies, no
+        decode+re-pickle on landing. A failed stream's chunks retry on the
+        surviving/replenished streams (backoff-bounded), so one mid-
+        transfer reset does not fail the pull.
         Returns (value | _FETCH_MISS, error_or_none)."""
         if chaos.ENABLED:
             try:
@@ -1027,6 +1158,7 @@ class DistributedRuntime(Runtime):
                 raise RpcConnectionError(str(e)) from e
         client = self.pool.get(addr)
         arena_key = self.host_arena_key
+        chunk_sz = _fetch_chunk()
         first_box: Dict[str, bytearray] = {}
 
         def _first_sink(n):
@@ -1038,7 +1170,7 @@ class DistributedRuntime(Runtime):
             rep.ParseFromString(client.call(
                 pb.FETCH_OBJECT, pb.FetchObjectRequest(
                     object_id=oid.binary(), offset=0,
-                    max_bytes=FETCH_CHUNK,
+                    max_bytes=min(_FETCH_PROBE_BYTES, chunk_sz),
                     arena_key=arena_key).SerializeToString(),
                 timeout=120, raw_sink=_first_sink).body)
             if not rep.found:
@@ -1061,56 +1193,110 @@ class DistributedRuntime(Runtime):
         if rep.eof or len(first) >= total:
             value, _ = _loads_framed(first)
             return value, None
-        data = bytearray(total)
-        data[:len(first)] = first
-        offsets = list(range(len(first), total, FETCH_CHUNK))
-        state = {"left": len(offsets), "error": None}
-        state_lock = threading.Lock()  # NOT self.lock: cbs run on the
-        done = threading.Event()       # reader thread — keep them tiny
-
-        def _chunk_cb(off):
-            def cb(env, error):
+        # Destination. With data streams available the bytes land in a
+        # store recv buffer (sealed in place at the end — the fetched
+        # object is never re-serialized). Arena-dest sinks are handed ONLY
+        # to stream connections we own: on failure we close them and join
+        # their readers before reclaiming the slot, a guarantee the shared
+        # control connection cannot give.
+        store = self.local_node.store
+        streams = self._data_streams.clients(addr)
+        dest = store.create_recv_buffer(oid, total) if streams else None
+        if dest is None:
+            if store.contains(oid):  # sealed while we probed
                 try:
-                    if error is None:
-                        crep = pb.FetchObjectReply()
-                        crep.ParseFromString(env.body)
-                        if not crep.found:
-                            error = RpcRemoteError(
-                                f"object {oid} vanished mid-fetch")
-                        elif crep.data:
-                            # pre-raw-lane peer: bytes came in the proto
-                            data[off:off + len(crep.data)] = crep.data
-                except Exception as e:  # noqa: BLE001
-                    error = e
-                with state_lock:
-                    if error is not None and state["error"] is None:
-                        state["error"] = error
-                    state["left"] -= 1
-                    if state["left"] == 0 or error is not None:
-                        done.set()
-            return cb
+                    return store.get(oid, timeout=0), None
+                except Exception as e:
+                    logger.debug("raced store read failed: %s", e)
+            heap = bytearray(total)
+            dest = memoryview(heap)
+            streams = streams or [client]
+        else:
+            heap = None
+        dest[:len(first)] = first
+        pending = list(range(len(first), total, chunk_sz))
+        backoff = BackoffPolicy(
+            deadline_s=_config.get("backoff_deadline_s")).start()
+        sealed = False
+        try:
+            while True:
+                state = {"errors": {}, "left": len(pending)}
+                state_lock = threading.Lock()  # NOT self.lock: cbs run on
+                done = threading.Event()       # reader threads; keep tiny
 
-        for off in offsets:
-            # The raw sink lands each chunk's bytes DIRECTLY in its slot
-            # of the destination buffer from the reader thread — the
-            # whole TCP pull does zero user-space payload copies here.
-            client.call_async(
-                pb.FETCH_OBJECT, pb.FetchObjectRequest(
-                    object_id=oid.binary(), offset=off,
-                    max_bytes=FETCH_CHUNK).SerializeToString(),
-                _chunk_cb(off),
-                raw_sink=lambda n, _o=off: memoryview(data)[_o:_o + n])
-        if not done.wait(timeout=120):
-            raise TimeoutError(f"chunked fetch of {oid} from {addr} "
-                               f"timed out")
-        if state["error"] is not None:
-            err = state["error"]
-            if isinstance(err, (RpcConnectionError, RpcRemoteError,
-                                TimeoutError)):
-                raise err
-            raise RpcConnectionError(str(err))
-        value, _ = _loads_framed(data)
-        return value, None
+                def _chunk_cb(off):
+                    def cb(env, error):
+                        try:
+                            if error is None:
+                                crep = pb.FetchObjectReply()
+                                crep.ParseFromString(env.body)
+                                if not crep.found:
+                                    error = RpcRemoteError(
+                                        f"object {oid} vanished mid-fetch")
+                                elif crep.data:
+                                    # pre-raw-lane peer: bytes in the proto
+                                    dest[off:off + len(crep.data)] = crep.data
+                        except Exception as e:  # noqa: BLE001
+                            error = e
+                        with state_lock:
+                            if error is not None:
+                                state["errors"][off] = error
+                            state["left"] -= 1
+                            if state["left"] == 0:
+                                done.set()
+                    return cb
+
+                for i, off in enumerate(pending):
+                    # The raw sink lands each chunk's bytes DIRECTLY in
+                    # its slot of the destination from the stream's reader
+                    # thread — zero user-space payload copies.
+                    streams[i % len(streams)].call_async(
+                        pb.FETCH_OBJECT, pb.FetchObjectRequest(
+                            object_id=oid.binary(), offset=off,
+                            max_bytes=chunk_sz).SerializeToString(),
+                        _chunk_cb(off),
+                        raw_sink=lambda n, _o=off: dest[_o:_o + n])
+                if not done.wait(timeout=120):
+                    raise TimeoutError(
+                        f"chunked fetch of {oid} from {addr} timed out")
+                errors = state["errors"]
+                if not errors:
+                    break
+                for err in errors.values():
+                    if isinstance(err, RpcRemoteError):
+                        raise err  # source lost the object: no retry helps
+                # Transport failures: retry just the missing chunks on the
+                # surviving streams (clients() replaces dead ones). The
+                # probe connection is last-resort only for heap dests.
+                pending = sorted(errors)
+                if not backoff.sleep():
+                    err = next(iter(errors.values()))
+                    if isinstance(err, (RpcConnectionError, TimeoutError)):
+                        raise err
+                    raise RpcConnectionError(str(err))
+                streams = [c for c in self._data_streams.clients(addr)
+                           if not c.closed]
+                if not streams:
+                    if heap is None:
+                        raise RpcConnectionError(
+                            f"data streams to {addr} lost mid-fetch")
+                    streams = [client]
+            if heap is None:
+                store.seal_recv_buffer(oid)
+                sealed = True
+                return store.get(oid, timeout=0), None
+            value, _ = _loads_framed(heap)
+            return value, None
+        finally:
+            if heap is None and not sealed:
+                # Quiesce our stream readers before reclaiming the slot:
+                # a late recv_into against a deleted slot would scribble
+                # over whatever the arena reuses that space for.
+                self._data_streams.drop(addr)
+                for c in streams:
+                    if c is not client:
+                        c.join_reader(timeout=5.0)
+                store.abort_recv_buffer(oid)
 
     def object_ready(self, oid: ObjectID) -> bool:
         if self.local_node.store.contains(oid):
@@ -1404,24 +1590,32 @@ class DistributedRuntime(Runtime):
             msg.method_name = spec.method_name or ""
         else:
             msg.fn_hash = self._export_callable(spec.function)
-        self._pin_collect.pins = []
-        try:
-            msg.args_pickle = cloudpickle.dumps((spec.args, spec.kwargs))
-            arg_pins = self._pin_collect.pins
-        except BaseException:
-            # Nothing ever reaches a receiver: release what we pinned.
-            for oid in self._pin_collect.pins or []:
-                self.reference_counter.unpin_for_task(oid)
-            raise
-        finally:
-            self._pin_collect.pins = None
+        if not spec.args and not spec.kwargs:
+            # The commonest hot-loop shape (f.remote() with no args):
+            # skip the pickler entirely — no refs, no pins.
+            msg.args_pickle = _EMPTY_ARGS_PICKLE
+            arg_pins = []
+        else:
+            self._pin_collect.pins = []
+            try:
+                msg.args_pickle = cloudpickle.dumps((spec.args, spec.kwargs))
+                arg_pins = self._pin_collect.pins
+            except BaseException:
+                # Nothing ever reaches a receiver: release what we pinned.
+                for oid in self._pin_collect.pins or []:
+                    self.reference_counter.unpin_for_task(oid)
+                raise
+            finally:
+                self._pin_collect.pins = None
         for k, v in spec.options.resources.to_dict().items():
             msg.resources.amounts[k] = v
         if spec.options.runtime_env:
             msg.runtime_env_json = json.dumps(
                 spec.options.runtime_env).encode()
         re = spec.options.retry_exceptions
-        if re not in (False, None):
+        if re is True:
+            msg.retry_exceptions_pickle = _RETRY_ALL_PICKLE
+        elif re not in (False, None):
             msg.retry_exceptions_pickle = cloudpickle.dumps(re)
         pg = spec.options.placement_group
         if pg is not None:
@@ -1504,8 +1698,11 @@ class DistributedRuntime(Runtime):
 
     def _push_task_remote(self, spec: TaskSpec, addr: str, cancel,
                           method: int = pb.PUSH_TASK, alloc=None,
-                          batched: bool = False):
-        msg, arg_pins = self._spec_to_msg(spec)
+                          batched: bool = False, premsg=None):
+        # ``premsg``: (msg, arg_pins) built by the caller BEFORE taking a
+        # per-actor lock — serialization must not run under rec.lock, or
+        # every actor call pays its neighbours' pickling time.
+        msg, arg_pins = premsg if premsg is not None else self._spec_to_msg(spec)
         # The re-serialization above re-pinned every arg ref; the previous
         # attempt's pins (held across the pending-queue wait) can go now.
         stale = getattr(spec, "_stale_arg_pins", None)
@@ -1596,7 +1793,51 @@ class DistributedRuntime(Runtime):
                     client.fail_pending([s for s, _ in pairs], e)
 
     def _flush_dispatch_batches(self):
-        self._flush_push_batches()
+        """Dispatch-pass hook: with a linger configured, queued pushes are
+        NOT shipped inline — a deadline is stamped and the flusher thread
+        sends one coalesced frame per daemon when it expires. A burst of
+        inline dispatches (each of which calls this hook) therefore pays
+        one syscall per linger window, not one per task; a lone task waits
+        at most ``task_push_flush_ms``. Oversized groups still flush
+        synchronously from ``_push_task_remote`` (>= 128 queued)."""
+        linger = float(_config.get("task_push_flush_ms") or 0.0)
+        if linger <= 0:
+            self._flush_push_batches()
+            return
+        with self._push_batch_lock:
+            if not any(self._push_batch.values()):
+                return
+        with self._push_flush_cv:
+            if self._push_flush_due is None:
+                self._push_flush_due = time.monotonic() + linger / 1000.0
+            if self._push_flusher is None or not self._push_flusher.is_alive():
+                self._push_flusher = threading.Thread(
+                    target=self._push_flush_loop, name="push-flush",
+                    daemon=True)
+                self._push_flusher.start()
+            self._push_flush_cv.notify()
+
+    def _push_flush_loop(self):
+        while not self._shutdown:
+            with self._push_flush_cv:
+                while self._push_flush_due is None and not self._shutdown:
+                    self._push_flush_cv.wait(timeout=0.5)
+                if self._shutdown:
+                    break
+                delay = self._push_flush_due - time.monotonic()
+                if delay > 0:
+                    self._push_flush_cv.wait(timeout=delay)
+                    continue  # re-check: the deadline may have been re-armed
+                self._push_flush_due = None
+            try:
+                self._flush_push_batches()
+            except Exception:  # defensive: the flusher must survive
+                logger.exception("lingered push-batch flush failed")
+        # Drain on shutdown so no queued push strands its pending reply.
+        try:
+            self._flush_push_batches()
+        except Exception as e:  # raylint: allow(swallow) teardown
+            logger.debug("final push-batch flush failed: %s", e)
 
     def _settle_view_alloc(self, info, credit: bool):
         """Settle one push attempt's optimistic view debit, exactly once.
@@ -2066,9 +2307,11 @@ class DistributedRuntime(Runtime):
         for oid in _ref_ids_in(spec.args, spec.kwargs):
             self.reference_counter.pin_for_task(oid)
         spec.actor_id = actor_id
+        premsg = self._spec_to_msg(spec)  # pickle OUTSIDE rec.lock: calls
+        # to one actor must not serialize their neighbours' encoding time
         with rec.lock:  # order with any in-flight mailbox handoff
             self._push_task_remote(spec, rec.address, cancel,
-                                   method=pb.ACTOR_CALL)
+                                   method=pb.ACTOR_CALL, premsg=premsg)
         return list(spec.return_ids)
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
@@ -2274,8 +2517,11 @@ class DistributedRuntime(Runtime):
             key = (req.group, req.src_rank, req.dst_rank, req.p2p_seq)
             now = time.monotonic()
             with self._p2p_cv:
+                # ctx.raw is a fresh per-frame buffer: take ownership of
+                # the tensor bytes instead of copying them (np.frombuffer
+                # reads a bytearray just as well)
                 self._p2p_box[key] = (req.dtype, tuple(req.shape),
-                                      bytes(ctx.raw or b""), now)
+                                      ctx.raw or b"", now)
                 # TTL sweep: deliveries whose recv timed out (the
                 # receiver's seq counter has moved past them) would
                 # otherwise pin full tensors in memory forever.
@@ -2723,15 +2969,18 @@ class DistributedRuntime(Runtime):
             return
         ctx.reply(pb.CreateActorReply(status="ok").SerializeToString())
 
-    def _serialized_for_fetch(self, oid: ObjectID) -> Optional[bytes]:
+    def _serialized_for_fetch(self, oid: ObjectID) -> FramedPayload:
         """Serialize once per object for chunked pulls (small MRU cache so a
-        multi-chunk fetch doesn't re-pickle per chunk)."""
+        multi-chunk fetch doesn't re-pickle per chunk). The payload is a
+        ``FramedPayload``: array bytes stay in their source buffers and
+        each served chunk leaves as a scatter-gather list — serving a
+        multi-GB object never materializes the frame."""
         with self._fetch_cache_lock:
             hit = self._fetch_cache.get(oid)
             if hit is not None:
                 return hit[0]
         value = self.local_node.store.get(oid, timeout=0)
-        payload = _dumps_framed(value)
+        payload = FramedPayload(value)
         with self._fetch_cache_lock:
             self._fetch_cache[oid] = [payload, None]
             while len(self._fetch_cache) > 8:
@@ -2846,21 +3095,30 @@ class DistributedRuntime(Runtime):
                     and nid in self._view and self._view[nid].alive]
 
     def _handle_push_object(self, ctx: RpcContext):
-        """Receiver half of the push path: chunks accumulate per object;
-        at EOF the value lands in the local store exactly like a completed
-        pull (location advertised), so the executor resolves it locally."""
+        """Receiver half of the push path: chunks land DIRECTLY in the
+        object's final resting place (an unsealed store recv buffer — the
+        native arena when it fits); at EOF the buffer seals and the store
+        serves the framed payload in place, exactly like a completed pull
+        (location advertised), so the executor resolves it locally. No
+        BytesIO accumulation, no decode+re-pickle round trip."""
         req = pb.PushObjectRequest()
         req.ParseFromString(ctx.body)
         oid = ObjectID(req.object_id)
         rep = pb.PushObjectReply(accepted=True)
         store = self.local_node.store
+
+        def _drop_locked(o):
+            if self._incoming_pushes.pop(o, None) is not None:
+                store.abort_recv_buffer(o)
+            self._incoming_push_seen.pop(o, None)
+
         if store.contains(oid):
             rep.accepted = False
             with self._incoming_pushes_lock:
-                self._incoming_pushes.pop(oid, None)
-                self._incoming_push_seen.pop(oid, None)
+                _drop_locked(oid)
             ctx.reply(rep.SerializeToString())
             return
+        chunk = req.data or ctx.raw or b""
         done = False
         now = time.monotonic()
         with self._incoming_pushes_lock:
@@ -2868,35 +3126,48 @@ class DistributedRuntime(Runtime):
             # they must not accumulate for the daemon's lifetime
             for stale in [o for o, t in self._incoming_push_seen.items()
                           if now - t > 60.0]:
-                self._incoming_pushes.pop(stale, None)
-                self._incoming_push_seen.pop(stale, None)
-            buf = self._incoming_pushes.get(oid)
-            if buf is None:
-                buf = self._incoming_pushes[oid] = io.BytesIO()
-            self._incoming_push_seen[oid] = now
-            if req.offset != buf.tell():
-                if req.offset == 0:   # sender restarted
-                    buf.seek(0)
-                    buf.truncate()
-                else:                 # out-of-order: abandon this stream
-                    self._incoming_pushes.pop(oid, None)
-                    self._incoming_push_seen.pop(oid, None)
+                _drop_locked(stale)
+            rec = self._incoming_pushes.get(oid)  # [dest_view, filled]
+            if rec is None:
+                if req.offset != 0:   # mid-stream chunk of a dead stream
                     rep.accepted = False
                     ctx.reply(rep.SerializeToString())
                     return
-            buf.write(req.data)
+                dest = store.create_recv_buffer(oid, req.total_size)
+                if dest is None:      # sealed locally while we raced
+                    rep.accepted = False
+                    ctx.reply(rep.SerializeToString())
+                    return
+                rec = self._incoming_pushes[oid] = [dest, 0]
+            self._incoming_push_seen[oid] = now
+            if req.offset != rec[1]:
+                if req.offset == 0:   # sender restarted
+                    rec[1] = 0
+                else:                 # out-of-order: abandon this stream
+                    _drop_locked(oid)
+                    rep.accepted = False
+                    ctx.reply(rep.SerializeToString())
+                    return
+            n = len(chunk)
+            if rec[1] + n > len(rec[0]):
+                _drop_locked(oid)     # sender lied about total_size
+                rep.accepted = False
+                ctx.reply(rep.SerializeToString())
+                return
+            if n:
+                rec[0][rec[1]:rec[1] + n] = chunk
+                rec[1] += n
             if req.eof:
+                if rec[1] != len(rec[0]):
+                    _drop_locked(oid)  # truncated stream
+                    rep.accepted = False
+                    ctx.reply(rep.SerializeToString())
+                    return
                 self._incoming_pushes.pop(oid, None)
                 self._incoming_push_seen.pop(oid, None)
                 done = True
         if done:
-            try:
-                value, _ = _loads_framed(buf.getvalue())
-            except Exception as e:
-                logger.warning("dropping corrupt pushed object payload: %s", e)
-                ctx.reply(rep.SerializeToString())
-                return
-            store.put(oid, value)
+            store.seal_recv_buffer(oid)
             with self.lock:
                 self.object_locations[oid] = self.local_node.node_id
             try:
@@ -2948,33 +3219,20 @@ class DistributedRuntime(Runtime):
                 rep.eof = True
                 ctx.reply(rep.SerializeToString())
                 return
-        end = min(len(payload), req.offset + (req.max_bytes or FETCH_CHUNK))
+        end = min(len(payload), req.offset + (req.max_bytes or _fetch_chunk()))
         rep.eof = end >= len(payload)
-        # Bulk lane: the chunk leaves via gather-write straight from the
-        # cached serialization — no per-chunk slice copy, no protobuf
-        # copy (rep.data stays empty; raw_len announces the bytes).
-        ctx.reply(rep.SerializeToString(),
-                  raw=memoryview(payload)[req.offset:end])
+        # Bulk lane: the chunk leaves via gather-write (sendmsg) straight
+        # from the source buffers of the cached FramedPayload — no slice
+        # copy, no frame materialization, no protobuf copy (rep.data stays
+        # empty; raw_len announces the bytes).
+        ctx.reply(rep.SerializeToString(), raw=payload.slices(req.offset, end))
 
 
 _FETCH_MISS = object()
 
-# ---------------------------------------------------------------------------
-# Framed out-of-band serialization (pickle protocol 5).
-#
-# The reference gets zero-copy numpy out of plasma by pinning arrays in shm
-# (serialization.py + plasma). Same idea here: large array payloads are
-# pickled with out-of-band buffers and laid out in a frame —
-#
-#   MAGIC  u32 idx_len  idx(header_len, nbuf, buf_lens...)  header
-#   [64-aligned buffer 0] [64-aligned buffer 1] ...
-#
-# — so the ENCODE side copies each array exactly once (into the frame) and
-# the DECODE side copies nothing: arrays are reconstructed backed by views
-# into the received frame (a TCP blob, or pinned shared-arena pages).
-# ---------------------------------------------------------------------------
-
-_FRAME_MAGIC = b"RTF5"
+# Framed out-of-band serialization lives in framing.py (RTF5 layout,
+# shared with object_store.py's arena receive slots). Only the arena
+# pin-release finalizer is local.
 
 
 def _release_arena_pin(arena, key: bytes):
@@ -2983,63 +3241,6 @@ def _release_arena_pin(arena, key: bytes):
     except Exception as e:
         logger.debug("arena pin release failed: %s", e)
         pass  # arena closed/shutdown: the pin died with the connection
-
-
-def _frame_layout(header_len: int, buf_lens: List[int]):
-    idx = _struct.pack(f">II{len(buf_lens)}Q", header_len, len(buf_lens),
-                       *buf_lens)
-    header_off = 4 + 4 + len(idx)
-    off = (header_off + header_len + 63) & ~63
-    buf_offs = []
-    for ln in buf_lens:
-        buf_offs.append(off)
-        off = (off + ln + 63) & ~63
-    return off, header_off, buf_offs, idx
-
-
-def _dumps_framed(value: Any) -> bytes:
-    """Serialize into one framed payload (single copy per array)."""
-    pbufs: List[Any] = []
-    header = cloudpickle.dumps(value, protocol=5,
-                               buffer_callback=pbufs.append)
-    raws = []
-    for b in pbufs:
-        try:
-            raws.append(b.raw())
-        except Exception:  # raylint: allow(swallow) raw() raises for non-contiguous buffers by contract; materialize instead
-            raws.append(memoryview(bytes(b)))
-    total, hoff, boffs, idx = _frame_layout(len(header),
-                                            [r.nbytes for r in raws])
-    out = bytearray(total)
-    out[0:4] = _FRAME_MAGIC
-    out[4:8] = _struct.pack(">I", len(idx))
-    out[8:8 + len(idx)] = idx
-    out[hoff:hoff + len(header)] = header
-    for off, r in zip(boffs, raws):
-        out[off:off + r.nbytes] = r
-    # returned as the bytearray itself — bytes(out) would duplicate the
-    # whole frame; consumers slice per-chunk (and bytes() those slices
-    # where the wire needs real bytes)
-    return out
-
-
-def _loads_framed(view) -> Tuple[Any, bool]:
-    """Decode a frame from ``view`` (bytes or memoryview).
-
-    Returns ``(value, zero_copy)``: when ``zero_copy`` the value's arrays
-    reference ``view`` directly — the caller must keep the backing alive
-    (and pinned, for arena pages) for the value's lifetime."""
-    mv = memoryview(view).toreadonly()  # sealed objects are immutable —
-    # a writable view into shared arena pages must never leak to users
-    if bytes(mv[:4]) != _FRAME_MAGIC:
-        return pickle.loads(mv), False  # legacy plain-pickle payload
-    (idx_len,) = _struct.unpack(">I", mv[4:8])
-    header_len, nbuf = _struct.unpack_from(">II", mv, 8)
-    buf_lens = list(_struct.unpack_from(f">{nbuf}Q", mv, 16))
-    _, hoff, boffs, _ = _frame_layout(header_len, buf_lens)
-    header = bytes(mv[hoff:hoff + header_len])
-    buffers = [mv[off:off + ln] for off, ln in zip(boffs, buf_lens)]
-    return pickle.loads(header, buffers=buffers), nbuf > 0
 
 
 class _PushManager:
@@ -3084,42 +3285,47 @@ class _PushManager:
     def _run(self, addr: str, oid: ObjectID, threshold: int):
         try:
             payload = self.rt._serialized_for_fetch(oid)
-            if len(payload) < threshold:
+            total = len(payload)
+            if total < threshold:
                 return
             client = self.rt.pool.get(addr)
+            chunk_sz = _fetch_chunk()
             offset = 0
-            while offset < len(payload) or offset == 0:
+            while offset < total or offset == 0:
                 if chaos.ENABLED and chaos.inject(
                         "object.push", peer=addr,
                         object=oid.hex()[:8]) == "drop":
                     return  # abandon the push; pull path authoritative
-                chunk = bytes(payload[offset:offset + FETCH_CHUNK])
-                eof = offset + len(chunk) >= len(payload)
+                end = min(total, offset + chunk_sz)
+                n = end - offset
+                eof = end >= total
                 with self._cv:
                     while (not self._closed
-                           and self._inflight.get(addr, 0) + len(chunk)
-                           > self.window
+                           and self._inflight.get(addr, 0) + n > self.window
                            and self._inflight.get(addr, 0) > 0):
                         self._cv.wait(timeout=1.0)
                     if self._closed:
                         return
-                    self._inflight[addr] = (self._inflight.get(addr, 0)
-                                            + len(chunk))
+                    self._inflight[addr] = self._inflight.get(addr, 0) + n
                 try:
                     rep = pb.PushObjectReply()
+                    # Chunk rides the bulk lane as a gather list straight
+                    # from the payload's source buffers — no slice copy,
+                    # no protobuf copy (data stays empty).
                     rep.ParseFromString(client.call(
                         pb.PUSH_OBJECT, pb.PushObjectRequest(
                             object_id=oid.binary(), offset=offset,
-                            total_size=len(payload), data=chunk,
-                            eof=eof).SerializeToString(), timeout=120).body)
+                            total_size=total,
+                            eof=eof).SerializeToString(), timeout=120,
+                        raw=payload.slices(offset, end)).body)
                 finally:
                     with self._cv:
                         self._inflight[addr] = max(
-                            0, self._inflight.get(addr, 0) - len(chunk))
+                            0, self._inflight.get(addr, 0) - n)
                         self._cv.notify_all()
                 if not rep.accepted:
                     return  # receiver already has it
-                offset += len(chunk)
+                offset = end
                 if eof:
                     self.rt.breakers.record_success(addr)
                     return

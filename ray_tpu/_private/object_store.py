@@ -27,6 +27,7 @@ import os
 import pickle
 import threading
 import time
+import weakref
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -34,9 +35,23 @@ import cloudpickle
 
 from ray_tpu import chaos
 from ray_tpu._private.config import _config
+from ray_tpu._private.framing import loads_framed
 from ray_tpu._private.ids import ObjectID
 
+# raylint: hot-path  (payload plane: R8 flags hidden payload copies)
 logger = logging.getLogger("ray_tpu")
+
+
+def _release_native_pin(native, oids: dict, key: bytes):
+    """Finalizer for zero-copy framed reads: drop the read pin; if the
+    entry was free()d while views kept it pinned, reap the arena slot now
+    (Delete refuses pinned objects, so free() could not)."""
+    try:
+        native.release(key)
+        if key not in oids:
+            native.delete(key)
+    except Exception as e:  # raylint: allow(swallow) interpreter/arena teardown: the pin died with the mapping
+        logger.debug("native pin release failed: %s", e)
 
 
 def _is_device_array(value: Any) -> bool:
@@ -70,6 +85,7 @@ class _Entry:
     spill_path: Optional[str] = None
     pin_count: int = 0
     native: bool = False  # payload lives in the C++ arena, data is None
+    framed: bool = False  # payload is an RTF5 frame (remote recv landing)
     last_access: float = field(default_factory=time.monotonic)
     sealed: threading.Event = field(default_factory=threading.Event)
 
@@ -95,6 +111,9 @@ class ObjectStore:
         # dict keeps only descriptors. Heap fallback if g++ is missing.
         self._native = None
         self._native_oids: Dict[bytes, ObjectID] = {}
+        # Unsealed remote-receive destinations: oid -> (arena_key|None,
+        # size, heap_buf|None). Invisible to readers until sealed.
+        self._recv_bufs: Dict[ObjectID, tuple] = {}
         if _config.get("use_native_object_store"):
             try:
                 from ray_tpu._native import NativeObjectStore
@@ -209,6 +228,96 @@ class ObjectStore:
             if object_id not in self._entries:
                 self._entries[object_id] = _Entry(kind=KIND_PICKLED)
 
+    # -- remote receive landing (zero-copy data plane) ------------------------
+
+    def create_recv_buffer(self, object_id: ObjectID,
+                           size: int) -> Optional[memoryview]:
+        """Writable destination for a remote framed (RTF5) payload: the
+        network layer recv_into's chunks DIRECTLY into the object's final
+        resting place — an unsealed native arena slot when the arena can
+        hold it, else a heap bytearray — so a pull/push lands with zero
+        reassembly copies and no re-serialization on ``put``.
+
+        Invisible to readers until :meth:`seal_recv_buffer`; a failed
+        transfer calls :meth:`abort_recv_buffer` and leaves no trace.
+        Returns None when the object is already sealed locally OR another
+        transfer holds a recv buffer for it (aborting under that writer's
+        live view would dangle it into reusable arena space)."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is not None and entry.sealed.is_set():
+                return None
+            if object_id in self._recv_bufs:
+                return None
+            if (self._native is not None
+                    and size >= _config.get("native_store_min_object_bytes")):
+                key = self._native_key(object_id)
+                for _ in range(2):
+                    try:
+                        view = self._native.create(key, size)
+                        if view is None:
+                            # stale sealed slot from an aborted ancestor:
+                            # replace it (content may differ per attempt)
+                            self._native.delete(key)
+                            view = self._native.create(key, size)
+                        if view is not None:
+                            self._recv_bufs[object_id] = (key, size, None)
+                            return view
+                        break
+                    except MemoryError:
+                        if not self._evict_native_locked(size):
+                            break
+            buf = bytearray(size)
+            self._recv_bufs[object_id] = (None, size, buf)
+            return memoryview(buf)
+
+    def seal_recv_buffer(self, object_id: ObjectID) -> None:
+        """Publish a fully-received framed payload as a sealed entry.
+        ``get()`` decodes it lazily — zero-copy views straight out of the
+        arena pages (or the heap buffer) with no intermediate pickle."""
+        with self._lock:
+            rec = self._recv_bufs.pop(object_id, None)
+            if rec is None:
+                return
+            key, size, heap = rec
+            existing = self._entries.get(object_id)
+            if existing is not None and existing.sealed.is_set():
+                if key is not None:  # raced a local put: ours is redundant
+                    self._native.seal(key)
+                    self._native.delete(key)
+                return
+            entry = _Entry(kind=KIND_PICKLED, size_bytes=size, framed=True)
+            if key is not None:
+                self._native.seal(key)
+                self._native_oids[key] = object_id
+                entry.native = True
+            else:
+                entry.data = heap
+            if existing is not None:
+                entry.sealed = existing.sealed
+            self._entries[object_id] = entry
+            self._host_bytes += size
+            entry.sealed.set()
+            self._maybe_spill_locked()
+
+    def abort_recv_buffer(self, object_id: ObjectID) -> None:
+        """Discard a half-landed transfer (sender died / fetch failed).
+        The slot was never sealed, so no reader ever observed it."""
+        with self._lock:
+            self._abort_recv_locked(object_id)
+
+    def _abort_recv_locked(self, object_id: ObjectID) -> None:
+        rec = self._recv_bufs.pop(object_id, None)
+        if rec is None or rec[0] is None:
+            return
+        key = rec[0]
+        try:
+            # Delete refuses unsealed slots (create-pin); seal first.
+            self._native.seal(key)
+            self._native.delete(key)
+        except Exception as e:  # raylint: allow(swallow) abort is best-effort; an orphan slot is LRU-evictable once sealed
+            logger.debug("recv-buffer abort failed: %s", e)
+
     def _build_entry(self, value: Any) -> _Entry:
         if _is_device_array(value):
             # Sharded jax.Array: store the descriptor; bytes live in HBM.
@@ -255,17 +364,35 @@ class ObjectStore:
                 raise entry.data
             if entry.kind == KIND_PICKLED:
                 if entry.native:
-                    # Zero-copy read: unpickle straight out of the pinned
-                    # arena buffer (loads copies what it keeps).
                     key = self._native_key(object_id)
                     view = self._native.get(key)
                     if view is None:
                         raise ObjectLostError(f"{object_id} lost from arena")
+                    if entry.framed:
+                        # Framed (RTF5) payload: arrays decode as views into
+                        # the arena pages — keep the slot pinned until the
+                        # last such view dies.
+                        value, zero_copy = loads_framed(view)
+                        if zero_copy:
+                            try:
+                                weakref.finalize(view.obj, _release_native_pin,
+                                                 self._native, self._native_oids,
+                                                 key)
+                            except TypeError:
+                                pass  # unfinalizable backing: stay pinned
+                        else:
+                            view.release()
+                            self._native.release(key)
+                        return value
+                    # Plain pickle: loads copies what it keeps.
                     try:
                         return cloudpickle.loads(view)
                     finally:
                         view.release()
                         self._native.release(key)
+                if entry.framed:
+                    value, _ = loads_framed(entry.data)
+                    return value
                 return cloudpickle.loads(entry.data)
             return entry.data  # device array or read-only numpy view
 

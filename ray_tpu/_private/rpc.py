@@ -27,6 +27,7 @@ from ray_tpu import chaos
 from ray_tpu._private.config import _config
 from ray_tpu.protocol import pb
 
+# raylint: hot-path  (payload plane: R8 flags hidden payload copies)
 logger = logging.getLogger("ray_tpu")
 
 MAX_FRAME = 1 << 31  # 2 GiB hard cap per frame
@@ -84,33 +85,46 @@ def frame_bytes(env: pb.Envelope) -> bytes:
     return _LEN.pack(len(payload)) + payload
 
 
+_IOV_GROUP = 512  # stay under IOV_MAX (1024 on Linux) per sendmsg
+
+
+def _sendmsg_all(sock: socket.socket, pieces: list) -> None:
+    """Drain a gather list fully (sendmsg may stop at any boundary)."""
+    while pieces:
+        sent = sock.sendmsg(pieces[:_IOV_GROUP])
+        while pieces and sent >= len(pieces[0]):
+            sent -= len(pieces[0])
+            pieces.pop(0)
+        if pieces and sent:
+            pieces[0] = pieces[0][sent:]
+
+
 def send_frame(sock: socket.socket, env: pb.Envelope,
                raw=None) -> None:
     """Write one frame with scatter-gather IO: the length prefix and the
     serialized envelope go out in one sendmsg, WITHOUT concatenating (the
     concat would copy every multi-MB payload a second time).
 
-    ``raw`` (bytes-like) rides the bulk lane: ``env.raw_len`` announces
-    it, and its bytes follow the envelope frame in the SAME gather write
-    — zero user-space copies of the payload on this side, and the
-    receiver recv_into's it straight into its destination buffer."""
-    raw_mv = None
+    ``raw`` rides the bulk lane: ``env.raw_len`` announces it, and its
+    bytes follow the envelope frame in the SAME gather write — zero
+    user-space copies of the payload on this side, and the receiver
+    recv_into's it straight into its destination buffer. ``raw`` may be
+    one bytes-like OR a list/tuple of bytes-likes: a scattered payload
+    (e.g. pickle-5 out-of-band buffers still living in their source
+    arrays) ships without ever being assembled contiguously."""
+    raw_mvs = []
     if raw is not None:
         # byte-cast FIRST: len() of a structured memoryview counts
         # ELEMENTS of its first dimension, not bytes
-        raw_mv = memoryview(raw).cast("B")
-        env.raw_len = len(raw_mv)
+        if isinstance(raw, (list, tuple)):
+            raw_mvs = [memoryview(r).cast("B") for r in raw]
+        else:
+            raw_mvs = [memoryview(raw).cast("B")]
+        env.raw_len = sum(len(mv) for mv in raw_mvs)
     payload = env.SerializeToString()
     pieces = [memoryview(_LEN.pack(len(payload))), memoryview(payload)]
-    if raw_mv is not None and len(raw_mv):
-        pieces.append(raw_mv)
-    while pieces:
-        sent = sock.sendmsg(pieces)
-        while pieces and sent >= len(pieces[0]):
-            sent -= len(pieces[0])
-            pieces.pop(0)
-        if pieces and sent:
-            pieces[0] = pieces[0][sent:]
+    pieces.extend(mv for mv in raw_mvs if len(mv))
+    _sendmsg_all(sock, pieces)
 
 
 def recv_into_exact(sock: socket.socket, mv: memoryview) -> None:
@@ -120,6 +134,15 @@ def recv_into_exact(sock: socket.socket, mv: memoryview) -> None:
         if r == 0:
             raise RpcConnectionError("connection closed by peer")
         got += r
+
+
+def _set_sock_bufs(sock: socket.socket, nbytes: int) -> None:
+    """Best-effort SO_SNDBUF/SO_RCVBUF sizing (kernel clamps silently)."""
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, nbytes)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, nbytes)
+    except OSError as e:
+        logger.debug("socket buffer sizing failed: %s", e)
 
 
 class _Pending:
@@ -138,7 +161,8 @@ class RpcClient:
     def __init__(self, address: str, connect_timeout: Optional[float] = None,
                  on_push: Optional[Callable[[pb.Envelope], None]] = None,
                  on_close: Optional[Callable[[Exception], None]] = None,
-                 auth_token: Optional[bytes] = None):
+                 auth_token: Optional[bytes] = None,
+                 sock_buf_bytes: int = 0):
         host, port = address.rsplit(":", 1)
         self.address = address
         if connect_timeout is None:
@@ -153,6 +177,13 @@ class RpcClient:
                 f"connect to {address} failed: {e}") from e
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if sock_buf_bytes > 0:
+            # Data-plane connections size their kernel buffers to the
+            # transfer chunk so one chunk stays in flight per stream
+            # (defaults keep the first RTTs window-limited). Linux
+            # auto-tunes past the initial SO_RCVBUF only when it is NOT
+            # set explicitly, so this is opt-in per connection.
+            _set_sock_bufs(self._sock, sock_buf_bytes)
         token = auth_token if auth_token is not None else default_auth_token()
         if token:
             # First frame of every connection: prove membership. The server
@@ -228,10 +259,12 @@ class RpcClient:
     def call_async(self, method: int, body: bytes,
                    callback: Callable[[Optional[pb.Envelope],
                                        Optional[Exception]], None],
-                   raw_sink=None) -> None:
+                   raw_sink=None, raw=None) -> None:
         """Fire a request; invoke ``callback(reply, None)`` or
         ``callback(None, error)`` from the reader thread when done.
-        ``raw_sink`` as in :meth:`call` — filled before the callback."""
+        ``raw_sink`` as in :meth:`call` — filled before the callback.
+        ``raw``: bulk-lane payload (one bytes-like or a gather list)
+        shipped with the request, no protobuf copy."""
         pending = _Pending()
         pending.callback = callback  # type: ignore[attr-defined]
         pending.raw_sink = raw_sink
@@ -244,11 +277,48 @@ class RpcClient:
             seq = self._seq
             self._pending[seq] = pending
         try:
-            self._send(pb.Envelope(seq=seq, method=method, body=body))
+            self._send(pb.Envelope(seq=seq, method=method, body=body),
+                       raw=raw)
         except Exception as e:
             with self._plock:
                 self._pending.pop(seq, None)
             callback(None, e)
+
+    def call_burst(self, items, callback) -> None:
+        """Ship MANY small requests in ONE gather write (one syscall, one
+        chaos site, one lock acquisition) — the control-plane batching
+        primitive. ``items``: list of ``(method, body)``;
+        ``callback(index, reply_env, error)`` fires per item from the
+        reader thread as the peer answers each seq. Frames go out in list
+        order on this single connection, so a peer that processes frames
+        per-connection in order (the state service's epoll loop) observes
+        the ops in exactly the order they were enqueued."""
+        pendings = []
+        with self._plock:
+            if self._closed:
+                err = RpcConnectionError(
+                    f"connection to {self.address} is closed")
+                for i in range(len(items)):
+                    callback(i, None, err)
+                return
+            for i, _ in enumerate(items):
+                self._seq += 1
+                pending = _Pending()
+                pending.callback = (
+                    lambda env, error, _i=i: callback(_i, env, error))
+                self._pending[self._seq] = pending
+                pendings.append(self._seq)
+        # Tiny control bodies: one contiguous buffer beats a long iovec.
+        buf = bytearray()
+        for seq, (method, body) in zip(pendings, items):
+            payload = pb.Envelope(seq=seq, method=method,
+                                  body=body).SerializeToString()
+            buf += _LEN.pack(len(payload))
+            buf += payload
+        try:
+            self._send_bytes(buf)
+        except Exception as e:
+            self.fail_pending(pendings, e)
 
     def send_oneway(self, method: int, body: bytes = b"") -> None:
         self._send(pb.Envelope(seq=0, method=method, body=body))
@@ -287,6 +357,13 @@ class RpcClient:
     def close(self):
         self._shutdown(RpcConnectionError("closed locally"))
 
+    def join_reader(self, timeout: Optional[float] = None) -> None:
+        """Wait for the reader thread to exit (after close): once it has,
+        no raw sink handed to this connection can be written again —
+        required before reclaiming a sink's destination buffer."""
+        if self._reader is not threading.current_thread():
+            self._reader.join(timeout)
+
     @property
     def closed(self) -> bool:
         return self._closed
@@ -309,6 +386,26 @@ class RpcClient:
         with self._wlock:
             try:
                 send_frame(self._sock, env, raw=raw)
+            except OSError as e:
+                raise RpcConnectionError(
+                    f"send to {self.address} failed: {e}") from e
+
+    def _send_bytes(self, buf) -> None:
+        """Pre-framed burst write (call_burst); same chaos semantics as
+        _send — a reset kills the connection, a drop loses the burst."""
+        if chaos.ENABLED:
+            try:
+                act = chaos.inject("rpc.client.send", peer=self.address,
+                                   method="BURST")
+            except chaos.ChaosConnectionReset as e:
+                self._shutdown(e)
+                raise RpcConnectionError(
+                    f"send to {self.address} failed: {e}") from e
+            if act == "drop":
+                return
+        with self._wlock:
+            try:
+                self._sock.sendall(buf)
             except OSError as e:
                 raise RpcConnectionError(
                     f"send to {self.address} failed: {e}") from e
@@ -477,10 +574,12 @@ class RpcServer:
     def __init__(self, handler: Handler, host: str = "127.0.0.1",
                  port: int = 0, max_workers: int = 64,
                  inline_methods: Optional[set] = None,
-                 auth_token: Optional[bytes] = None):
+                 auth_token: Optional[bytes] = None,
+                 sock_buf_bytes: int = 0):
         self._handler = handler
         self._auth_token = (auth_token if auth_token is not None
                             else default_auth_token())
+        self._sock_buf_bytes = sock_buf_bytes
         # Methods handled synchronously on the connection's reader thread:
         # cheap enqueue-style handlers that need per-connection ordering
         # (actor mailbox inserts — the reference's actor sequencing queues,
@@ -531,6 +630,8 @@ class RpcServer:
             except OSError:
                 return
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self._sock_buf_bytes > 0:
+                _set_sock_bufs(sock, self._sock_buf_bytes)
             conn_id += 1
             wlock = threading.Lock()
             with self._conn_lock:

@@ -58,6 +58,133 @@ def start_state_service(port: int = 0, host: str = "127.0.0.1",
     raise TimeoutError("state service did not start listening in time")
 
 
+class _StateBatcher:
+    """Coalesces object-directory upserts into write bursts.
+
+    Every task completion and fetch landing publishes a location; at
+    thousands of tasks/s those synchronous one-op RPCs dominate the state
+    connection. Enqueued ops flush as ONE gather write (``call_burst``)
+    when ``state_batch_max`` accumulate or ``state_batch_flush_ms``
+    elapses, whichever first.
+
+    Ordering: ops serialize into frames in enqueue order and go out on one
+    connection; the state service's epoll loop processes frames
+    per-connection in order, so UPDATE→REMOVE sequences for the same
+    object are preserved. The single flusher thread retries a failed
+    burst (reconnect + resend, the ops are idempotent upserts) BEFORE
+    taking the next batch, which keeps that guarantee across a state-
+    service restart."""
+
+    def __init__(self, sc: "StateClient"):
+        self.sc = sc
+        self._cv = threading.Condition()
+        self._ops: List[Tuple[int, bytes]] = []
+        self._thread: Optional[threading.Thread] = None
+        self._inflight = 0          # ops sent, reply not yet seen
+        self._stopped = False
+        self.flushes = 0            # bursts sent (observable in tests)
+
+    def enqueue(self, method: int, body: bytes) -> None:
+        with self._cv:
+            if self._stopped:       # late op during shutdown: drop —
+                return              # a dead directory entry, not a wedge
+            self._ops.append((method, body))
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._flush_loop, daemon=True,
+                    name="state-batch")
+                self._thread.start()
+            self._cv.notify_all()
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Block until everything enqueued so far is sent AND answered —
+        the barrier synchronous readers (get_locations) use before
+        trusting the directory."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._ops or self._inflight:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(timeout=left)
+        return True
+
+    def stop(self) -> None:
+        self.flush(timeout=5.0)
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+
+    # -- internals ---------------------------------------------------------
+
+    def _flush_loop(self):
+        max_ops = max(1, int(_config.get("state_batch_max")))
+        wait_s = max(_config.get("state_batch_flush_ms"), 0.0) / 1e3
+        while True:
+            with self._cv:
+                if not self._ops:
+                    if self._stopped:
+                        return
+                    self._cv.wait(timeout=1.0)
+                    continue
+                # Linger briefly for the rest of a submission wave, but
+                # never past the latency budget.
+                if len(self._ops) < max_ops and not self._stopped:
+                    self._cv.wait_for(
+                        lambda: len(self._ops) >= max_ops or self._stopped,
+                        timeout=wait_s)
+                batch, self._ops = self._ops[:max_ops], self._ops[max_ops:]
+                self._inflight = len(batch)
+            try:
+                self._send(batch)
+            finally:
+                with self._cv:
+                    self._inflight = 0
+                    self._cv.notify_all()
+
+    def _send(self, batch):
+        """One burst; on transport failure reconnect and replay the WHOLE
+        batch once (idempotent upserts), preserving op order."""
+        for attempt in (0, 1):
+            settle = threading.Event()
+            state = {"left": len(batch), "conn_error": None}
+            lock = threading.Lock()
+
+            def _cb(_i, _env, error):
+                with lock:
+                    if (error is not None
+                            and isinstance(error, (RpcConnectionError,
+                                                   ConnectionError))
+                            and state["conn_error"] is None):
+                        state["conn_error"] = error
+                    state["left"] -= 1
+                    if state["left"] == 0:
+                        settle.set()
+            try:
+                with self.sc._client_lock:
+                    client = self.sc._client
+                client.call_burst(batch, _cb)
+            except Exception as e:
+                logger.debug("state batch send failed: %s", e)
+                with lock:
+                    state["conn_error"] = state["conn_error"] or e
+                settle.set()
+            settle.wait(timeout=30.0)
+            err = state["conn_error"]
+            self.flushes += 1
+            if err is None or attempt == 1:
+                if err is not None:
+                    logger.warning(
+                        "dropping %d batched directory ops after retry: "
+                        "%s (heartbeat re-publish will reconcile)",
+                        len(batch), err)
+                return
+            try:
+                self.sc._reconnect()
+            except Exception as e:
+                logger.debug("state batch reconnect failed: %s", e)
+
+
 class StateClient:
     """GCS-fault-tolerant client: a state-service restart (new process,
     journal-recovered tables) breaks the TCP connections — calls
@@ -81,6 +208,7 @@ class StateClient:
         # resubscription for the full call timeout
         self._handlers_lock = threading.Lock()
         self._handlers: Dict[str, List[Callable[[pb.Event], None]]] = {}
+        self._batcher = _StateBatcher(self)
         self._closed = False
 
     # ------------------------------------------------------------------ core
@@ -190,6 +318,7 @@ class StateClient:
                 self.address)
 
     def close(self):
+        self._batcher.stop()  # drain queued directory ops first
         with self._client_lock:
             self._closed = True
             self._client.close()
@@ -314,15 +443,38 @@ class StateClient:
 
     # ------------------------------------------------------ object directory
 
+    @staticmethod
+    def _batching_on() -> bool:
+        return _config.get("state_batch_flush_ms") > 0
+
     def add_location(self, object_id: bytes, node_id: bytes, size: int = 0):
-        self._call(pb.ADD_LOCATION, pb.ObjectLocRequest(
-            object_id=object_id, node_id=node_id, size=size))
+        req = pb.ObjectLocRequest(object_id=object_id, node_id=node_id,
+                                  size=size)
+        if self._batching_on():
+            self._batcher.enqueue(pb.ADD_LOCATION, req.SerializeToString())
+        else:
+            self._call(pb.ADD_LOCATION, req)
 
     def remove_location(self, object_id: bytes, node_id: bytes):
-        self._call(pb.REMOVE_LOCATION, pb.ObjectLocRequest(
-            object_id=object_id, node_id=node_id))
+        # Routed through the SAME queue as add_location: an UPDATE→REMOVE
+        # pair for one object must reach the service in order.
+        req = pb.ObjectLocRequest(object_id=object_id, node_id=node_id)
+        if self._batching_on():
+            self._batcher.enqueue(pb.REMOVE_LOCATION,
+                                  req.SerializeToString())
+        else:
+            self._call(pb.REMOVE_LOCATION, req)
+
+    def flush_locations(self, timeout: float = 10.0) -> bool:
+        """Barrier: directory ops enqueued before this call are applied
+        (or dropped after a failed retry) when it returns True."""
+        return self._batcher.flush(timeout=timeout)
 
     def get_locations(self, object_id: bytes) -> pb.GetLocationsReply:
+        if self._batching_on():
+            # Read-your-writes: a pull right after a task completes must
+            # see the completion's batched add_location.
+            self._batcher.flush(timeout=5.0)
         rep = pb.GetLocationsReply()
         rep.ParseFromString(self._call(
             pb.GET_LOCATIONS, pb.GetLocationsRequest(object_id=object_id)))

@@ -46,6 +46,10 @@ R6   proto-drift    field/enum-number drift between ``raytpu.proto`` and
 R7   bare-retry     hand-rolled retry loop: constant ``time.sleep`` inside
                     a loop that also catches exceptions (use
                     ``ray_tpu._private.backoff.BackoffPolicy``)
+R8   hidden-copy    ``bytes(<memoryview/bytearray/slice>)`` casts and
+                    ``b"".join`` chunk reassembly inside modules marked
+                    ``# raylint: hot-path`` (payload-plane copies the
+                    zero-copy data plane exists to eliminate)
 ==== ============== ====================================================
 """
 
@@ -647,6 +651,63 @@ def check_bare_retry(ctx: FileContext) -> Iterator[Finding]:
                 "catches exceptions): no jitter, cap, or deadline — use "
                 "ray_tpu._private.backoff.BackoffPolicy, or justify with "
                 "'# raylint: allow(bare-retry) <why>'")
+
+
+# --------------------------------------------------------------------------
+# R8: hidden payload copies in hot-path (bulk-transfer) modules
+
+_HOT_PATH_RE = re.compile(r"#\s*raylint:\s*hot-path")
+_BUFFERISH_CALLS = {"memoryview", "bytearray"}
+
+
+@rule("R8", "hidden-copy")
+def check_hidden_copy(ctx: FileContext) -> Iterator[Finding]:
+    """Inside a module annotated ``# raylint: hot-path`` (the payload
+    plane: rpc / object transfer / store), a ``bytes(...)`` cast of a
+    memoryview, bytearray, or slice duplicates payload bytes the zero-copy
+    framing exists to avoid — and ``b"".join(chunks)`` is the classic
+    reassembly copy (land chunks in a preallocated buffer instead).
+    Metadata-sized casts are justified with
+    ``# raylint: allow(hidden-copy) <why>``."""
+    if not _HOT_PATH_RE.search(ctx.source):
+        return
+    # File-level approximation of buffer-ish bindings: any name ever
+    # assigned from memoryview(...)/bytearray(...) counts everywhere —
+    # hot-path modules are exactly where that heuristic is accurate.
+    bufferish: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                and isinstance(node.value.func, ast.Name) \
+                and node.value.func.id in _BUFFERISH_CALLS:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    bufferish.add(t.id)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        msg = None
+        if isinstance(node.func, ast.Name) and node.func.id == "bytes" \
+                and len(node.args) == 1 and not node.keywords:
+            arg = node.args[0]
+            if isinstance(arg, ast.Subscript):
+                msg = ("bytes(<slice>) materializes a payload copy — pass "
+                       "the memoryview (or a gather list) through instead")
+            elif isinstance(arg, ast.Call) and \
+                    isinstance(arg.func, ast.Name) and \
+                    arg.func.id in _BUFFERISH_CALLS:
+                msg = (f"bytes({arg.func.id}(...)) copies the whole "
+                       f"buffer — keep the view")
+            elif isinstance(arg, ast.Name) and arg.id in bufferish:
+                msg = (f"bytes({arg.id}) copies a buffer-backed value — "
+                       f"keep the view or write into the destination")
+        elif isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "join" and \
+                isinstance(node.func.value, ast.Constant) and \
+                isinstance(node.func.value.value, bytes):
+            msg = ("b\"\".join(...) reassembles chunks through an extra "
+                   "copy — recv_into a preallocated destination instead")
+        if msg and not ctx.allowed(node.lineno, "R8", "hidden-copy"):
+            yield Finding("R8", "hidden-copy", ctx.relpath, node.lineno, msg)
 
 
 # --------------------------------------------------------------------------

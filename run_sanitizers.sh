@@ -56,6 +56,22 @@ echo "== chaos gate (core suite under a fixed delay-only fault schedule) =="
 # Seed is fixed so the perturbation is reproducible run-to-run.
 RAY_TPU_CHAOS="20260805:rpc.client.send@3%7=delay(0.02);state.heartbeat@2%3=delay(0.05);object.push@2%5=delay(0.01)" \
 JAX_PLATFORMS=cpu \
-python -m pytest tests/test_core.py tests/test_actors.py -q
+python -m pytest tests/test_core.py tests/test_actors.py tests/test_data_plane.py -q
+
+echo "== bench regression gate (bench_micro --check vs tracked baseline) =="
+# Throughput must stay within --tolerance of BENCH_MICRO.json; latency
+# (_us) metrics are inverted. Cluster metrics are skipped automatically
+# where the C++ state service can't build (no protoc) — the inproc set
+# still gates the task/actor/object hot paths.
+if python - <<'EOF'
+from ray_tpu._native.build import build_state_service
+try:
+    build_state_service()
+except Exception:
+    raise SystemExit(1)
+EOF
+then BENCH_MODE=both; else BENCH_MODE=inproc; fi
+JAX_PLATFORMS=cpu \
+python bench_micro.py --mode "$BENCH_MODE" --check BENCH_MICRO.json --tolerance 0.7
 
 echo "sanitizer pass ($KIND) complete"
