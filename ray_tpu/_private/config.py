@@ -174,6 +174,20 @@ _config.define("state_batch_flush_ms", float, 2.0,
                "max latency an enqueued directory op waits for batching; "
                "<= 0 disables batching (every op is a synchronous RPC)")
 
+# -- Checkpoint engine ----------------------------------------------------------
+_config.define("checkpoint_queue_depth", int, 2,
+               "pending async saves per checkpoint engine before save() "
+               "blocks (backpressure instead of unbounded host-copy "
+               "buffering)")
+_config.define("checkpoint_hash_verify", bool, True,
+               "re-hash every chunk on restore and fail loudly on mismatch")
+_config.define("checkpoint_shard_wait_s", float, 60.0,
+               "how long the rank-0 committer waits for the other ranks' "
+               "shard indexes before abandoning a save")
+_config.define("checkpoint_final_timeout_s", float, 10.0,
+               "per-worker deadline when collecting final checkpoints at "
+               "trainer shutdown; a dead worker forfeits its slot")
+
 # -- Host-shared object plane ---------------------------------------------------
 _config.define("arena_enabled", bool, True,
                "share one shm arena per host between daemons (fd-passing)")

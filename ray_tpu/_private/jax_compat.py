@@ -1,0 +1,21 @@
+"""Version bridge for jax APIs spelled differently across releases.
+
+``shard_map`` went top-level in jax 0.4.35, renaming the replication
+check kwarg from ``check_rep`` to ``check_vma``. Older versions only
+ship ``jax.experimental.shard_map``. Import ``shard_map`` from here and
+use the modern spelling; on old jax the kwarg is translated.
+"""
+
+from __future__ import annotations
+
+__all__ = ["shard_map"]
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, /, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(f, **kwargs)

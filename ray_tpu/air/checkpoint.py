@@ -20,11 +20,17 @@ logger = logging.getLogger("ray_tpu")
 
 class Checkpoint:
     def __init__(self, data: Optional[Dict[str, Any]] = None,
-                 directory: Optional[str] = None):
-        if (data is None) == (directory is None):
-            raise ValueError("provide exactly one of data= or directory=")
+                 directory: Optional[str] = None,
+                 manifest: Optional[Any] = None):
+        if sum(x is not None for x in (data, directory, manifest)) != 1:
+            raise ValueError(
+                "provide exactly one of data=, directory= or manifest=")
         self._data = data
         self._directory = directory
+        # A ray_tpu.checkpoint.CheckpointRef: the checkpoint lives in a
+        # content-addressed engine store; this object is a light, picklable
+        # pointer and loads lazily (elastic restore reshards at load time).
+        self._manifest = manifest
 
     # -- constructors ---------------------------------------------------------
 
@@ -37,13 +43,40 @@ class Checkpoint:
         return cls(directory=path)
 
     @classmethod
+    def from_manifest(cls, root: str,
+                      manifest_name: Optional[str] = None) -> "Checkpoint":
+        """Checkpoint backed by a committed engine manifest. With no
+        ``manifest_name`` the newest complete commit is pinned now, so the
+        reference stays stable under later saves."""
+        from ray_tpu.checkpoint import (CheckpointNotFound, CheckpointRef,
+                                        resolve_latest)
+        name = manifest_name or resolve_latest(root)
+        if name is None:
+            raise CheckpointNotFound(f"no committed checkpoint under {root}")
+        return cls(manifest=CheckpointRef(root, name))
+
+    @classmethod
     def from_object_ref(cls, ref) -> "Checkpoint":
         from ray_tpu._private import worker as _worker
         return cls.from_dict(_worker.get(ref))
 
     # -- conversions ----------------------------------------------------------
 
+    @property
+    def manifest_ref(self):
+        """The engine CheckpointRef backing this checkpoint, or None."""
+        return self._manifest
+
     def to_dict(self) -> Dict[str, Any]:
+        if self._manifest is not None:
+            from ray_tpu.train import session as _session
+            s = _session._get_session()
+            if s is not None:
+                # inside a train worker: restore THIS rank's (resharded)
+                # slice of the saved world
+                return self._manifest.load(rank=s.world_rank,
+                                           world_size=s.world_size)
+            return self._manifest.load()
         if self._data is not None:
             # Copy the dict *containers* recursively so caller mutation of
             # any nesting level cannot corrupt the stored checkpoint. Leaves

@@ -35,6 +35,10 @@ object.push           peer, object — distributed pusher, per chunk
 object.fetch          peer, object — distributed fetch, per source attempt
 object.store.get      object — local ObjectStore.get
 task.execute          task, name — worker, before user code runs
+checkpoint.write      path, rank — engine writer, before each chunk write
+checkpoint.commit     stage (manifest|latest), step — rank-0 committer,
+                      before the manifest rename / LATEST update
+checkpoint.restore    manifest, rank — before chunks are read back
 ====================  =====================================================
 """
 

@@ -1,0 +1,21 @@
+"""ray_tpu.checkpoint — async sharded checkpointing with crash-atomic
+commit, content-hash dedup, and reshard-on-restore.
+
+See engine.py for the save/commit pipeline and ARCHITECTURE.md
+"Checkpointing & elastic restore" for the on-disk format contract.
+"""
+
+from ray_tpu.checkpoint.engine import (CheckpointEngine, CheckpointRef,
+                                       EngineStats, SaveHandle, load)
+from ray_tpu.checkpoint.manifest import (CheckpointCorruption,
+                                         CheckpointError, CheckpointNotFound,
+                                         Manifest, ShardIndex,
+                                         list_manifest_names, read_manifest,
+                                         resolve_latest)
+
+__all__ = [
+    "CheckpointEngine", "CheckpointRef", "EngineStats", "SaveHandle", "load",
+    "CheckpointError", "CheckpointCorruption", "CheckpointNotFound",
+    "Manifest", "ShardIndex", "list_manifest_names", "read_manifest",
+    "resolve_latest",
+]

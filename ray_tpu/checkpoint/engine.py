@@ -1,0 +1,607 @@
+"""Async sharded checkpoint engine.
+
+Save path (per rank)::
+
+    caller thread                     writer thread (daemon, bounded queue)
+    -------------                     -----------------------------------
+    flatten pytree                    hash each array (sha256 of
+    device->host (np.asarray)    -->    dtype|shape|bytes) = chunk id
+    enqueue job, return handle        dedup: chunk file exists -> skip
+                                      else gather-write RTF5 frame + rename
+                                      write shard index into pending/
+                                      rank 0 only: wait for all ranks'
+                                        shard indexes, then COMMIT
+
+``save()`` returns as soon as the device->host copy is done; disk I/O
+overlaps the next training step. The bounded queue (``checkpoint_queue_depth``)
+applies backpressure instead of buffering unbounded host copies.
+
+Commit protocol (rank 0): verify every referenced chunk exists -> write
+manifest (tmp+fsync+rename) -> advance LATEST -> best-effort register in the
+state service -> prune to ``num_to_keep`` + GC. A crash at any point leaves
+the previous or the new checkpoint fully readable (see manifest.py).
+
+Restore reshards when the world size changed: replicated saves hand any
+shard to any rank; axis-sharded saves are reassembled into global arrays
+from the per-shard offsets recorded at commit, then re-split
+``lo = r*dim//W, hi = (r+1)*dim//W`` along the shard axis for the new world.
+
+Chaos choke points: ``checkpoint.write`` (per chunk, labels path/rank),
+``checkpoint.commit`` (labels stage=manifest|latest, step), and
+``checkpoint.restore`` (labels manifest, rank).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu import chaos
+from ray_tpu._private.config import _config
+from ray_tpu._private.framing import FramedPayload, dumps_framed, loads_framed
+from ray_tpu.checkpoint import manifest as mf
+from ray_tpu.checkpoint.manifest import (ArrayEntry, CheckpointCorruption,
+                                         CheckpointError, CheckpointNotFound,
+                                         Manifest, ShardIndex)
+
+logger = logging.getLogger("ray_tpu")
+
+
+class _Slot:
+    """Marks where an array leaf was lifted out of the skeleton pytree."""
+
+    __slots__ = ("slot",)
+
+    def __init__(self, slot: int):
+        self.slot = slot
+
+    def __reduce__(self):
+        return (_Slot, (self.slot,))
+
+
+def _is_array(x: Any) -> bool:
+    if isinstance(x, np.ndarray):
+        return True
+    cls = type(x)
+    return cls.__module__.startswith("jax") and hasattr(x, "dtype") \
+        and hasattr(x, "shape")
+
+
+def _extract_arrays(value: Any, path: Tuple[str, ...],
+                    out: List[Tuple[str, np.ndarray]]) -> Any:
+    """Replace array leaves with _Slot markers; collect (path, host array).
+    np.asarray is the device->host transfer for jax.Array leaves."""
+    if isinstance(value, dict):
+        return {k: _extract_arrays(v, path + (str(k),), out)
+                for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        seq = [_extract_arrays(v, path + (str(i),), out)
+               for i, v in enumerate(value)]
+        return tuple(seq) if isinstance(value, tuple) else seq
+    if _is_array(value):
+        slot = len(out)
+        out.append(("/".join(path), np.ascontiguousarray(np.asarray(value))))
+        return _Slot(slot)
+    return value
+
+
+def _inject_arrays(value: Any, slots: Dict[int, np.ndarray]) -> Any:
+    if isinstance(value, dict):
+        return {k: _inject_arrays(v, slots) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        seq = [_inject_arrays(v, slots) for v in value]
+        return tuple(seq) if isinstance(value, tuple) else seq
+    if isinstance(value, _Slot):
+        return slots[value.slot]
+    return value
+
+
+def _hash_array(arr: np.ndarray) -> str:
+    try:
+        raw = memoryview(arr).cast("B")
+    except (TypeError, ValueError):
+        raw = arr.tobytes()
+    return mf.hash_bytes(arr.dtype.str, json.dumps(list(arr.shape)), raw)
+
+
+@dataclass
+class EngineStats:
+    saves: int = 0
+    commits: int = 0
+    chunks_written: int = 0
+    chunk_bytes_written: int = 0
+    chunks_deduped: int = 0
+    bytes_deduped: int = 0
+    chunks_gced: int = 0
+
+
+class SaveHandle:
+    """Completion token for one rank's async save. ``result()`` returns the
+    committed manifest filename on rank 0, None on other ranks."""
+
+    def __init__(self, step: int, rank: int):
+        self.step = step
+        self.rank = rank
+        self._done = threading.Event()
+        self._manifest_name: Optional[str] = None
+        self._error: Optional[BaseException] = None
+
+    def _finish(self, manifest_name: Optional[str],
+                error: Optional[BaseException]) -> None:
+        self._manifest_name = manifest_name
+        self._error = error
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Optional[str]:
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"checkpoint save (step={self.step} rank={self.rank}) "
+                f"still in flight after {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._manifest_name
+
+
+@dataclass
+class _SaveJob:
+    handle: SaveHandle
+    skeleton_frame: bytes
+    arrays: List[Tuple[str, np.ndarray]]
+    step: int
+    rank: int
+    world_size: int
+    shard_axis: Optional[int]
+    mesh: Optional[Dict[str, Any]]
+    meta: Dict[str, Any]
+    save_key: str
+
+
+class CheckpointEngine:
+    """Content-addressed checkpoint store rooted at a directory shared by
+    every rank (local disk, NFS, or the spill dir)."""
+
+    def __init__(self, root: str, *, num_to_keep: Optional[int] = None,
+                 namespace: str = "default",
+                 state_client: Optional[Any] = None):
+        self.root = os.path.abspath(root)
+        self.num_to_keep = num_to_keep
+        self.namespace = namespace
+        self._state_client = state_client
+        mf.init_root(self.root)
+        self._queue: "queue.Queue[Optional[_SaveJob]]" = queue.Queue(
+            maxsize=max(1, int(_config.checkpoint_queue_depth)))
+        self._writer: Optional[threading.Thread] = None
+        self._writer_lock = threading.Lock()
+        self._inflight: List[SaveHandle] = []
+        self._inflight_chunks: set = set()   # GC must not reap these
+        self._closed = False
+        self.stats = EngineStats()
+
+    # -- save -----------------------------------------------------------------
+
+    def save(self, tree: Any, *, step: int, rank: int = 0,
+             world_size: int = 1, shard_axis: Optional[int] = None,
+             mesh: Optional[Dict[str, Any]] = None,
+             meta: Optional[Dict[str, Any]] = None,
+             save_key: Optional[str] = None,
+             wait: bool = False) -> SaveHandle:
+        """Snapshot ``tree`` (this rank's shard of it). Returns once the
+        device->host copy is enqueued; ``wait=True`` blocks through commit."""
+        if self._closed:
+            raise CheckpointError("engine is closed")
+        arrays: List[Tuple[str, np.ndarray]] = []
+        skeleton = _extract_arrays(tree, (), arrays)
+        handle = SaveHandle(step, rank)
+        job = _SaveJob(
+            handle=handle,
+            skeleton_frame=bytes(dumps_framed(skeleton)),
+            arrays=arrays, step=step, rank=rank, world_size=world_size,
+            shard_axis=shard_axis, mesh=mesh, meta=dict(meta or {}),
+            save_key=save_key or f"step-{step:08d}")
+        self._ensure_writer()
+        with self._writer_lock:
+            self._inflight.append(handle)
+        self._queue.put(job)
+        if wait:
+            handle.result()
+        return handle
+
+    def _ensure_writer(self) -> None:
+        with self._writer_lock:
+            if self._writer is None or not self._writer.is_alive():
+                self._writer = threading.Thread(
+                    target=self._writer_loop,
+                    name="ckpt-writer", daemon=True)
+                self._writer.start()
+
+    def _writer_loop(self) -> None:
+        while True:
+            job = self._queue.get()
+            if job is None:
+                self._queue.task_done()
+                return
+            try:
+                name = self._process(job)
+                job.handle._finish(name, None)
+            except BaseException as e:
+                logger.warning("checkpoint: save step=%d rank=%d failed: %s",
+                               job.step, job.rank, e)
+                job.handle._finish(None, e)
+            finally:
+                self._queue.task_done()
+                with self._writer_lock:
+                    try:
+                        self._inflight.remove(job.handle)
+                    except ValueError:
+                        logger.debug("checkpoint: handle already reaped "
+                                     "(flush raced the writer)")
+
+    # -- the write path (writer thread) ---------------------------------------
+
+    def _write_chunk(self, chunk_id: str, pieces: List, nbytes: int) -> None:
+        final = os.path.join(self.root, mf.chunk_relpath(chunk_id))
+        if os.path.exists(final):
+            self.stats.chunks_deduped += 1
+            self.stats.bytes_deduped += nbytes
+            return
+        os.makedirs(os.path.dirname(final), exist_ok=True)
+        tmp = f"{final}.tmp-{os.getpid()}-{threading.get_ident()}"
+        try:
+            with open(tmp, "wb") as f:
+                for p in pieces:
+                    f.write(p)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, final)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        self.stats.chunks_written += 1
+        self.stats.chunk_bytes_written += nbytes
+
+    def _process(self, job: _SaveJob) -> Optional[str]:
+        self.stats.saves += 1
+        protected: List[str] = []
+        try:
+            entries: List[ArrayEntry] = []
+            for slot, (path, arr) in enumerate(job.arrays):
+                chunk_id = _hash_array(arr)
+                protected.append(chunk_id)
+                self._inflight_chunks.add(chunk_id)
+                dropped = False
+                if chaos.ENABLED:
+                    dropped = chaos.inject("checkpoint.write", path=path,
+                                           rank=str(job.rank)) == "drop"
+                if not dropped:
+                    payload = FramedPayload(arr)
+                    self._write_chunk(chunk_id, payload.pieces, arr.nbytes)
+                # a dropped (lost) write still indexes the chunk: the
+                # committer's presence check then fails the save loudly
+                # instead of publishing a manifest missing the array
+                entries.append(ArrayEntry(
+                    path=path, slot=slot, chunk=chunk_id, nbytes=arr.nbytes,
+                    dtype=arr.dtype.str, shape=list(arr.shape)))
+            skel_id = mf.hash_bytes("skeleton", job.skeleton_frame)
+            protected.append(skel_id)
+            self._inflight_chunks.add(skel_id)
+            if chaos.ENABLED:
+                chaos.inject("checkpoint.write", path="<skeleton>",
+                             rank=str(job.rank))
+            self._write_chunk(skel_id, [job.skeleton_frame],
+                              len(job.skeleton_frame))
+            shard = ShardIndex(rank=job.rank, skeleton=skel_id,
+                               skeleton_nbytes=len(job.skeleton_frame),
+                               arrays=entries)
+            pend_dir = os.path.join(self.root, mf.PENDING_DIR, job.save_key)
+            os.makedirs(pend_dir, exist_ok=True)
+            mf.atomic_write_bytes(
+                os.path.join(pend_dir, f"shard-{job.rank}.json"),
+                json.dumps({"step": job.step, "world_size": job.world_size,
+                            "shard": shard.to_json()}).encode())
+            if job.rank != 0:
+                return None
+            return self._commit(job, pend_dir)
+        finally:
+            self._inflight_chunks.difference_update(protected)
+
+    def _commit(self, job: _SaveJob, pend_dir: str) -> str:
+        shards = self._gather_shards(job, pend_dir)
+        if job.shard_axis is not None:
+            _finalize_sharding(shards, job.shard_axis)
+        m = Manifest(id=mf.new_manifest_id(), step=job.step,
+                     world_size=job.world_size, shards=shards,
+                     shard_axis=job.shard_axis, mesh=job.mesh, meta=job.meta)
+        if not mf.chunks_present(self.root, m):
+            raise CheckpointError(
+                f"step {job.step}: chunk(s) missing at commit time "
+                "(lost or dropped write) — refusing to publish a torn "
+                "manifest")
+        if chaos.ENABLED:
+            chaos.inject("checkpoint.commit", stage="manifest",
+                         step=str(job.step))
+        name = mf.write_manifest(self.root, m)
+        if chaos.ENABLED:
+            chaos.inject("checkpoint.commit", stage="latest",
+                         step=str(job.step))
+        mf.set_latest(self.root, name)
+        self.stats.commits += 1
+        self._register(name)
+        self._cleanup_pending(pend_dir)
+        if self.num_to_keep is not None:
+            self._prune(self.num_to_keep)
+        return name
+
+    def _gather_shards(self, job: _SaveJob, pend_dir: str) -> List[ShardIndex]:
+        """Rank 0 waits for every rank's shard index in pending/."""
+        deadline = time.monotonic() + float(_config.checkpoint_shard_wait_s)
+        want = {r: os.path.join(pend_dir, f"shard-{r}.json")
+                for r in range(job.world_size)}
+        shards: Dict[int, ShardIndex] = {}
+        while True:
+            for r, path in list(want.items()):
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        d = json.load(f)
+                except (OSError, json.JSONDecodeError):
+                    continue
+                if d.get("step") != job.step:
+                    continue  # stale file from a crashed earlier attempt
+                shards[r] = ShardIndex.from_json(d["shard"])
+                del want[r]
+            if not want:
+                return [shards[r] for r in sorted(shards)]
+            if time.monotonic() >= deadline:
+                raise CheckpointError(
+                    f"step {job.step}: ranks {sorted(want)} never delivered "
+                    f"shard indexes within "
+                    f"{_config.checkpoint_shard_wait_s}s — save abandoned "
+                    "(previous checkpoint remains the restore point)")
+            time.sleep(0.005)  # raylint: allow(bare-retry) local-FS poll under the explicit checkpoint_shard_wait_s deadline above
+
+    def _register(self, name: str) -> None:
+        client = self._state_client
+        if client is None:
+            return
+        try:
+            client.kv_put(f"ckpt/{self.namespace}/latest".encode(),
+                          name.encode())
+        except Exception as e:
+            # registration is advisory (LATEST on disk is authoritative);
+            # a dead state service must not fail a durable commit
+            logger.debug("checkpoint: state-service register failed: %s", e)
+
+    def _cleanup_pending(self, pend_dir: str) -> None:
+        try:
+            for fn in os.listdir(pend_dir):
+                os.unlink(os.path.join(pend_dir, fn))
+            os.rmdir(pend_dir)
+        except OSError as e:
+            logger.debug("checkpoint: pending cleanup left residue: %s", e)
+
+    # -- retention / GC -------------------------------------------------------
+
+    def _prune(self, keep: int) -> None:
+        names = mf.list_manifest_names(self.root)
+        for name in names[:-keep] if keep > 0 else names:
+            try:
+                os.unlink(os.path.join(self.root, mf.MANIFESTS_DIR, name))
+            except OSError as e:
+                logger.debug("checkpoint: prune of %s failed: %s", name, e)
+        self.gc()
+
+    def gc(self) -> int:
+        """Reap chunk files no committed manifest references (crashed saves
+        leave orphans by design). In-flight saves' chunks are protected."""
+        referenced = set(self._inflight_chunks)
+        for name in mf.list_manifest_names(self.root):
+            try:
+                referenced.update(mf.read_manifest(self.root, name)
+                                  .chunk_ids())
+            except CheckpointError:
+                logger.warning("checkpoint: gc skipping unreadable manifest "
+                               "%s (its chunks stay protected-by-absence)",
+                               name)
+                return 0  # cannot prove anything is orphaned
+        reaped = 0
+        chunks_dir = os.path.join(self.root, mf.CHUNKS_DIR)
+        for sub in os.listdir(chunks_dir):
+            subdir = os.path.join(chunks_dir, sub)
+            if not os.path.isdir(subdir):
+                continue
+            for fn in os.listdir(subdir):
+                if fn.split(".tmp-")[0] in referenced and ".tmp-" not in fn:
+                    continue
+                try:
+                    os.unlink(os.path.join(subdir, fn))
+                    reaped += 1
+                except OSError as e:
+                    logger.debug("checkpoint: gc unlink failed: %s", e)
+        self.stats.chunks_gced += reaped
+        return reaped
+
+    # -- restore --------------------------------------------------------------
+
+    def latest(self) -> Optional[str]:
+        return mf.resolve_latest(self.root)
+
+    def restore(self, manifest_name: Optional[str] = None, *, rank: int = 0,
+                world_size: int = 1) -> Any:
+        return load(self.root, manifest_name, rank=rank,
+                    world_size=world_size)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def flush(self, timeout: Optional[float] = None) -> bool:
+        """Wait for every in-flight save. True when all completed."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._writer_lock:
+                pending = list(self._inflight)
+            if not pending:
+                return True
+            for h in pending:
+                left = (None if deadline is None
+                        else max(0.0, deadline - time.monotonic()))
+                if not h.wait(left):
+                    return False
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        if self._closed:
+            return
+        self.flush(timeout)
+        self._closed = True
+        with self._writer_lock:
+            writer = self._writer
+        if writer is not None and writer.is_alive():
+            self._queue.put(None)
+            writer.join(timeout=5.0)
+
+
+# -- engine-less read path ----------------------------------------------------
+
+def _read_chunk(root: str, chunk_id: str) -> bytes:
+    path = os.path.join(root, mf.chunk_relpath(chunk_id))
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except FileNotFoundError:
+        raise CheckpointCorruption(f"chunk {chunk_id[:12]}… missing at {root}")
+
+
+def _load_array(root: str, e: ArrayEntry, verify: bool) -> np.ndarray:
+    value, _ = loads_framed(_read_chunk(root, e.chunk))
+    arr = np.asarray(value)
+    if verify:
+        got = _hash_array(np.ascontiguousarray(arr))
+        if got != e.chunk:
+            raise CheckpointCorruption(
+                f"chunk for {e.path!r} failed hash verification "
+                f"(manifest {e.chunk[:12]}…, disk {got[:12]}…)")
+    return arr
+
+
+def _load_shard(root: str, shard: ShardIndex, verify: bool) -> Any:
+    skeleton, _ = loads_framed(_read_chunk(root, shard.skeleton))
+    slots = {e.slot: _load_array(root, e, verify) for e in shard.arrays}
+    return _inject_arrays(skeleton, slots)
+
+
+def _finalize_sharding(shards: List[ShardIndex], axis: int) -> None:
+    """Stamp global_shape/offset onto entries that are genuinely split
+    along ``axis`` (same path, same non-axis dims across all ranks).
+    Anything else — scalars, replicated leaves — restores replicated."""
+    by_path: Dict[str, List[ArrayEntry]] = {}
+    for s in shards:
+        for e in s.arrays:
+            by_path.setdefault(e.path, []).append(e)
+    nranks = len(shards)
+    for path, entries in by_path.items():
+        if len(entries) != nranks:
+            continue
+        if len({e.chunk for e in entries}) == 1 and nranks > 1:
+            # byte-identical on every rank: a replicated leaf, not an
+            # axis-split one — reassembling would tile it
+            continue
+        shapes = [e.shape for e in entries]
+        if any(len(sh) <= axis for sh in shapes):
+            continue
+        base = shapes[0][:axis] + shapes[0][axis + 1:]
+        if any(sh[:axis] + sh[axis + 1:] != base for sh in shapes[1:]):
+            continue
+        total = sum(sh[axis] for sh in shapes)
+        off = 0
+        for e in entries:   # shards arrive rank-sorted from the committer
+            g = list(e.shape)
+            g[axis] = total
+            o = [0] * len(g)
+            o[axis] = off
+            e.global_shape, e.offset = g, o
+            off += e.shape[axis]
+
+
+def _load_resharded(root: str, m: Manifest, rank: int, world_size: int,
+                    verify: bool) -> Any:
+    """World size changed on an axis-sharded save: rebuild each global
+    array from recorded offsets, then take this rank's equal split."""
+    axis = m.shard_axis
+    assert axis is not None
+    skeleton, _ = loads_framed(_read_chunk(root, m.shards[0].skeleton))
+    slots: Dict[int, np.ndarray] = {}
+    for e0 in m.shards[0].arrays:
+        if e0.global_shape is None:
+            slots[e0.slot] = _load_array(root, e0, verify)
+            continue
+        glob = np.empty(tuple(e0.global_shape), dtype=np.dtype(e0.dtype))
+        for s in m.shards:
+            e = next(x for x in s.arrays if x.path == e0.path)
+            part = _load_array(root, e, verify)
+            sel = [slice(None)] * glob.ndim
+            sel[axis] = slice(e.offset[axis], e.offset[axis] + e.shape[axis])
+            glob[tuple(sel)] = part.reshape(tuple(e.shape))
+        dim = glob.shape[axis]
+        lo, hi = rank * dim // world_size, (rank + 1) * dim // world_size
+        sel = [slice(None)] * glob.ndim
+        sel[axis] = slice(lo, hi)
+        slots[e0.slot] = glob[tuple(sel)]
+    return _inject_arrays(skeleton, slots)
+
+
+def load(root: str, manifest_name: Optional[str] = None, *, rank: int = 0,
+         world_size: int = 1) -> Any:
+    """Restore one rank's view of a committed checkpoint (thread-free read
+    path; the engine's ``restore`` delegates here)."""
+    root = os.path.abspath(root)
+    if manifest_name is None:
+        manifest_name = mf.resolve_latest(root)
+        if manifest_name is None:
+            raise CheckpointNotFound(f"no committed checkpoint under {root}")
+    m = mf.read_manifest(root, manifest_name)
+    if chaos.ENABLED:
+        chaos.inject("checkpoint.restore", manifest=manifest_name,
+                     rank=str(rank))
+    verify = bool(_config.checkpoint_hash_verify)
+    if m.shard_axis is None:
+        # replicated: every shard is a full tree; any one serves any rank
+        return _load_shard(root, m.shards[rank % len(m.shards)], verify)
+    if world_size == m.world_size:
+        by_rank = {s.rank: s for s in m.shards}
+        return _load_shard(root, by_rank[rank], verify)
+    return _load_resharded(root, m, rank, world_size, verify)
+
+
+@dataclass
+class CheckpointRef:
+    """Picklable pointer to a committed checkpoint — what trials, results
+    and serve configs carry instead of directory copies or value blobs."""
+
+    root: str
+    manifest_name: Optional[str] = None   # None = latest at load time
+
+    def load(self, rank: int = 0, world_size: int = 1) -> Any:
+        return load(self.root, self.manifest_name, rank=rank,
+                    world_size=world_size)
+
+    def exists(self) -> bool:
+        try:
+            name = self.manifest_name or mf.resolve_latest(self.root)
+            return name is not None and mf.chunks_present(
+                self.root, mf.read_manifest(self.root, name))
+        except CheckpointError:
+            return False

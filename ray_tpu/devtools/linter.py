@@ -50,6 +50,13 @@ R8   hidden-copy    ``bytes(<memoryview/bytearray/slice>)`` casts and
                     ``b"".join`` chunk reassembly inside modules marked
                     ``# raylint: hot-path`` (payload-plane copies the
                     zero-copy data plane exists to eliminate)
+R9   direct-checkpoint-io
+                    ``.to_directory()`` / ``.from_directory()`` calls in
+                    the ``train/``, ``tune/`` or ``serve/`` subtrees —
+                    directory blobs bypass the checkpoint engine's
+                    crash-atomic manifest commit; go through
+                    ``ray_tpu.checkpoint`` (the engine itself and
+                    ``air/`` are out of scope)
 ==== ============== ====================================================
 """
 
@@ -708,6 +715,40 @@ def check_hidden_copy(ctx: FileContext) -> Iterator[Finding]:
                    "copy — recv_into a preallocated destination instead")
         if msg and not ctx.allowed(node.lineno, "R8", "hidden-copy"):
             yield Finding("R8", "hidden-copy", ctx.relpath, node.lineno, msg)
+
+
+# --------------------------------------------------------------------------
+# R9: checkpoint directory I/O that bypasses the manifest commit path
+
+_CKPT_IO_SCOPES = {"train", "tune", "serve"}
+_CKPT_IO_METHODS = {"to_directory", "from_directory"}
+
+
+@rule("R9", "direct-checkpoint-io")
+def check_direct_checkpoint_io(ctx: FileContext) -> Iterator[Finding]:
+    """In the train/tune/serve subtrees, ``Checkpoint.to_directory`` /
+    ``from_directory`` write/read whole-value blobs with none of the
+    engine's guarantees: no crash-atomic commit, no content dedup, no
+    reshard-on-restore. Those layers must move checkpoints as manifest
+    refs through ``ray_tpu.checkpoint``. The engine itself and ``air/``
+    (the conversion layer) are out of scope; deliberate blob I/O is
+    justified with ``# raylint: allow(direct-checkpoint-io) <why>``."""
+    segments = set(ctx.relpath.replace("\\", "/").split("/")[:-1])
+    if not segments & _CKPT_IO_SCOPES:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _CKPT_IO_METHODS):
+            continue
+        if ctx.allowed(node.lineno, "R9", "direct-checkpoint-io"):
+            continue
+        yield Finding(
+            "R9", "direct-checkpoint-io", ctx.relpath, node.lineno,
+            f".{node.func.attr}() bypasses the checkpoint engine's "
+            "crash-atomic manifest commit — persist/restore through "
+            "ray_tpu.checkpoint (manifest refs) instead")
 
 
 # --------------------------------------------------------------------------

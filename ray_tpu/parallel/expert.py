@@ -13,7 +13,7 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ray_tpu._private.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 

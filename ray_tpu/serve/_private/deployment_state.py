@@ -89,7 +89,8 @@ class DeploymentState:
                         max(2, self.config.max_concurrent_queries))
         handle = ray_tpu.remote(Replica).options(**opts).remote(
             self.name, tag, self.func_or_class, self.init_args,
-            self.init_kwargs, self.config.user_config)
+            self.init_kwargs, self.config.user_config,
+            self.config.checkpoint)
         return ReplicaInfo(tag, handle, self.target_version)
 
     def _stop_replica(self, info: ReplicaInfo) -> None:

@@ -20,16 +20,35 @@ import time
 from typing import Any, Optional
 
 
+def _load_checkpoint(checkpoint: Any) -> Any:
+    """Resolve a deployment checkpoint to the restored pytree. Accepts a
+    CheckpointRef or its dict form (DeploymentConfig rides through
+    dataclasses.asdict on deploy)."""
+    from ray_tpu.checkpoint import CheckpointRef
+    if isinstance(checkpoint, dict) and "root" in checkpoint:
+        checkpoint = CheckpointRef(**checkpoint)
+    if isinstance(checkpoint, CheckpointRef):
+        return checkpoint.load()
+    return checkpoint
+
+
 class Replica:
     def __init__(self, deployment_name: str, replica_tag: str,
                  func_or_class, init_args, init_kwargs,
-                 user_config: Optional[Any] = None):
+                 user_config: Optional[Any] = None,
+                 checkpoint: Optional[Any] = None):
         self.deployment_name = deployment_name
         self.replica_tag = replica_tag
         self._ongoing = 0
         self._total = 0
         self._lock = threading.Lock()
         self._draining = False
+        if checkpoint is not None:
+            # Cold start from an engine manifest: the weights pytree loads
+            # from the content-addressed store HERE, on the replica — the
+            # controller only ever shipped the (root, manifest) pointer.
+            init_kwargs = dict(init_kwargs or {})
+            init_kwargs["checkpoint"] = _load_checkpoint(checkpoint)
         if inspect.isfunction(func_or_class):
             self._callable = func_or_class
             self._is_function = True

@@ -115,8 +115,15 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                ray_actor_options: Optional[dict] = None,
                route_prefix: Optional[str] = None,
                health_check_period_s: float = 10.0,
-               graceful_shutdown_timeout_s: float = 20.0):
-    """Decorator declaring a class or function as a Serve deployment."""
+               graceful_shutdown_timeout_s: float = 20.0,
+               checkpoint: Any = None):
+    """Decorator declaring a class or function as a Serve deployment.
+
+    ``checkpoint`` accepts a ``ray_tpu.checkpoint.CheckpointRef`` (e.g.
+    ``trainer_result.checkpoint.manifest_ref``): class replicas then
+    cold-start with the restored pytree injected as a ``checkpoint=``
+    init kwarg, loaded from the engine store on the replica itself.
+    """
 
     def wrap(func_or_class):
         if isinstance(autoscaling_config, dict):
@@ -130,7 +137,8 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             autoscaling_config=asc,
             ray_actor_options=ray_actor_options or {},
             health_check_period_s=health_check_period_s,
-            graceful_shutdown_timeout_s=graceful_shutdown_timeout_s)
+            graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
+            checkpoint=checkpoint)
         return Deployment(func_or_class,
                           name or func_or_class.__name__, cfg, route_prefix)
 

@@ -45,13 +45,19 @@ class DeploymentConfig:
     ray_actor_options: Dict[str, Any] = dataclasses.field(default_factory=dict)
     health_check_period_s: float = 10.0
     graceful_shutdown_timeout_s: float = 20.0
+    # Model weights source: a ray_tpu.checkpoint.CheckpointRef (or its
+    # {"root", "manifest_name"} dict form after config serialization).
+    # Replicas cold-start by loading the manifest on the replica actor —
+    # weights come from the content-addressed store, never through the
+    # controller. Changing it is a version change (rolling update).
+    checkpoint: Optional[Any] = None
 
     def version_hash(self, func_or_class, init_args, init_kwargs) -> str:
         """Code/config version: changing it triggers a rolling update;
         changing only user_config reconfigures replicas in place
         (reference: deployment_state version semantics).  The hash covers
-        the callable's source (so edited code redeploys) plus init args
-        and actor options."""
+        the callable's source (so edited code redeploys) plus init args,
+        actor options, and the checkpoint manifest pin."""
         import hashlib
         import inspect
         import pickle
@@ -60,9 +66,13 @@ class DeploymentConfig:
         except Exception:  # raylint: allow(swallow) source unavailable: fall back to qualname
             code = getattr(func_or_class, "__qualname__",
                            repr(func_or_class))
+        ckpt = self.checkpoint
+        if dataclasses.is_dataclass(ckpt):
+            ckpt = dataclasses.asdict(ckpt)
         try:
             payload = pickle.dumps(
-                (code, init_args, init_kwargs, self.ray_actor_options))
+                (code, init_args, init_kwargs, self.ray_actor_options,
+                 ckpt))
         except Exception:  # raylint: allow(swallow) unpicklable config: fall back to repr
-            payload = repr((code, init_args, init_kwargs)).encode()
+            payload = repr((code, init_args, init_kwargs, ckpt)).encode()
         return hashlib.sha1(payload).hexdigest()[:12]

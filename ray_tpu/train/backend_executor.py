@@ -39,7 +39,7 @@ class RayTrainWorker:
 
     def start_training(self, train_loop: Callable, config: Dict[str, Any],
                        checkpoint=None, group_name: Optional[str] = None,
-                       dataset_shards=None):
+                       dataset_shards=None, checkpoint_spec=None):
         from ray_tpu.train import session as session_mod
         mesh = None
         try:
@@ -77,7 +77,7 @@ class RayTrainWorker:
             world_rank=self.rank, world_size=self.world_size,
             checkpoint=checkpoint, mesh=mesh, config=config,
             collective_group_name=group_name,
-            dataset_shards=dataset_shards)
+            dataset_shards=dataset_shards, checkpoint_spec=checkpoint_spec)
         sess = self.session
         # Collective groups and task context are thread-local; hand the actor
         # thread's bindings to the training-loop thread.
@@ -98,6 +98,13 @@ class RayTrainWorker:
             except BaseException as e:  # noqa: BLE001
                 sess.error = e
             finally:
+                # Drain in-flight engine saves BEFORE the completion
+                # sentinel: a result consumer must observe the last
+                # checkpoint as committed, not queued.
+                try:
+                    sess._close_engine(had_error=sess.error is not None)
+                except Exception as ce:
+                    logger.warning("checkpoint engine close failed: %s", ce)
                 sess.finished.set()
                 sess.results.put(_FINISHED)
 
@@ -181,12 +188,14 @@ class BackendExecutor:
                 backend=self.collective_backend, group_name=self.group_name)
 
     def start_training(self, train_loop: Callable, config: Dict[str, Any],
-                       checkpoint=None, dataset_shards=None):
+                       checkpoint=None, dataset_shards=None,
+                       checkpoint_spec=None):
         self._finished = set()
         ray_tpu.get([
             w.start_training.remote(
                 train_loop, config, checkpoint, self.group_name,
-                dataset_shards[i] if dataset_shards else None)
+                dataset_shards[i] if dataset_shards else None,
+                checkpoint_spec)
             for i, w in enumerate(self.workers)])
 
     def get_next_results(self, timeout: Optional[float] = None):
@@ -216,8 +225,24 @@ class BackendExecutor:
         return out
 
     def get_final_checkpoints(self):
-        return ray_tpu.get(
-            [w.get_final_checkpoint.remote() for w in self.workers])
+        """Final checkpoint per worker, None for workers that are dead or
+        miss their deadline — one crashed worker must not hang shutdown."""
+        from ray_tpu._private.backoff import BackoffPolicy
+        from ray_tpu._private.config import _config
+        policy = BackoffPolicy(
+            deadline_s=float(_config.checkpoint_final_timeout_s))
+        out = []
+        for i, w in enumerate(self.workers):
+            state = policy.start()
+            try:
+                out.append(ray_tpu.get(w.get_final_checkpoint.remote(),
+                                       timeout=state.attempt_timeout()))
+            except Exception as e:
+                logger.warning(
+                    "final checkpoint from worker %d unavailable (%s: %s); "
+                    "returning partial results", i, type(e).__name__, e)
+                out.append(None)
+        return out
 
     def shutdown(self):
         for w in self.workers:

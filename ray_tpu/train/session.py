@@ -10,9 +10,12 @@ resident; the store keeps descriptors).
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 from typing import Any, Dict, Optional
+
+logger = logging.getLogger("ray_tpu")
 
 
 class _TrainSession:
@@ -20,7 +23,7 @@ class _TrainSession:
                  local_rank: int = 0,
                  checkpoint=None, mesh=None, config=None,
                  collective_group_name: Optional[str] = None,
-                 dataset_shards=None):
+                 dataset_shards=None, checkpoint_spec=None):
         self.dataset_shards = dataset_shards or {}
         self.world_rank = world_rank
         self.world_size = world_size
@@ -33,6 +36,51 @@ class _TrainSession:
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
         self.latest_checkpoint = checkpoint
+        # Engine-backed persistence (trainer passes a spec when
+        # RunConfig.storage_path is set): every reported checkpoint is also
+        # snapshotted asynchronously through ray_tpu.checkpoint, so the
+        # driver restarts from a committed manifest, not a driver-memory blob.
+        self.checkpoint_spec = checkpoint_spec
+        self.checkpoint_engine = None
+        self._ckpt_seq = 0
+
+    def _engine(self):
+        if self.checkpoint_engine is None and self.checkpoint_spec:
+            from ray_tpu.checkpoint import CheckpointEngine
+            self.checkpoint_engine = CheckpointEngine(
+                self.checkpoint_spec["root"],
+                num_to_keep=self.checkpoint_spec.get("num_to_keep"))
+        return self.checkpoint_engine
+
+    def _engine_save(self, checkpoint) -> None:
+        """Async engine snapshot of a reported checkpoint. The report call
+        returns once the device->host copy is queued; commit happens on the
+        engine's writer thread."""
+        self._ckpt_seq += 1
+        freq = max(1, int(self.checkpoint_spec.get("frequency") or 1))
+        if (self._ckpt_seq - 1) % freq != 0:
+            return
+        tree = checkpoint.to_dict() if hasattr(checkpoint, "to_dict") \
+            else checkpoint
+        token = self.checkpoint_spec.get("run_token", "run")
+        self._engine().save(
+            tree, step=self._ckpt_seq, rank=self.world_rank,
+            world_size=self.world_size,
+            save_key=f"{token}-{self._ckpt_seq:08d}")
+
+    def _close_engine(self, had_error: bool) -> None:
+        eng = self.checkpoint_engine
+        if eng is None:
+            return
+        if had_error:
+            # A crashed loop must not stall shutdown behind a commit that
+            # waits on dead peers; committed manifests are already durable.
+            eng.flush(timeout=0.5)
+        else:
+            if not eng.flush(timeout=60.0):
+                logger.warning("checkpoint: in-flight save unfinished at "
+                               "session close (rank %d)", self.world_rank)
+            eng.close(timeout=1.0)
 
 
 _session = threading.local()
@@ -58,6 +106,8 @@ def report(metrics: Dict[str, Any], checkpoint=None) -> None:
         raise RuntimeError("session.report() called outside a train worker")
     if checkpoint is not None:
         s.latest_checkpoint = checkpoint
+        if s.checkpoint_spec:
+            s._engine_save(checkpoint)
     s.results.put({"metrics": dict(metrics), "checkpoint": checkpoint,
                    "rank": s.world_rank})
 

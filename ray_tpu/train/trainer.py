@@ -12,7 +12,10 @@ inside the loop the user gets a mesh (``session.get_mesh``) and an optional
 
 from __future__ import annotations
 
+import logging
+import os
 import time
+import uuid
 from typing import Any, Callable, Dict, Optional
 
 import ray_tpu
@@ -22,6 +25,8 @@ from ray_tpu.air.checkpoint import Checkpoint
 from ray_tpu.air.config import (FailureConfig, Result, RunConfig,
                                 ScalingConfig)
 from ray_tpu.train.backend_executor import BackendExecutor
+
+logger = logging.getLogger("ray_tpu")
 
 
 class JaxTrainer:
@@ -64,7 +69,7 @@ class JaxTrainer:
         checkpoint = self._resume_from
         history = []
         last_metrics: Dict[str, Any] = {}
-        ckpt_index = 0
+        engine_root = self._engine_root()
         while True:
             executor = BackendExecutor(
                 self.scaling_config.num_workers,
@@ -76,7 +81,9 @@ class JaxTrainer:
                 executor.start()
                 executor.start_training(self._train_loop, self._config,
                                         checkpoint,
-                                        dataset_shards=self._dataset_shards())
+                                        dataset_shards=self._dataset_shards(),
+                                        checkpoint_spec=self._checkpoint_spec(
+                                            engine_root))
                 while True:
                     round_results = executor.get_next_results()
                     if round_results is None:
@@ -85,8 +92,6 @@ class JaxTrainer:
                         history.append(r["metrics"])
                         if r["checkpoint"] is not None and r["rank"] == 0:
                             checkpoint = r["checkpoint"]
-                            ckpt_index = self._persist_checkpoint(
-                                checkpoint, ckpt_index)
                     if round_results:
                         last_metrics = round_results[0]["metrics"]
                 finals = executor.get_final_checkpoints()
@@ -100,8 +105,13 @@ class JaxTrainer:
                 if max_failures != -1 and failures > max_failures:
                     return Result(metrics=last_metrics, checkpoint=checkpoint,
                                   error=e, metrics_history=history)
-                # Elastic restart from the latest checkpoint
-                # (reference: backend_executor.py:510-531).
+                # Elastic restart from the last *committed* manifest when the
+                # engine is on (reference: backend_executor.py:510-531). The
+                # streamed in-memory checkpoint is the fallback — it may be
+                # ahead of the last commit, but it dies with the driver.
+                committed = self._committed_checkpoint(engine_root)
+                if committed is not None:
+                    checkpoint = committed
                 time.sleep(restart_backoff.delay_for(failures - 1))
                 continue
             finally:
@@ -109,25 +119,32 @@ class JaxTrainer:
                 # path exits the attempt.
                 executor.shutdown()
 
-    def _persist_checkpoint(self, checkpoint: Checkpoint, index: int) -> int:
-        """Write checkpoints under RunConfig.storage_path, pruning to
-        CheckpointConfig.num_to_keep (reference: checkpoint managers in
-        ``air/_internal/checkpoint_manager.py``)."""
-        import os
-        import shutil
+    def _engine_root(self) -> Optional[str]:
+        """Engine store under <storage_path>/<name>/checkpoints; None keeps
+        checkpoints driver-memory-only (small runs, existing behavior)."""
         storage = self.run_config.storage_path
         if not storage:
-            return index
+            return None
         name = self.run_config.name or "experiment"
-        exp_dir = os.path.join(storage, name)
-        os.makedirs(exp_dir, exist_ok=True)
-        checkpoint.to_directory(os.path.join(exp_dir,
-                                             f"checkpoint_{index:06d}"))
-        keep = self.run_config.checkpoint_config.num_to_keep
-        if keep:
-            existing = sorted(d for d in os.listdir(exp_dir)
-                              if d.startswith("checkpoint_"))
-            for stale in existing[:-keep]:
-                shutil.rmtree(os.path.join(exp_dir, stale),
-                              ignore_errors=True)
-        return index + 1
+        return os.path.join(storage, name, "checkpoints")
+
+    def _checkpoint_spec(self, engine_root: Optional[str]):
+        if engine_root is None:
+            return None
+        cfg = self.run_config.checkpoint_config
+        # run_token namespaces pending/ save keys per attempt, so shard
+        # indexes left by a crashed attempt can never join a new commit
+        return {"root": engine_root,
+                "num_to_keep": cfg.num_to_keep,
+                "frequency": cfg.checkpoint_frequency,
+                "run_token": uuid.uuid4().hex[:8]}
+
+    def _committed_checkpoint(self, engine_root: Optional[str]):
+        if engine_root is None:
+            return None
+        from ray_tpu.checkpoint import resolve_latest
+        name = resolve_latest(engine_root)
+        if name is None:
+            return None
+        logger.info("restarting from committed checkpoint manifest %s", name)
+        return Checkpoint.from_manifest(engine_root, name)

@@ -119,8 +119,38 @@ class TrialRunner:
         from ray_tpu.tune.syncer import _SyncerState
         self._syncer = _SyncerState(sync_config, self.experiment_dir,
                                     experiment_name)
+        # Trial checkpoints live in one shared content-addressed store;
+        # Trial.checkpoint holds a tiny picklable CheckpointRef, so
+        # experiment state files and PBT exploits move manifest pointers,
+        # not payload copies. Dedup makes PBT clone-heavy saves ~free.
+        from ray_tpu.checkpoint import CheckpointEngine
+        self._ckpt_engine = CheckpointEngine(
+            os.path.join(self.experiment_dir, "checkpoint_store"))
+        self._ckpt_seq = 0
         for t in self.trials:
             self.scheduler.on_trial_add(t)
+
+    def _save_trial_checkpoint(self, trial: Trial):
+        """Snapshot a trial's state into the shared engine store; returns a
+        CheckpointRef pinned to the committed manifest. Synchronous: a ref
+        must never circulate (PBT exploit, state files) before its commit."""
+        payload = ray_tpu.get(trial._actor.save.remote())
+        from ray_tpu.checkpoint import CheckpointRef
+        self._ckpt_seq += 1
+        handle = self._ckpt_engine.save(
+            payload, step=self._ckpt_seq,
+            meta={"trial_id": trial.trial_id},
+            save_key=f"{trial.trial_id}-{self._ckpt_seq:08d}", wait=True)
+        return CheckpointRef(self._ckpt_engine.root, handle.result())
+
+    @staticmethod
+    def _resolve_checkpoint(checkpoint):
+        """A trial checkpoint is a CheckpointRef (engine manifest) or, for
+        backward compatibility, a raw payload dict."""
+        from ray_tpu.checkpoint import CheckpointRef
+        if isinstance(checkpoint, CheckpointRef):
+            return checkpoint.load()
+        return checkpoint
 
     def _derive_concurrency(self) -> int:
         try:
@@ -158,7 +188,8 @@ class TrialRunner:
                 trial.trial_id)
         trial._actor = actor
         if restore and trial.checkpoint is not None:
-            ray_tpu.get(actor.restore.remote(trial.checkpoint))
+            ray_tpu.get(actor.restore.remote(
+                self._resolve_checkpoint(trial.checkpoint)))
         trial.status = RUNNING
         if trial.start_time is None:
             trial.start_time = time.time()
@@ -171,7 +202,7 @@ class TrialRunner:
         if trial._actor is not None:
             try:
                 if save:
-                    trial.checkpoint = ray_tpu.get(trial._actor.save.remote())
+                    trial.checkpoint = self._save_trial_checkpoint(trial)
                 ray_tpu.get(trial._actor.stop.remote())
             except Exception as e:
                 logger.debug("trial save/stop failed: %s", e)
@@ -216,7 +247,8 @@ class TrialRunner:
             self._start_trial(trial, restore=True)
             return
         trial.config = new_config
-        ray_tpu.get(trial._actor.restore.remote(donor.checkpoint))
+        ray_tpu.get(trial._actor.restore.remote(
+            self._resolve_checkpoint(donor.checkpoint)))
         trial.checkpoint = donor.checkpoint
         trial._future = trial._actor.train.remote()
 
@@ -237,7 +269,7 @@ class TrialRunner:
     def _maybe_checkpoint(self, trial: Trial, result: Dict[str, Any]):
         it = result.get("training_iteration", 0)
         if self.checkpoint_freq and it % self.checkpoint_freq == 0:
-            trial.checkpoint = ray_tpu.get(trial._actor.save.remote())
+            trial.checkpoint = self._save_trial_checkpoint(trial)
 
     # ------------------------------------------------------------------
     def run(self):
@@ -277,6 +309,7 @@ class TrialRunner:
             self._process_result(trial, ready[0])
             self._syncer.maybe_sync()
         self.save_experiment_state()
+        self._ckpt_engine.close(timeout=5.0)
         self._syncer.maybe_sync(force=True)  # failure logged by the state
         return self.trials
 
@@ -336,7 +369,7 @@ class TrialRunner:
             decision = STOP
         if decision == STOP:
             if self.checkpoint_at_end:
-                trial.checkpoint = ray_tpu.get(trial._actor.save.remote())
+                trial.checkpoint = self._save_trial_checkpoint(trial)
             self.scheduler.on_trial_complete(trial, result)
             if self.searcher is not None:
                 self.searcher.on_trial_complete(trial.trial_id, result)

@@ -31,7 +31,13 @@ class Syncer:
 class _LocalMirrorSyncer(Syncer):
     """rsync-style incremental copy for file:// / plain-path targets:
     only files whose (size, mtime) changed are rewritten, so periodic
-    syncs of a mostly-static experiment dir are cheap."""
+    syncs of a mostly-static experiment dir are cheap. With
+    ``prune_stale`` (the default) the mirror also DELETES entries absent
+    from the source — rolled-back or renamed trial checkpoints must not
+    accumulate in the durable copy forever."""
+
+    def __init__(self, prune_stale: bool = True):
+        self.prune_stale = prune_stale
 
     @staticmethod
     def _strip(uri: str) -> str:
@@ -41,11 +47,14 @@ class _LocalMirrorSyncer(Syncer):
         if not os.path.isdir(src):
             return False
         os.makedirs(dst, exist_ok=True)
+        seen_dirs, seen_files = {"."}, set()
         for root, _dirs, files in os.walk(src):
             rel = os.path.relpath(root, src)
+            seen_dirs.add(rel)
             troot = os.path.join(dst, rel) if rel != "." else dst
             os.makedirs(troot, exist_ok=True)
             for name in files:
+                seen_files.add(os.path.normpath(os.path.join(rel, name)))
                 s = os.path.join(root, name)
                 d = os.path.join(troot, name)
                 try:
@@ -58,7 +67,27 @@ class _LocalMirrorSyncer(Syncer):
                     shutil.copy2(s, d)
                 except OSError:
                     return False
+        if self.prune_stale:
+            self._prune(dst, seen_dirs, seen_files)
         return True
+
+    @staticmethod
+    def _prune(dst: str, seen_dirs, seen_files) -> None:
+        for root, dirs, files in os.walk(dst, topdown=True):
+            rel = os.path.relpath(root, dst)
+            stale_dirs = [d for d in dirs
+                          if os.path.normpath(os.path.join(rel, d))
+                          not in seen_dirs]
+            for d in stale_dirs:
+                shutil.rmtree(os.path.join(root, d), ignore_errors=True)
+                dirs.remove(d)  # pruned subtree: don't descend
+            for name in files:
+                if os.path.normpath(os.path.join(rel, name)) in seen_files:
+                    continue
+                try:
+                    os.unlink(os.path.join(root, name))
+                except OSError:
+                    pass  # raylint: allow(swallow) best-effort cleanup; next sync retries
 
     def sync_up(self, local_dir: str, remote_dir: str) -> bool:
         return self._mirror(local_dir, self._strip(remote_dir))
@@ -74,6 +103,9 @@ class SyncConfig:
     upload_dir: Optional[str] = None
     syncer: Optional[Syncer] = None
     sync_period: float = 300.0
+    # delete mirror entries absent from the source (stale checkpoints of
+    # rolled-back/renamed trials); off = pure-additive mirroring
+    prune_stale: bool = True
 
     def get_syncer(self) -> Optional[Syncer]:
         if not self.upload_dir:
@@ -82,7 +114,7 @@ class SyncConfig:
             return self.syncer
         if (self.upload_dir.startswith("file://")
                 or "://" not in self.upload_dir):
-            return _LocalMirrorSyncer()
+            return _LocalMirrorSyncer(prune_stale=self.prune_stale)
         raise ValueError(
             f"no syncer for {self.upload_dir!r}: schemes other than "
             "file:// need an explicit SyncConfig(syncer=...) (no cloud "

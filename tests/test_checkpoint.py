@@ -1,0 +1,314 @@
+"""Checkpoint engine: sharded round-trip identity, content-hash dedup,
+crash-atomic commit under chaos (process death at every choke point),
+reshard-on-restore across world sizes, GC, and elastic trainer restart.
+
+The crash tests run the save sequence in a subprocess with a
+``RAY_TPU_CHAOS`` schedule that hard-exits mid-write / mid-commit, then
+verify from the parent that the store still resolves to a complete,
+hash-verified checkpoint — previous or new, never torn.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import chaos
+from ray_tpu.air import (Checkpoint, CheckpointConfig, FailureConfig,
+                         RunConfig, ScalingConfig)
+from ray_tpu.checkpoint import (CheckpointEngine, CheckpointError,
+                                CheckpointNotFound, list_manifest_names,
+                                load, read_manifest, resolve_latest)
+from ray_tpu.train import JaxTrainer, session
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- round-trip identity ------------------------------------------------------
+
+def test_round_trip_identity(tmp_path):
+    """A nested pytree with mixed dtypes restores byte-identical: same
+    dtypes, same values, non-array leaves (ints, strings, None) intact."""
+    tree = {
+        "params": {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+                   "b": np.ones(4, dtype=np.float64)},
+        "opt": [np.zeros(3, dtype=np.int32),
+                np.array([True, False, True])],
+        "epoch": 7,
+        "tag": "run-a",
+        "none": None,
+    }
+    eng = CheckpointEngine(str(tmp_path))
+    name = eng.save(tree, step=7, wait=True).result()
+    assert name is not None
+    restored = load(str(tmp_path), name)
+    assert restored["epoch"] == 7
+    assert restored["tag"] == "run-a"
+    assert restored["none"] is None
+    for orig, back in [(tree["params"]["w"], restored["params"]["w"]),
+                       (tree["params"]["b"], restored["params"]["b"]),
+                       (tree["opt"][0], restored["opt"][0]),
+                       (tree["opt"][1], restored["opt"][1])]:
+        assert back.dtype == orig.dtype
+        np.testing.assert_array_equal(back, orig)
+    eng.close()
+
+
+def test_latest_and_missing(tmp_path):
+    with pytest.raises(CheckpointNotFound):
+        load(str(tmp_path / "empty"))
+    eng = CheckpointEngine(str(tmp_path))
+    eng.save({"x": np.arange(3.0)}, step=1, wait=True)
+    eng.save({"x": np.arange(3.0) + 1}, step=2, wait=True)
+    assert eng.latest() == resolve_latest(str(tmp_path))
+    np.testing.assert_array_equal(load(str(tmp_path))["x"],
+                                  np.arange(3.0) + 1)
+    eng.close()
+
+
+# -- content-hash dedup -------------------------------------------------------
+
+def test_warm_save_dedups_to_zero_chunk_bytes(tmp_path):
+    """Saving an unchanged tree again writes ~0 new chunk bytes: every
+    array chunk AND the skeleton dedup against the content store."""
+    tree = {"w": np.random.default_rng(0).normal(size=(64, 64)),
+            "b": np.zeros(64)}
+    eng = CheckpointEngine(str(tmp_path))
+    eng.save(tree, step=1, wait=True)
+    cold_chunks = eng.stats.chunks_written
+    cold_bytes = eng.stats.chunk_bytes_written
+    assert cold_chunks == 3  # w, b, skeleton
+    eng.save(tree, step=2, wait=True)
+    assert eng.stats.chunks_written == cold_chunks
+    assert eng.stats.chunk_bytes_written == cold_bytes
+    assert eng.stats.chunks_deduped == 3
+    assert eng.stats.bytes_deduped > 0
+    # both manifests restore, sharing every chunk
+    names = list_manifest_names(str(tmp_path))
+    assert len(names) == 2
+    assert (read_manifest(str(tmp_path), names[0]).chunk_ids()
+            == read_manifest(str(tmp_path), names[1]).chunk_ids())
+    eng.close()
+
+
+# -- crash atomicity under chaos ----------------------------------------------
+
+_CRASH_PROG = """\
+import sys
+import numpy as np
+from ray_tpu.checkpoint import CheckpointEngine
+root = sys.argv[1]
+eng = CheckpointEngine(root)
+eng.save({"w": np.arange(16.0) * 1, "epoch": 1}, step=1, wait=True)
+eng.save({"w": np.arange(16.0) * 2, "epoch": 2}, step=2, wait=True)
+print("SURVIVED")
+"""
+
+# step 1 fires checkpoint.write twice (array + skeleton) and each commit
+# stage once, so these triggers land inside step 2's save exactly.
+@pytest.mark.parametrize("spec", [
+    "checkpoint.write@3=exit",                  # before step 2's array chunk
+    "checkpoint.commit[stage=manifest]@2=exit",  # before step 2's manifest
+    "checkpoint.commit[stage=latest]@2=exit",    # manifest in, LATEST not
+], ids=["write", "commit-manifest", "commit-latest"])
+def test_crash_leaves_consistent_checkpoint(tmp_path, spec):
+    root = str(tmp_path / "store")
+    env = dict(os.environ, RAY_TPU_CHAOS=f"1:{spec}", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", _CRASH_PROG, root],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=180)
+    assert proc.returncode != 0, proc.stdout + proc.stderr
+    assert "SURVIVED" not in proc.stdout
+
+    # The store must resolve to a COMPLETE checkpoint whose arrays pass
+    # hash verification and agree with its step — previous or new, never a
+    # mix. A crash before the manifest lands must keep step 1 current.
+    name = resolve_latest(root)
+    assert name is not None
+    m = read_manifest(root, name)
+    if spec != "checkpoint.commit[stage=latest]@2=exit":
+        assert m.step == 1
+    restored = load(root, name)
+    assert restored["epoch"] == m.step
+    np.testing.assert_array_equal(restored["w"], np.arange(16.0) * m.step)
+
+
+def test_dropped_write_refuses_torn_manifest(tmp_path):
+    """A lost chunk write (chaos drop) fails the save loudly at commit;
+    the previous checkpoint stays the restore point."""
+    eng = CheckpointEngine(str(tmp_path))
+    eng.save({"w": np.arange(4.0)}, step=1, wait=True)
+    chaos.configure(3, "checkpoint.write@1=drop")
+    try:
+        handle = eng.save({"w": np.full(4, 7.0)}, step=2)
+        with pytest.raises(CheckpointError, match="torn"):
+            handle.result(timeout=30)
+    finally:
+        chaos.clear()
+    assert len(list_manifest_names(str(tmp_path))) == 1
+    np.testing.assert_array_equal(load(str(tmp_path))["w"], np.arange(4.0))
+    eng.close()
+
+
+def test_restore_fault_is_loud_and_retryable(tmp_path):
+    eng = CheckpointEngine(str(tmp_path))
+    eng.save({"w": np.arange(5.0)}, step=1, wait=True)
+    eng.close()
+    chaos.configure(9, "checkpoint.restore@1=error")
+    try:
+        with pytest.raises(chaos.ChaosError):
+            load(str(tmp_path))
+    finally:
+        chaos.clear()
+    # nothing on disk was harmed; the retry succeeds
+    np.testing.assert_array_equal(load(str(tmp_path))["w"], np.arange(5.0))
+
+
+# -- reshard on restore -------------------------------------------------------
+
+def _rank_shard(rank, world):
+    rows = 8 // world
+    lo = rank * rows
+    return {
+        "w": np.arange(24.0).reshape(8, 3)[lo:lo + rows],
+        "bias": np.full(3, 0.5),      # replicated: identical on every rank
+        "step": 1,
+    }
+
+
+def _save_sharded(root, world=4):
+    engines = [CheckpointEngine(root) for _ in range(world)]
+    handles = [engines[r].save(_rank_shard(r, world), step=1, rank=r,
+                               world_size=world, shard_axis=0)
+               for r in range(world)]
+    name = handles[0].result(timeout=60)
+    for e in engines:
+        e.close()
+    return name
+
+
+def test_sharded_round_trip_same_world(tmp_path):
+    root = str(tmp_path)
+    name = _save_sharded(root)
+    for r in range(4):
+        back = load(root, name, rank=r, world_size=4)
+        np.testing.assert_array_equal(back["w"], _rank_shard(r, 4)["w"])
+        np.testing.assert_array_equal(back["bias"], np.full(3, 0.5))
+        assert back["step"] == 1
+
+
+@pytest.mark.parametrize("new_world", [2, 8], ids=["shrink", "grow"])
+def test_restore_reshards_to_new_world(tmp_path, new_world):
+    """A 4-way axis-0 save restores onto a different world size: each new
+    rank gets its equal slice of the reassembled global array, and
+    replicated leaves restore everywhere."""
+    root = str(tmp_path)
+    name = _save_sharded(root, world=4)
+    glob = np.arange(24.0).reshape(8, 3)
+    rows = 8 // new_world
+    for r in range(new_world):
+        back = load(root, name, rank=r, world_size=new_world)
+        np.testing.assert_array_equal(back["w"],
+                                      glob[r * rows:(r + 1) * rows])
+        np.testing.assert_array_equal(back["bias"], np.full(3, 0.5))
+
+
+# -- GC and retention ---------------------------------------------------------
+
+def test_prune_and_gc_reap_unreferenced_chunks(tmp_path):
+    root = str(tmp_path)
+    eng = CheckpointEngine(root, num_to_keep=1)
+    eng.save({"w": np.arange(8.0)}, step=1, wait=True)
+    eng.save({"w": np.arange(8.0) + 100}, step=2, wait=True)
+    # retention pruned step 1's manifest; its now-orphaned chunks are gone
+    assert len(list_manifest_names(root)) == 1
+    assert eng.stats.chunks_gced >= 1
+    np.testing.assert_array_equal(load(root)["w"], np.arange(8.0) + 100)
+
+    # a crashed save's residue (an unreferenced chunk file) is reaped too
+    orphan_dir = os.path.join(root, "chunks", "ff")
+    os.makedirs(orphan_dir, exist_ok=True)
+    with open(os.path.join(orphan_dir, "ff" + "0" * 62), "wb") as f:
+        f.write(b"orphaned by a crash")
+    assert eng.gc() == 1
+    np.testing.assert_array_equal(load(root)["w"], np.arange(8.0) + 100)
+    eng.close()
+
+
+# -- trainer integration: elastic restart under chaos -------------------------
+
+def _chaos_loop(config):
+    """Reports 6 epochs; a chaos rule kills epoch 3 on the first attempt
+    only (the restart resumes past the trigger's event window)."""
+    from ray_tpu import chaos as ch
+    ch.configure(11, "train.step@4=error")
+    try:
+        ckpt = session.get_checkpoint()
+        start = 0 if ckpt is None else ckpt.to_dict()["epoch"] + 1
+        for epoch in range(start, 6):
+            ch.inject("train.step")
+            session.report(
+                {"epoch": epoch},
+                checkpoint=Checkpoint.from_dict(
+                    {"epoch": epoch, "w": np.full(4, float(epoch))}))
+    finally:
+        ch.clear()
+
+
+def test_trainer_elastic_restart_from_committed_manifest(ray_start_regular,
+                                                         tmp_path):
+    """A deterministic chaos kill mid-run restarts the group from the last
+    ENGINE-committed manifest: training resumes at the crash epoch instead
+    of from scratch, and the final state is a committed manifest."""
+    trainer = JaxTrainer(
+        _chaos_loop, train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="exp", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=2),
+            checkpoint_config=CheckpointConfig(num_to_keep=3)),
+        collective_backend=None)
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["epoch"] == 5
+    epochs = [m["epoch"] for m in result.metrics_history]
+    assert epochs == [0, 1, 2, 3, 4, 5]   # resumed, no epoch re-run
+    root = str(tmp_path / "exp" / "checkpoints")
+    final = Checkpoint.from_manifest(root).to_dict()
+    assert final["epoch"] == 5
+    np.testing.assert_array_equal(final["w"], np.full(4, 5.0))
+    assert len(list_manifest_names(root)) <= 3
+
+
+# -- executor: partial final-checkpoint collection ----------------------------
+
+def test_get_final_checkpoints_partial_on_dead_worker(ray_start_regular):
+    from ray_tpu._private.config import _config
+    from ray_tpu.train.backend_executor import BackendExecutor
+
+    def loop(config):
+        session.report(
+            {"ok": 1},
+            checkpoint=Checkpoint.from_dict(
+                {"rank": session.get_world_rank()}))
+
+    old = _config.get("checkpoint_final_timeout_s")
+    _config.set("checkpoint_final_timeout_s", 2.0)
+    ex = BackendExecutor(2, {"CPU": 1}, collective_backend=None)
+    try:
+        ex.start()
+        ex.start_training(loop, {})
+        while ex.get_next_results() is not None:
+            pass
+        ray_tpu.kill(ex.workers[1])
+        finals = ex.get_final_checkpoints()
+        assert len(finals) == 2
+        assert finals[0] is not None
+        assert finals[0].to_dict()["rank"] == 0
+        assert finals[1] is None     # dead worker: partial result, no hang
+    finally:
+        _config.set("checkpoint_final_timeout_s", old)
+        ex.shutdown()

@@ -304,6 +304,48 @@ def test_r7_quiet_on_poll_policy_and_allow(tmp_path):
     assert findings == []
 
 
+# -- R9: direct checkpoint directory I/O in train/tune/serve -----------------
+
+def run_rule_in_tree(tmp_path, rule_id, relpath, source):
+    """Lint a file placed at ``relpath`` under a package dir, so rules that
+    scope on path segments (R9) see a real relative path, not a bare name."""
+    path = tmp_path / "pkg" / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    eng = LintEngine([str(tmp_path / "pkg")], only_rules={rule_id})
+    findings = eng.run()
+    assert not eng.errors, eng.errors
+    return findings
+
+
+def test_r9_fires_on_directory_io_in_train(tmp_path):
+    findings = run_rule_in_tree(tmp_path, "R9", "train/trainer.py", """\
+        def persist(checkpoint, path):
+            checkpoint.to_directory(path)
+
+        def resume(cls, path):
+            return cls.from_directory(path)
+    """)
+    assert [f.rule for f in findings] == ["R9", "R9"]
+    assert "to_directory" in findings[0].message
+    assert "manifest" in findings[0].message
+
+
+def test_r9_quiet_outside_scope_and_on_allow(tmp_path):
+    # air/ is the conversion layer — out of scope by path.
+    findings = run_rule_in_tree(tmp_path, "R9", "air/checkpoint.py", """\
+        def persist(checkpoint, path):
+            checkpoint.to_directory(path)
+    """)
+    assert findings == []
+    # In scope, but justified with an allow comment.
+    findings = run_rule_in_tree(tmp_path, "R9", "tune/export.py", """\
+        def export(checkpoint, path):
+            checkpoint.to_directory(path)  # raylint: allow(direct-checkpoint-io) user-facing blob export
+    """)
+    assert findings == []
+
+
 def test_proto_parser_sees_real_schema():
     schema = parse_proto_text(open(PROTO, encoding="utf-8").read())
     assert "TaskSpecMsg" in schema

@@ -143,6 +143,9 @@ def test_checkpoint_directory_roundtrip(tmp_path):
 
 def test_trainer_persists_checkpoints_with_pruning(ray_start_regular,
                                                    tmp_path):
+    """storage_path routes reported checkpoints through the engine:
+    manifests are pruned to num_to_keep and the newest commit restores."""
+
     def loop(config):
         for epoch in range(5):
             session.report({"epoch": epoch},
@@ -159,11 +162,11 @@ def test_trainer_persists_checkpoints_with_pruning(ray_start_regular,
         collective_backend=None)
     result = trainer.fit()
     assert result.error is None
-    import os
-    kept = sorted(os.listdir(tmp_path / "exp"))
+    from ray_tpu.checkpoint import list_manifest_names
+    root = str(tmp_path / "exp" / "checkpoints")
+    kept = list_manifest_names(root)
     assert len(kept) == 2
-    restored = Checkpoint.from_directory(
-        str(tmp_path / "exp" / kept[-1])).to_dict()
+    restored = Checkpoint.from_manifest(root).to_dict()
     assert restored["epoch"] == 4
 
 

@@ -100,7 +100,9 @@ def test_class_trainable_and_checkpoint_freq(tmp_path):
                         verbose=0)
     t = analysis.trials[0]
     assert t.last_result["x"] == 6
-    assert t.checkpoint is not None and t.checkpoint["data"]["x"] in (4, 6)
+    # trial checkpoints are engine manifest refs, not payload blobs
+    assert t.checkpoint is not None
+    assert t.checkpoint.load()["data"]["x"] in (4, 6)
 
 
 def test_trial_failure_restart_from_checkpoint(tmp_path):
@@ -639,3 +641,39 @@ def test_syncer_incremental_and_schemes(tmp_path):
     restored = tmp_path / "restored"
     assert s.sync_down(str(dst), str(restored))
     assert (restored / "a.txt").read_text() == "one"
+
+
+def test_syncer_prunes_stale_mirror_entries(tmp_path):
+    """Files and directories deleted at the source (pruned trial
+    checkpoints) disappear from the mirror on the next sync; prune_stale
+    =False keeps the old accumulate-forever behavior."""
+    from ray_tpu.tune.syncer import SyncConfig, _LocalMirrorSyncer
+    src = tmp_path / "src"
+    dst = tmp_path / "dst"
+    (src / "trial" / "ckpt-old").mkdir(parents=True)
+    (src / "trial" / "ckpt-old" / "state.json").write_text("{}")
+    (src / "keep.txt").write_text("keep")
+    s = _LocalMirrorSyncer()
+    assert s.sync_up(str(src), str(dst))
+    assert (dst / "trial" / "ckpt-old" / "state.json").exists()
+
+    import shutil
+    shutil.rmtree(src / "trial" / "ckpt-old")
+    (src / "trial" / "new.txt").write_text("new")
+    assert s.sync_up(str(src), str(dst))
+    assert not (dst / "trial" / "ckpt-old").exists()   # pruned with src
+    assert (dst / "trial" / "new.txt").read_text() == "new"
+    assert (dst / "keep.txt").read_text() == "keep"
+
+    # opt-out preserves stale mirror entries
+    (src / "trial" / "stale.txt").write_text("x")
+    s2 = _LocalMirrorSyncer(prune_stale=False)
+    assert s2.sync_up(str(src), str(dst))
+    os.unlink(src / "trial" / "stale.txt")
+    assert s2.sync_up(str(src), str(dst))
+    assert (dst / "trial" / "stale.txt").exists()
+
+    # the flag rides through SyncConfig
+    assert SyncConfig(upload_dir=str(dst)).get_syncer().prune_stale
+    assert not SyncConfig(upload_dir=str(dst),
+                          prune_stale=False).get_syncer().prune_stale
