@@ -182,7 +182,8 @@ def bench_checkpoint(mb: int = 64):
         engines = [CheckpointEngine(root) for _ in range(world)]
         handles = [
             engines[r].save({"w": glob[r * 1024:(r + 1) * 1024]}, step=1,
-                            rank=r, world_size=world, shard_axis=0)
+                            rank=r, world_size=world, shard_axis=0,
+                            shard_paths=("w",))
             for r in range(world)]
         name = handles[0].result(timeout=600)
         for e in engines:

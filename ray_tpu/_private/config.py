@@ -187,6 +187,11 @@ _config.define("checkpoint_shard_wait_s", float, 60.0,
 _config.define("checkpoint_final_timeout_s", float, 10.0,
                "per-worker deadline when collecting final checkpoints at "
                "trainer shutdown; a dead worker forfeits its slot")
+_config.define("checkpoint_gc_grace_s", float, 300.0,
+               "gc leaves unreferenced chunk/tmp files younger than this "
+               "alone: peer ranks on the same root write chunks before "
+               "their shard index lands, and a tmp file may be one "
+               "os.replace away from becoming a live chunk")
 
 # -- Host-shared object plane ---------------------------------------------------
 _config.define("arena_enabled", bool, True,

@@ -25,6 +25,12 @@ Restore reshards when the world size changed: replicated saves hand any
 shard to any rank; axis-sharded saves are reassembled into global arrays
 from the per-shard offsets recorded at commit, then re-split
 ``lo = r*dim//W, hi = (r+1)*dim//W`` along the shard axis for the new world.
+Which leaves are axis-split is DECLARED at save time (``shard_paths``
+fnmatch patterns against the "/"-joined leaf path) and stamped into each
+shard index — never inferred from data, so per-rank-distinct but logically
+replicated leaves (RNG keys, rank-local counters) of matching shapes can't
+be misread as one split array. Undeclared leaves restore replicated
+(rank 0's copy when the world changes).
 
 Chaos choke points: ``checkpoint.write`` (per chunk, labels path/rank),
 ``checkpoint.commit`` (labels stage=manifest|latest, step), and
@@ -33,6 +39,7 @@ Chaos choke points: ``checkpoint.write`` (per chunk, labels path/rank),
 
 from __future__ import annotations
 
+import fnmatch
 import json
 import logging
 import os
@@ -165,6 +172,7 @@ class _SaveJob:
     rank: int
     world_size: int
     shard_axis: Optional[int]
+    shard_paths: Optional[Tuple[str, ...]]
     mesh: Optional[Dict[str, Any]]
     meta: Dict[str, Any]
     save_key: str
@@ -195,14 +203,28 @@ class CheckpointEngine:
 
     def save(self, tree: Any, *, step: int, rank: int = 0,
              world_size: int = 1, shard_axis: Optional[int] = None,
+             shard_paths: Optional[Any] = None,
              mesh: Optional[Dict[str, Any]] = None,
              meta: Optional[Dict[str, Any]] = None,
              save_key: Optional[str] = None,
              wait: bool = False) -> SaveHandle:
         """Snapshot ``tree`` (this rank's shard of it). Returns once the
-        device->host copy is enqueued; ``wait=True`` blocks through commit."""
+        device->host copy is enqueued; ``wait=True`` blocks through commit.
+
+        ``shard_paths`` is required with ``shard_axis``: an iterable of
+        fnmatch patterns over "/"-joined leaf paths naming exactly which
+        leaves are split along the axis (``["params/*", "opt/mu/*"]``).
+        Everything unmatched is treated as replicated — the engine never
+        infers placement from shard contents.
+        """
         if self._closed:
             raise CheckpointError("engine is closed")
+        if (shard_axis is None) != (shard_paths is None):
+            raise CheckpointError(
+                "shard_axis and shard_paths must be passed together: the "
+                "caller declares which leaves are axis-split (fnmatch "
+                "patterns over '/'-joined paths); placement is never "
+                "inferred from data")
         arrays: List[Tuple[str, np.ndarray]] = []
         skeleton = _extract_arrays(tree, (), arrays)
         handle = SaveHandle(step, rank)
@@ -210,7 +232,10 @@ class CheckpointEngine:
             handle=handle,
             skeleton_frame=bytes(dumps_framed(skeleton)),
             arrays=arrays, step=step, rank=rank, world_size=world_size,
-            shard_axis=shard_axis, mesh=mesh, meta=dict(meta or {}),
+            shard_axis=shard_axis,
+            shard_paths=(None if shard_paths is None
+                         else tuple(str(p) for p in shard_paths)),
+            mesh=mesh, meta=dict(meta or {}),
             save_key=save_key or f"step-{step:08d}")
         self._ensure_writer()
         with self._writer_lock:
@@ -297,7 +322,10 @@ class CheckpointEngine:
                 # instead of publishing a manifest missing the array
                 entries.append(ArrayEntry(
                     path=path, slot=slot, chunk=chunk_id, nbytes=arr.nbytes,
-                    dtype=arr.dtype.str, shape=list(arr.shape)))
+                    dtype=arr.dtype.str, shape=list(arr.shape),
+                    sharded=(job.shard_paths is not None and any(
+                        fnmatch.fnmatchcase(path, pat)
+                        for pat in job.shard_paths))))
             skel_id = mf.hash_bytes("skeleton", job.skeleton_frame)
             protected.append(skel_id)
             self._inflight_chunks.add(skel_id)
@@ -398,7 +426,11 @@ class CheckpointEngine:
     # -- retention / GC -------------------------------------------------------
 
     def _prune(self, keep: int) -> None:
-        names = mf.list_manifest_names(self.root)
+        # Retention keeps the most recently COMMITTED manifests (file
+        # mtime), not the highest step numbers: a step counter that
+        # restarted after a crash writes fresh low-step manifests which
+        # must out-live stale pre-crash high-step ones.
+        names = mf.list_manifest_names_by_commit_time(self.root)
         for name in names[:-keep] if keep > 0 else names:
             try:
                 os.unlink(os.path.join(self.root, mf.MANIFESTS_DIR, name))
@@ -408,7 +440,16 @@ class CheckpointEngine:
 
     def gc(self) -> int:
         """Reap chunk files no committed manifest references (crashed saves
-        leave orphans by design). In-flight saves' chunks are protected."""
+        leave orphans by design).
+
+        Every rank runs its own engine on the same shared root, so "live"
+        must be judged cross-process, not from this instance alone: chunks
+        named by any ``pending/`` shard index belong to a save some
+        committer may still publish, and any file younger than
+        ``checkpoint_gc_grace_s`` is left alone — a peer's freshly written
+        chunk may precede its shard index, and unlinking a peer's tmp file
+        would fail its imminent ``os.replace``.
+        """
         referenced = set(self._inflight_chunks)
         for name in mf.list_manifest_names(self.root):
             try:
@@ -419,6 +460,14 @@ class CheckpointEngine:
                                "%s (its chunks stay protected-by-absence)",
                                name)
                 return 0  # cannot prove anything is orphaned
+        grace = max(0.0, float(_config.checkpoint_gc_grace_s))
+        # stale pending indexes (older than the committer's shard-wait
+        # deadline plus grace) can never join a commit — ignore them so a
+        # crashed attempt's residue doesn't pin chunks forever
+        referenced.update(mf.pending_chunk_ids(
+            self.root,
+            max_age_s=float(_config.checkpoint_shard_wait_s) + grace))
+        now = time.time()
         reaped = 0
         chunks_dir = os.path.join(self.root, mf.CHUNKS_DIR)
         for sub in os.listdir(chunks_dir):
@@ -428,11 +477,14 @@ class CheckpointEngine:
             for fn in os.listdir(subdir):
                 if fn.split(".tmp-")[0] in referenced and ".tmp-" not in fn:
                     continue
+                path = os.path.join(subdir, fn)
                 try:
-                    os.unlink(os.path.join(subdir, fn))
+                    if grace and now - os.path.getmtime(path) < grace:
+                        continue
+                    os.unlink(path)
                     reaped += 1
                 except OSError as e:
-                    logger.debug("checkpoint: gc unlink failed: %s", e)
+                    logger.debug("checkpoint: gc skipped %s: %s", fn, e)
         self.stats.chunks_gced += reaped
         return reaped
 
@@ -504,27 +556,37 @@ def _load_shard(root: str, shard: ShardIndex, verify: bool) -> Any:
 
 
 def _finalize_sharding(shards: List[ShardIndex], axis: int) -> None:
-    """Stamp global_shape/offset onto entries that are genuinely split
-    along ``axis`` (same path, same non-axis dims across all ranks).
-    Anything else — scalars, replicated leaves — restores replicated."""
+    """Stamp global_shape/offset onto the leaves the ranks DECLARED split
+    along ``axis`` (``save(shard_paths=...)`` → ``ArrayEntry.sharded``).
+    Undeclared leaves — scalars, replicated params, per-rank-distinct RNG
+    keys — restore replicated; a declared leaf whose shards don't actually
+    assemble (missing on a rank, inconsistent flags, axis out of range,
+    mismatched non-axis dims) fails the commit loudly rather than
+    publishing a manifest that reshards into garbage."""
     by_path: Dict[str, List[ArrayEntry]] = {}
     for s in shards:
         for e in s.arrays:
             by_path.setdefault(e.path, []).append(e)
     nranks = len(shards)
     for path, entries in by_path.items():
-        if len(entries) != nranks:
+        marked = sum(1 for e in entries if e.sharded)
+        if marked == 0:
             continue
-        if len({e.chunk for e in entries}) == 1 and nranks > 1:
-            # byte-identical on every rank: a replicated leaf, not an
-            # axis-split one — reassembling would tile it
-            continue
+        if marked != len(entries) or len(entries) != nranks:
+            raise CheckpointError(
+                f"leaf {path!r} is declared axis-split on {marked} of "
+                f"{len(entries)} entries across {nranks} ranks — every "
+                "rank must save it with a matching shard_paths pattern")
         shapes = [e.shape for e in entries]
         if any(len(sh) <= axis for sh in shapes):
-            continue
+            raise CheckpointError(
+                f"leaf {path!r} is declared split along axis {axis} but "
+                f"has shape(s) {shapes} without that axis")
         base = shapes[0][:axis] + shapes[0][axis + 1:]
         if any(sh[:axis] + sh[axis + 1:] != base for sh in shapes[1:]):
-            continue
+            raise CheckpointError(
+                f"leaf {path!r} is declared split along axis {axis} but "
+                f"non-axis dims differ across ranks: {shapes}")
         total = sum(sh[axis] for sh in shapes)
         off = 0
         for e in entries:   # shards arrive rank-sorted from the committer

@@ -104,6 +104,9 @@ class ArrayEntry:
     nbytes: int
     dtype: str
     shape: List[int]
+    # Declared by the caller at save time (save(shard_paths=...)); the
+    # committer trusts this flag — placement is never inferred from data.
+    sharded: bool = False
     global_shape: Optional[List[int]] = None   # set when sharded
     offset: Optional[List[int]] = None         # per-dim start inside global
 
@@ -111,6 +114,8 @@ class ArrayEntry:
         d = {"path": self.path, "slot": self.slot, "chunk": self.chunk,
              "nbytes": self.nbytes, "dtype": self.dtype,
              "shape": list(self.shape)}
+        if self.sharded:
+            d["sharded"] = True
         if self.global_shape is not None:
             d["global_shape"] = list(self.global_shape)
             d["offset"] = list(self.offset or [0] * len(self.global_shape))
@@ -121,6 +126,7 @@ class ArrayEntry:
         return cls(path=d["path"], slot=d["slot"], chunk=d["chunk"],
                    nbytes=d["nbytes"], dtype=d["dtype"],
                    shape=list(d["shape"]),
+                   sharded=bool(d.get("sharded", False)),
                    global_shape=d.get("global_shape"),
                    offset=d.get("offset"))
 
@@ -245,6 +251,60 @@ def list_manifest_names(root: str) -> List[str]:
                   if n.startswith("ck-") and n.endswith(".json"))
 
 
+def list_manifest_names_by_commit_time(root: str) -> List[str]:
+    """Manifest names oldest-commit-first (file mtime, name tie-break).
+
+    Retention and the LATEST fallback scan order by *commit recency*, not
+    by the step embedded in the filename: a caller whose step counter
+    restarted (a new engine attempt after a crash) must never have its
+    fresh commits out-sorted — and reaped — by stale higher-step
+    manifests from before the crash.
+    """
+    def mtime(name: str) -> float:
+        try:
+            return os.path.getmtime(os.path.join(root, MANIFESTS_DIR, name))
+        except OSError:
+            return 0.0
+    return sorted(list_manifest_names(root), key=lambda n: (mtime(n), n))
+
+
+def pending_chunk_ids(root: str,
+                      max_age_s: Optional[float] = None) -> set:
+    """Chunk ids referenced by any rank's pending/ shard index — an
+    in-flight save that some committer may still publish. GC must treat
+    these as live even though no committed manifest names them yet.
+    Indexes older than ``max_age_s`` are ignored: the committer's
+    shard-wait deadline has long expired, so they can never join a commit
+    (crashed attempts must not protect their residue forever)."""
+    out: set = set()
+    pend = os.path.join(root, PENDING_DIR)
+    try:
+        keys = os.listdir(pend)
+    except OSError:
+        return out
+    now = time.time()
+    for key in keys:
+        d = os.path.join(pend, key)
+        try:
+            files = os.listdir(d)
+        except OSError:
+            continue
+        for fn in files:
+            if not (fn.startswith("shard-") and fn.endswith(".json")):
+                continue
+            path = os.path.join(d, fn)
+            try:
+                if max_age_s is not None \
+                        and now - os.path.getmtime(path) > max_age_s:
+                    continue
+                with open(path, encoding="utf-8") as f:
+                    out.update(ShardIndex.from_json(
+                        json.load(f)["shard"]).chunk_ids())
+            except (OSError, json.JSONDecodeError, KeyError, TypeError):
+                continue  # torn/stale index protects nothing
+    return out
+
+
 def chunks_present(root: str, m: Manifest) -> bool:
     return all(os.path.exists(os.path.join(root, chunk_relpath(c)))
                for c in m.chunk_ids())
@@ -254,8 +314,8 @@ def resolve_latest(root: str) -> Optional[str]:
     """Name of the newest *complete* committed manifest, or None.
 
     Trusts ``LATEST`` when it points at a manifest whose chunks all exist
-    (the normal case); otherwise scans ``manifests/`` newest-first and
-    returns the first fully-present one — this is what makes a crash
+    (the normal case); otherwise scans ``manifests/`` newest-commit-first
+    and returns the first fully-present one — this is what makes a crash
     between manifest rename and LATEST update harmless.
     """
     try:
@@ -269,7 +329,7 @@ def resolve_latest(root: str) -> Optional[str]:
                 return name
         except CheckpointError:
             pass
-    for name in reversed(list_manifest_names(root)):
+    for name in reversed(list_manifest_names_by_commit_time(root)):
         try:
             if chunks_present(root, read_manifest(root, name)):
                 return name

@@ -43,18 +43,26 @@ class Replica:
         self._total = 0
         self._lock = threading.Lock()
         self._draining = False
+        self._is_function = inspect.isfunction(func_or_class)
         if checkpoint is not None:
+            if self._is_function:
+                # Only class replicas have an __init__ to receive the
+                # restored tree; silently dropping the checkpoint would
+                # serve uninitialized weights.
+                raise ValueError(
+                    f"deployment {deployment_name!r}: checkpoint= requires "
+                    "a class deployment (the restored pytree is injected "
+                    "as the checkpoint= init kwarg); a function replica "
+                    "has nowhere to receive it")
             # Cold start from an engine manifest: the weights pytree loads
             # from the content-addressed store HERE, on the replica — the
             # controller only ever shipped the (root, manifest) pointer.
             init_kwargs = dict(init_kwargs or {})
             init_kwargs["checkpoint"] = _load_checkpoint(checkpoint)
-        if inspect.isfunction(func_or_class):
+        if self._is_function:
             self._callable = func_or_class
-            self._is_function = True
         else:
             self._callable = func_or_class(*init_args, **(init_kwargs or {}))
-            self._is_function = False
         if user_config is not None:
             self.reconfigure(user_config)
 

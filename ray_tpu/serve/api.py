@@ -8,6 +8,7 @@ deploys it, ``serve.start`` brings up the controller and HTTP proxy.
 """
 
 from __future__ import annotations
+import inspect
 import logging
 
 import threading
@@ -126,6 +127,11 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
     """
 
     def wrap(func_or_class):
+        if checkpoint is not None and inspect.isfunction(func_or_class):
+            raise ValueError(
+                "@serve.deployment(checkpoint=...) requires a class: the "
+                "restored pytree is injected as the replica's checkpoint= "
+                "init kwarg, which a function deployment cannot receive")
         if isinstance(autoscaling_config, dict):
             asc = AutoscalingConfig(**autoscaling_config)
         else:

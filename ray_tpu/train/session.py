@@ -42,7 +42,12 @@ class _TrainSession:
         # driver restarts from a committed manifest, not a driver-memory blob.
         self.checkpoint_spec = checkpoint_spec
         self.checkpoint_engine = None
-        self._ckpt_seq = 0
+        # Resume step numbering after the last committed manifest
+        # (spec["base_step"], carried across elastic restarts by the
+        # trainer) — a counter that restarted at 0 would write manifests
+        # that sort BELOW the stale pre-crash ones, and retention would
+        # reap the fresh commits instead of the stale ones.
+        self._ckpt_seq = int((checkpoint_spec or {}).get("base_step") or 0)
 
     def _engine(self):
         if self.checkpoint_engine is None and self.checkpoint_spec:

@@ -133,11 +133,30 @@ class JaxTrainer:
             return None
         cfg = self.run_config.checkpoint_config
         # run_token namespaces pending/ save keys per attempt, so shard
-        # indexes left by a crashed attempt can never join a new commit
+        # indexes left by a crashed attempt can never join a new commit.
+        # base_step carries the step counter across attempts: a restarted
+        # session resumes numbering AFTER the last committed manifest, so
+        # retention (which keeps the newest commits) and the LATEST
+        # fallback scan see one monotonic step sequence instead of a
+        # post-crash counter reset shadowed by stale pre-crash manifests.
         return {"root": engine_root,
                 "num_to_keep": cfg.num_to_keep,
                 "frequency": cfg.checkpoint_frequency,
+                "base_step": self._committed_step(engine_root),
                 "run_token": uuid.uuid4().hex[:8]}
+
+    def _committed_step(self, engine_root: str) -> int:
+        from ray_tpu.checkpoint import (CheckpointError, read_manifest,
+                                        resolve_latest)
+        try:
+            name = resolve_latest(engine_root)
+            if name is None:
+                return 0
+            return int(read_manifest(engine_root, name).step)
+        except CheckpointError as e:
+            logger.warning("could not read last committed step (restarting "
+                           "the counter from 0): %s", e)
+            return 0
 
     def _committed_checkpoint(self, engine_root: Optional[str]):
         if engine_root is None:

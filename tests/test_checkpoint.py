@@ -175,6 +175,7 @@ def _rank_shard(rank, world):
     return {
         "w": np.arange(24.0).reshape(8, 3)[lo:lo + rows],
         "bias": np.full(3, 0.5),      # replicated: identical on every rank
+        "rng": np.full(2, float(rank)),   # replicated but PER-RANK DISTINCT
         "step": 1,
     }
 
@@ -182,7 +183,8 @@ def _rank_shard(rank, world):
 def _save_sharded(root, world=4):
     engines = [CheckpointEngine(root) for _ in range(world)]
     handles = [engines[r].save(_rank_shard(r, world), step=1, rank=r,
-                               world_size=world, shard_axis=0)
+                               world_size=world, shard_axis=0,
+                               shard_paths=("w",))
                for r in range(world)]
     name = handles[0].result(timeout=60)
     for e in engines:
@@ -197,6 +199,8 @@ def test_sharded_round_trip_same_world(tmp_path):
         back = load(root, name, rank=r, world_size=4)
         np.testing.assert_array_equal(back["w"], _rank_shard(r, 4)["w"])
         np.testing.assert_array_equal(back["bias"], np.full(3, 0.5))
+        # undeclared leaves keep their per-rank values at the same world
+        np.testing.assert_array_equal(back["rng"], np.full(2, float(r)))
         assert back["step"] == 1
 
 
@@ -204,7 +208,9 @@ def test_sharded_round_trip_same_world(tmp_path):
 def test_restore_reshards_to_new_world(tmp_path, new_world):
     """A 4-way axis-0 save restores onto a different world size: each new
     rank gets its equal slice of the reassembled global array, and
-    replicated leaves restore everywhere."""
+    leaves not declared in shard_paths restore replicated — including
+    per-rank-distinct ones of matching shapes (RNG keys), which placement
+    inference used to misread as one split array and tile into garbage."""
     root = str(tmp_path)
     name = _save_sharded(root, world=4)
     glob = np.arange(24.0).reshape(8, 3)
@@ -214,11 +220,51 @@ def test_restore_reshards_to_new_world(tmp_path, new_world):
         np.testing.assert_array_equal(back["w"],
                                       glob[r * rows:(r + 1) * rows])
         np.testing.assert_array_equal(back["bias"], np.full(3, 0.5))
+        # undeclared ⇒ replicated from rank 0, ORIGINAL shape — never a
+        # concatenation of the four per-rank values re-sliced
+        np.testing.assert_array_equal(back["rng"], np.zeros(2))
+
+
+def test_shard_axis_requires_explicit_paths(tmp_path):
+    """Placement is declared, never inferred: shard_axis without
+    shard_paths (and vice versa) is rejected up front."""
+    eng = CheckpointEngine(str(tmp_path))
+    with pytest.raises(CheckpointError, match="shard_paths"):
+        eng.save({"w": np.arange(4.0)}, step=1, world_size=2, shard_axis=0)
+    with pytest.raises(CheckpointError, match="shard_paths"):
+        eng.save({"w": np.arange(4.0)}, step=1, shard_paths=("w",))
+    eng.close()
+
+
+def test_declared_shard_mismatch_fails_commit_loudly(tmp_path):
+    """A leaf declared axis-split whose shards don't assemble (non-axis
+    dims differ across ranks) must abandon the save at commit instead of
+    publishing a manifest that reshards into garbage."""
+    root = str(tmp_path)
+    engines = [CheckpointEngine(root) for _ in range(2)]
+    handles = [engines[r].save(
+        {"w": np.zeros((2, 3 + r))},   # rank-dependent non-axis dim
+        step=1, rank=r, world_size=2, shard_axis=0, shard_paths=("w",))
+        for r in range(2)]
+    with pytest.raises(CheckpointError, match="non-axis dims"):
+        handles[0].result(timeout=60)
+    assert list_manifest_names(root) == []
+    for e in engines:
+        e.close(timeout=5.0)
 
 
 # -- GC and retention ---------------------------------------------------------
 
-def test_prune_and_gc_reap_unreferenced_chunks(tmp_path):
+@pytest.fixture
+def no_gc_grace():
+    from ray_tpu._private.config import _config
+    old = _config.get("checkpoint_gc_grace_s")
+    _config.set("checkpoint_gc_grace_s", 0.0)
+    yield
+    _config.set("checkpoint_gc_grace_s", old)
+
+
+def test_prune_and_gc_reap_unreferenced_chunks(tmp_path, no_gc_grace):
     root = str(tmp_path)
     eng = CheckpointEngine(root, num_to_keep=1)
     eng.save({"w": np.arange(8.0)}, step=1, wait=True)
@@ -236,6 +282,105 @@ def test_prune_and_gc_reap_unreferenced_chunks(tmp_path):
     assert eng.gc() == 1
     np.testing.assert_array_equal(load(root)["w"], np.arange(8.0) + 100)
     eng.close()
+
+
+def test_gc_spares_other_processes_inflight_work(tmp_path, no_gc_grace):
+    """Every rank runs its own engine on the shared root, so gc must judge
+    liveness cross-process: chunks named by a pending/ shard index (a save
+    another rank's committer may still publish) and files younger than the
+    grace window (a peer's tmp mid-os.replace, or a chunk written before
+    its shard index lands) survive; stale residue does not."""
+    import json as _json
+    from ray_tpu._private.config import _config
+    from ray_tpu.checkpoint.manifest import ShardIndex
+
+    root = str(tmp_path)
+    eng = CheckpointEngine(root)
+    eng.save({"w": np.arange(6.0)}, step=1, wait=True)
+
+    # another process's in-flight save: an indexed chunk, nothing committed
+    peer_chunk = "ab" + "1" * 62
+    chunk_dir = os.path.join(root, "chunks", "ab")
+    os.makedirs(chunk_dir, exist_ok=True)
+    peer_path = os.path.join(chunk_dir, peer_chunk)
+    with open(peer_path, "wb") as f:
+        f.write(b"peer rank's next save")
+    os.utime(peer_path, (1.0, 1.0))   # old: only the pending index saves it
+    pend = os.path.join(root, "pending", "step-00000002")
+    os.makedirs(pend, exist_ok=True)
+    shard = ShardIndex(rank=1, skeleton=peer_chunk, skeleton_nbytes=0)
+    with open(os.path.join(pend, "shard-1.json"), "w") as f:
+        _json.dump({"step": 2, "world_size": 2, "shard": shard.to_json()},
+                   f)
+    assert eng.gc() == 0
+    assert os.path.exists(peer_path)
+
+    # a fresh tmp file (a peer one os.replace away) survives the grace
+    # window; with the grace elapsed it is crash residue and is reaped
+    _config.set("checkpoint_gc_grace_s", 300.0)
+    tmp_file = os.path.join(chunk_dir, "cd" + "2" * 62 + ".tmp-99-1")
+    with open(tmp_file, "wb") as f:
+        f.write(b"mid-write")
+    assert eng.gc() == 0
+    assert os.path.exists(tmp_file)
+    _config.set("checkpoint_gc_grace_s", 0.0)
+    assert eng.gc() == 1
+    assert not os.path.exists(tmp_file)
+
+    # once the pending index is stale (older than the committer's
+    # shard-wait deadline), it stops protecting its chunks
+    idx = os.path.join(pend, "shard-1.json")
+    os.utime(idx, (1.0, 1.0))
+    assert eng.gc() == 1
+    assert not os.path.exists(peer_path)
+    eng.close()
+
+
+def test_retention_keeps_newest_commits_after_step_counter_reset(
+        tmp_path, no_gc_grace):
+    """A post-crash attempt whose step counter restarted writes low-step
+    manifests AFTER stale high-step ones; retention and the LATEST
+    fallback scan order by commit time, so the fresh commits survive and
+    win — never the pre-crash leftovers."""
+    root = str(tmp_path)
+    pre = CheckpointEngine(root)   # pre-crash attempt: steps 5 and 6
+    pre.save({"w": np.full(4, 5.0)}, step=5, wait=True)
+    pre.save({"w": np.full(4, 6.0)}, step=6, wait=True)
+    pre.close()
+
+    post = CheckpointEngine(root, num_to_keep=2)   # restarted counter
+    post.save({"w": np.full(4, 1.0)}, step=1, wait=True)
+    post.save({"w": np.full(4, 2.0)}, step=2, wait=True)
+    post.close()
+
+    names = list_manifest_names(root)
+    assert len(names) == 2
+    assert sorted(read_manifest(root, n).step for n in names) == [1, 2]
+    np.testing.assert_array_equal(load(root)["w"], np.full(4, 2.0))
+    # even with LATEST gone, the fallback scan resolves the newest COMMIT
+    os.unlink(os.path.join(root, "LATEST"))
+    name = resolve_latest(root)
+    assert read_manifest(root, name).step == 2
+
+
+def test_session_resumes_step_numbering_from_base_step(tmp_path):
+    """The trainer carries base_step across elastic restarts; a restarted
+    session numbers its saves after the last committed manifest instead
+    of restarting at 1."""
+    root = str(tmp_path)
+    s = session._init_session(
+        world_rank=0, world_size=1,
+        checkpoint_spec={"root": root, "num_to_keep": None, "frequency": 1,
+                         "run_token": "t2", "base_step": 7})
+    try:
+        session.report({"m": 1},
+                       checkpoint=Checkpoint.from_dict({"epoch": 7}))
+        assert s._ckpt_seq == 8
+        s._close_engine(had_error=False)
+    finally:
+        session._shutdown_session()
+    name = resolve_latest(root)
+    assert read_manifest(root, name).step == 8
 
 
 # -- trainer integration: elastic restart under chaos -------------------------
@@ -281,6 +426,10 @@ def test_trainer_elastic_restart_from_committed_manifest(ray_start_regular,
     assert final["epoch"] == 5
     np.testing.assert_array_equal(final["w"], np.full(4, 5.0))
     assert len(list_manifest_names(root)) <= 3
+    # step numbering continued across the restart (3 pre-crash commits +
+    # 3 post-restart) — a reset counter would let retention reap the
+    # fresh commits behind the stale pre-crash manifests
+    assert read_manifest(root, resolve_latest(root)).step == 6
 
 
 # -- executor: partial final-checkpoint collection ----------------------------

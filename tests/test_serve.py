@@ -48,6 +48,23 @@ def test_function_deployment(serve_instance):
     assert h.remote(21).result(timeout=30) == 42
 
 
+def test_function_deployment_rejects_checkpoint():
+    """checkpoint= injects the restored tree as an __init__ kwarg, which a
+    function deployment has nowhere to receive — declaring one must fail
+    loudly instead of silently serving without the weights."""
+    from ray_tpu.serve._private.replica import Replica
+
+    with pytest.raises(ValueError, match="class"):
+        @serve.deployment(checkpoint={"root": "/tmp/ckpt"})
+        def with_ckpt(x):
+            return x
+
+    # the replica guards too (config-dict deploy paths bypass the decorator)
+    with pytest.raises(ValueError, match="checkpoint"):
+        Replica("d", "d#1", double.func_or_class, (), {},
+                checkpoint={"root": "/tmp/ckpt"})
+
+
 def test_num_replicas_and_status(serve_instance):
     h = serve.run(Echo.options(name="echo3", num_replicas=3).bind(),
                   route_prefix="/e3")
