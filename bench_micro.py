@@ -18,6 +18,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -264,8 +265,9 @@ def main():
         try:
             import jax
             jax.config.update("jax_platforms", plat)
-        except Exception:
-            pass
+        except Exception as e:
+            print(f"bench_micro: could not pin jax platform to {plat!r}: {e}",
+                  file=sys.stderr)
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", choices=["inproc", "cluster", "both"],
                     default="both")

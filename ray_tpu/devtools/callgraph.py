@@ -1,0 +1,573 @@
+"""Whole-program symbol table, call graph, and interprocedural summaries.
+
+The per-file rules in :mod:`ray_tpu.devtools.linter` see one parse tree at
+a time; the invariants that actually deadlock TPU clusters live *between*
+functions: a blocking primitive three calls below an ``async def``, a lock
+taken in one method and another lock taken in a callee two files away, a
+collective dominated by a rank branch whose body lives in a helper.  This
+module builds the shared substrate those interprocedural rules (R10-R13)
+run on:
+
+1. **Symbol table** — every module under the lint roots, its top-level
+   functions, classes (with base-class links), and import aliases
+   (``import a.b as c`` and ``from m import f as g``, including relative
+   imports resolved against the package).
+2. **Call graph** — one :class:`CallSite` per call expression, resolved
+   module-level: plain names through import chains and re-exports,
+   ``self.method`` through the class and its project-resolvable bases
+   (MRO walk), ``cls.method``, ``super().method``, ``self.attr.method``
+   through light attribute-type inference (``self.attr = ClassName(...)``
+   anywhere in the class), and local-variable types
+   (``v = ClassName(...); v.method()``).  Thread/async entry points are
+   classified as their own edge kinds so dataflow can distinguish "runs
+   here, now" from "runs on another thread" from "runs on the event loop
+   later".
+3. **Per-function summaries** — direct blocking primitives, lock
+   acquisitions (``with``-statements over lockish expressions, with the
+   lexically-held set at each acquisition *and* at each outgoing call),
+   and collective/barrier calls.  Rules compose these into transitive
+   closures (see the fixpoint helpers at the bottom).
+
+Soundness stance: the resolver is deliberately *under*-approximate.  A
+call it cannot resolve degrades to ``target=None`` ("unknown") and simply
+contributes no edges — rules built on the graph can therefore miss
+findings through dynamic dispatch, but never invent a path that does not
+exist.  That is the right polarity for a lint gate that fails CI.
+
+Edge kinds:
+
+===========  ==========================================================
+kind         meaning
+===========  ==========================================================
+``call``     ordinary synchronous call — callee runs on this thread,
+             now, with the caller's locks held (also ``await f()``)
+``loop``     ``asyncio.create_task``/``ensure_future`` — the coroutine
+             runs on *this* event loop, later: event-loop blocking
+             propagates, lock-held sets do not
+``spawn``    ``threading.Thread(target=...)``, ``executor.submit``,
+             ``loop.run_in_executor``, ``call_soon_threadsafe`` — runs
+             on another thread: neither blocking nor held locks
+             propagate across it
+===========  ==========================================================
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+__all__ = ["ProjectIndex", "FunctionInfo", "ClassInfo", "ModuleInfo",
+           "CallSite", "module_name_for"]
+
+_LOCKISH = re.compile(r"(^|[._])(lock|mutex|cv|cond|sem)", re.IGNORECASE)
+
+#: Final attributes / names treated as collective or barrier operations
+#: (R12).  Matched against the last segment of the called dotted name.
+COLLECTIVE_NAMES = frozenset({
+    "allreduce", "all_reduce", "allgather", "all_gather", "reducescatter",
+    "reduce_scatter", "broadcast", "barrier", "all_to_all", "psum",
+    "pmean", "pmax", "pmin", "ppermute",
+})
+
+#: Fully-resolved callables that act as cross-rank rendezvous even though
+#: their names don't look like collectives: every rank must reach them the
+#: same number of times (rank 0 gathers the other ranks' shard indexes in
+#: the checkpoint commit barrier; ``session.report`` feeds it).
+BARRIER_QNAMES = frozenset({
+    "ray_tpu.checkpoint.engine:CheckpointEngine.save",
+    "ray_tpu.train.session:report",
+    "ray_tpu.train.session:_TrainSession.report",
+})
+
+
+def module_name_for(relpath: str) -> str:
+    """Dotted module name for a lint-root-relative path.
+
+    ``ray_tpu/_private/rpc.py`` -> ``ray_tpu._private.rpc``;
+    ``ray_tpu/__init__.py`` -> ``ray_tpu``; ``bench.py`` -> ``bench``.
+    """
+    rel = relpath.replace(os.sep, "/").replace("\\", "/")
+    if rel.endswith(".py"):
+        rel = rel[:-3]
+    if rel.endswith("/__init__"):
+        rel = rel[: -len("/__init__")]
+    return rel.replace("/", ".")
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class CallSite:
+    line: int
+    raw: str                      # dotted text as written ("self.flush")
+    target: Optional[str]         # resolved function qname, or None
+    kind: str = "call"            # call | loop | spawn
+    locks_held: Tuple[str, ...] = ()
+
+
+@dataclass
+class FunctionInfo:
+    qname: str                    # "mod:func" or "mod:Class.method"
+    module: str
+    cls: Optional[str]
+    name: str
+    node: ast.AST
+    ctx: object                   # linter.FileContext
+    is_async: bool = False
+    call_sites: List[CallSite] = field(default_factory=list)
+    # call AST node id -> CallSite, for rules that re-walk statements
+    site_by_node: Dict[int, CallSite] = field(default_factory=dict)
+    # (line, description) of directly-invoked blocking primitives
+    blocking: List[Tuple[int, str]] = field(default_factory=list)
+    # (lock_id, line, locks already held lexically)
+    acquires: List[Tuple[str, int, Tuple[str, ...]]] = field(
+        default_factory=list)
+    # (line, collective name) invoked directly
+    collectives: List[Tuple[int, str]] = field(default_factory=list)
+
+
+@dataclass
+class ClassInfo:
+    qname: str                    # "mod:Class"
+    module: str
+    name: str
+    bases: List[str] = field(default_factory=list)       # dotted, as written
+    methods: Dict[str, FunctionInfo] = field(default_factory=dict)
+    attr_types: Dict[str, str] = field(default_factory=dict)  # attr -> cls qname
+
+
+@dataclass
+class ModuleInfo:
+    name: str
+    ctx: object
+    is_package: bool = False
+    imports: Dict[str, str] = field(default_factory=dict)    # local -> dotted
+    functions: Dict[str, FunctionInfo] = field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+
+
+class ProjectIndex:
+    """Symbol table + resolved call graph over a set of FileContexts."""
+
+    def __init__(self, ctxs: Iterable[object]):
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.ctx_of: Dict[str, object] = {}      # relpath -> FileContext
+        for ctx in ctxs:
+            self._add_module(ctx)
+        for mod in self.modules.values():
+            self._collect_imports(mod)
+        for cls in self.classes.values():
+            self._infer_attr_types(cls)
+        for fn in self.functions.values():
+            self._analyze(fn)
+
+    # -- construction ------------------------------------------------------
+
+    def _add_module(self, ctx) -> None:
+        mod = ModuleInfo(module_name_for(ctx.relpath), ctx,
+                         is_package=ctx.relpath.replace("\\", "/")
+                         .endswith("__init__.py"))
+        self.modules[mod.name] = mod
+        self.ctx_of[ctx.relpath] = ctx
+
+        def add_fn(node, cls: Optional[ClassInfo]):
+            owner = f"{cls.name}." if cls else ""
+            info = FunctionInfo(
+                qname=f"{mod.name}:{owner}{node.name}", module=mod.name,
+                cls=cls.name if cls else None, name=node.name, node=node,
+                ctx=ctx, is_async=isinstance(node, ast.AsyncFunctionDef))
+            # first definition wins (overloads/redefinitions are rare and
+            # resolving to the first keeps the graph deterministic)
+            self.functions.setdefault(info.qname, info)
+            if cls is not None:
+                cls.methods.setdefault(node.name, info)
+            else:
+                mod.functions.setdefault(node.name, info)
+            return info
+
+        def walk(node, cls: Optional[ClassInfo]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    cinfo = ClassInfo(qname=f"{mod.name}:{child.name}",
+                                      module=mod.name, name=child.name,
+                                      bases=[b for b in
+                                             (_dotted(x) for x in child.bases)
+                                             if b])
+                    self.classes.setdefault(cinfo.qname, cinfo)
+                    mod.classes.setdefault(child.name, cinfo)
+                    walk(child, cinfo)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    add_fn(child, cls)
+                    # nested defs are indexed under the same class scope so
+                    # self.x inside them still resolves; their call sites
+                    # stay separate from the parent's (pruned walk)
+                    walk(child, cls)
+                else:
+                    walk(child, cls)
+
+        walk(ctx.tree, None)
+
+    def _collect_imports(self, mod: ModuleInfo) -> None:
+        package = mod.name if mod.is_package else (
+            mod.name.rsplit(".", 1)[0] if "." in mod.name else "")
+        for node in ast.walk(mod.ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        mod.imports[alias.asname] = alias.name
+                    else:
+                        # "import a.b.c" binds "a"
+                        mod.imports[alias.name.split(".")[0]] = \
+                            alias.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    anchor = package
+                    for _ in range(node.level - 1):
+                        anchor = anchor.rsplit(".", 1)[0] if "." in anchor \
+                            else ""
+                    base = f"{anchor}.{base}".strip(".") if base else anchor
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    mod.imports[alias.asname or alias.name] = \
+                        f"{base}.{alias.name}" if base else alias.name
+
+    # -- resolution --------------------------------------------------------
+
+    def _resolve_qualified(self, dotted: str,
+                           depth: int = 0) -> Optional[str]:
+        """Resolve an absolute dotted path to a symbol key.
+
+        Returns a function qname (``mod:f`` / ``mod:C.m``), a class qname
+        (``mod:C``), a module name, or None.  Follows re-exports through
+        ``__init__`` modules with a depth guard.
+        """
+        if depth > 8:
+            return None
+        parts = dotted.split(".")
+        for cut in range(len(parts), 0, -1):
+            mod = self.modules.get(".".join(parts[:cut]))
+            if mod is None:
+                continue
+            rest = parts[cut:]
+            if not rest:
+                return mod.name
+            head, tail = rest[0], rest[1:]
+            if head in mod.functions and not tail:
+                return mod.functions[head].qname
+            if head in mod.classes:
+                cls = mod.classes[head]
+                if not tail:
+                    return cls.qname
+                if len(tail) == 1:
+                    m = self.lookup_method(cls, tail[0])
+                    return m.qname if m else None
+                return None
+            if head in mod.imports:
+                return self._resolve_qualified(
+                    ".".join([mod.imports[head]] + tail), depth + 1)
+            return None
+        return None
+
+    def resolve_name(self, mod: ModuleInfo, dotted: str) -> Optional[str]:
+        """Resolve a dotted expression written in *mod*'s scope."""
+        parts = dotted.split(".")
+        head, tail = parts[0], parts[1:]
+        if head in mod.functions and not tail:
+            return mod.functions[head].qname
+        if head in mod.classes:
+            cls = mod.classes[head]
+            if not tail:
+                return cls.qname
+            if len(tail) == 1:
+                m = self.lookup_method(cls, tail[0])
+                return m.qname if m else None
+            return None
+        if head in mod.imports:
+            return self._resolve_qualified(
+                ".".join([mod.imports[head]] + tail))
+        return None
+
+    def lookup_method(self, cls: ClassInfo, name: str,
+                      _seen: Tuple[str, ...] = ()) -> Optional[FunctionInfo]:
+        """Method lookup through the class and project-resolvable bases."""
+        if cls.qname in _seen:
+            return None
+        if name in cls.methods:
+            return cls.methods[name]
+        mod = self.modules.get(cls.module)
+        for base in cls.bases:
+            key = self.resolve_name(mod, base) if mod else None
+            binfo = self.classes.get(key) if key else None
+            if binfo is not None:
+                found = self.lookup_method(binfo, name,
+                                           _seen + (cls.qname,))
+                if found:
+                    return found
+        return None
+
+    def _class_of(self, fn: FunctionInfo) -> Optional[ClassInfo]:
+        return self.classes.get(f"{fn.module}:{fn.cls}") if fn.cls else None
+
+    def _infer_attr_types(self, cls: ClassInfo) -> None:
+        """``self.attr = ClassName(...)`` anywhere in the class types attr."""
+        mod = self.modules.get(cls.module)
+        for m in cls.methods.values():
+            for node in ast.walk(m.node):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                ctor = _dotted(node.value.func)
+                key = self.resolve_name(mod, ctor) if (ctor and mod) else None
+                if key not in self.classes:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        cls.attr_types.setdefault(t.attr, key)
+
+    # -- per-function analysis --------------------------------------------
+
+    def _lock_identity(self, expr: ast.AST,
+                       fn: FunctionInfo) -> Optional[str]:
+        text = _dotted(expr)
+        if not text or not _LOCKISH.search(text):
+            return None
+        if text.startswith("self."):
+            # class-qualified, like R2 and lockwatch's per-site identity
+            return f"{fn.cls or '?'}.{text[5:]}"
+        if "." not in text:
+            # bare module-global lock: qualify by module so same-named
+            # globals in different files never merge into one node
+            return f"{fn.module}.{text}"
+        # module-alias attribute (``with b.LOCK: ...`` after ``from proj
+        # import b``): rewrite to the defining module's node so it merges
+        # with that module's own bare-name acquisitions
+        parts = text.split(".")
+        mod = self.modules.get(fn.module)
+        if mod is not None and parts[0] in mod.imports:
+            target = mod.imports[parts[0]]
+            if target in self.modules:
+                return ".".join([target] + parts[1:])
+        return text
+
+    def _resolve_call(self, fn: FunctionInfo, dn: Optional[str],
+                      local_types: Dict[str, str]) -> Optional[str]:
+        if not dn:
+            return None
+        mod = self.modules.get(fn.module)
+        cls = self._class_of(fn)
+        parts = dn.split(".")
+        if parts[0] in ("self", "cls") and cls is not None:
+            if len(parts) == 2:
+                m = self.lookup_method(cls, parts[1])
+                return m.qname if m else None
+            if len(parts) == 3:
+                tkey = cls.attr_types.get(parts[1])
+                tcls = self.classes.get(tkey) if tkey else None
+                if tcls is not None:
+                    m = self.lookup_method(tcls, parts[2])
+                    return m.qname if m else None
+            return None
+        if parts[0] in local_types and len(parts) == 2:
+            tcls = self.classes.get(local_types[parts[0]])
+            if tcls is not None:
+                m = self.lookup_method(tcls, parts[1])
+                return m.qname if m else None
+            return None
+        key = self.resolve_name(mod, dn) if mod else None
+        if key in self.classes:
+            # constructing a class: the synchronous work is __init__
+            init = self.lookup_method(self.classes[key], "__init__")
+            return init.qname if init else None
+        if key in self.functions:
+            return key
+        return None
+
+    def _blocking_reason(self, node: ast.Call, fn: FunctionInfo,
+                         dn: Optional[str]) -> Optional[str]:
+        ctx = fn.ctx
+        if dn == "time.sleep" or (
+                dn == "sleep" and
+                getattr(ctx, "from_imports", {}).get("sleep") == "time"):
+            return "blocking time.sleep()"
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            kwargs = {kw.arg for kw in node.keywords}
+            if attr == "result" and not node.args and "timeout" not in kwargs:
+                return "blocking Future.result() without timeout"
+            if attr == "acquire" and _LOCKISH.search(
+                    _dotted(node.func.value) or ""):
+                if not node.args and not ({"timeout", "blocking"} & kwargs):
+                    return "lock .acquire() with no timeout"
+            if attr == "get" and _dotted(node.func.value) == "ray_tpu":
+                return "blocking ray_tpu.get()"
+        elif isinstance(node.func, ast.Name) and node.func.id == "get" and \
+                getattr(ctx, "from_imports", {}).get(
+                    "get", "").startswith("ray_tpu"):
+            return "blocking ray_tpu.get()"
+        return None
+
+    def _analyze(self, fn: FunctionInfo) -> None:
+        local_types: Dict[str, str] = {}
+        mod = self.modules.get(fn.module)
+        cls = self._class_of(fn)
+        held: List[str] = []
+
+        def add_site(node: ast.Call, target: Optional[str], kind: str,
+                     raw: str) -> None:
+            site = CallSite(line=node.lineno, raw=raw, target=target,
+                            kind=kind, locks_held=tuple(held))
+            fn.call_sites.append(site)
+            fn.site_by_node[id(node)] = site
+
+        def spawn_target(node: ast.Call) -> Optional[ast.AST]:
+            dn = _dotted(node.func)
+            if dn in ("threading.Thread", "Thread"):
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        return kw.value
+                return None
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr in ("submit", "call_soon_threadsafe"):
+                    return node.args[0] if node.args else None
+                if node.func.attr == "run_in_executor":
+                    return node.args[1] if len(node.args) > 1 else None
+            return None
+
+        def handle_call(node: ast.Call) -> None:
+            dn = _dotted(node.func)
+            reason = self._blocking_reason(node, fn, dn)
+            if reason is not None:
+                fn.blocking.append((node.lineno, reason))
+            last = (dn or "").rsplit(".", 1)[-1]
+            target: Optional[str]
+            if dn in ("asyncio.create_task", "asyncio.ensure_future",
+                      "create_task", "ensure_future") and node.args and \
+                    isinstance(node.args[0], ast.Call):
+                inner = _dotted(node.args[0].func)
+                target = self._resolve_call(fn, inner, local_types)
+                add_site(node, target, "loop", inner or "<dynamic>")
+                return
+            st = spawn_target(node)
+            if st is not None:
+                sdn = _dotted(st)
+                target = self._resolve_call(fn, sdn, local_types)
+                add_site(node, target, "spawn", sdn or "<dynamic>")
+                return
+            # super().method() -> first base that defines it
+            if isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Call) and \
+                    isinstance(node.func.value.func, ast.Name) and \
+                    node.func.value.func.id == "super" and cls is not None:
+                m = None
+                for base in cls.bases:
+                    key = self.resolve_name(mod, base) if mod else None
+                    binfo = self.classes.get(key) if key else None
+                    if binfo:
+                        m = self.lookup_method(binfo, node.func.attr)
+                        if m:
+                            break
+                add_site(node, m.qname if m else None, "call",
+                         f"super().{node.func.attr}")
+                return
+            target = self._resolve_call(fn, dn, local_types)
+            add_site(node, target, "call", dn or "<dynamic>")
+            if last in COLLECTIVE_NAMES or \
+                    (target is not None and target in BARRIER_QNAMES):
+                fn.collectives.append((node.lineno, last))
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return  # nested defs are their own FunctionInfo
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                pushed = 0
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Call):
+                        visit(item.context_expr)
+                    lid = self._lock_identity(item.context_expr, fn)
+                    if lid:
+                        fn.acquires.append((lid, node.lineno, tuple(held)))
+                        held.append(lid)
+                        pushed += 1
+                for stmt in node.body:
+                    visit(stmt)
+                for _ in range(pushed):
+                    held.pop()
+                return
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                ctor = _dotted(node.value.func)
+                key = self.resolve_name(mod, ctor) if (ctor and mod) else None
+                if key in self.classes:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            local_types[t.id] = key
+            if isinstance(node, ast.Call):
+                handle_call(node)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for stmt in fn.node.body:
+            visit(stmt)
+
+    # -- fixpoint helpers for the interprocedural rules --------------------
+
+    def _callees(self, fn: FunctionInfo,
+                 kinds: Tuple[str, ...]) -> List[CallSite]:
+        return [s for s in fn.call_sites
+                if s.kind in kinds and s.target in self.functions]
+
+    def transitive_paths(self, direct: Dict[str, List[Tuple[int, str]]],
+                         kinds: Tuple[str, ...] = ("call",)
+                         ) -> Dict[str, Dict[str, List[Tuple[str, int]]]]:
+        """Fixpoint closure of a per-function fact set over the call graph.
+
+        ``direct[qname]`` is a list of ``(line, key)`` facts established in
+        that function.  Returns, per function, ``key -> witness path``
+        where a path is ``[(qname, line), ...]`` ending at the function
+        that establishes the fact directly.  The first-discovered witness
+        is kept (deterministic: call sites are visited in source order).
+        """
+        out: Dict[str, Dict[str, List[Tuple[str, int]]]] = {}
+        for q, facts in direct.items():
+            d = out.setdefault(q, {})
+            for line, key in facts:
+                d.setdefault(key, [(q, line)])
+        # reverse edges for the worklist
+        callers: Dict[str, List[Tuple[str, CallSite]]] = {}
+        for q, fn in self.functions.items():
+            for site in self._callees(fn, kinds):
+                callers.setdefault(site.target, []).append((q, site))
+        work = list(out)
+        while work:
+            callee = work.pop()
+            facts = out.get(callee, {})
+            for caller, site in callers.get(callee, ()):
+                d = out.setdefault(caller, {})
+                changed = False
+                for key, path in facts.items():
+                    if key not in d:
+                        d[key] = [(caller, site.line)] + path
+                        changed = True
+                if changed:
+                    work.append(caller)
+        return out

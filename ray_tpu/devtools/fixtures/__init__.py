@@ -1,0 +1,8 @@
+"""Self-check fixture corpus for raylint (``--self-check``).
+
+Each ``r1N_*.py`` file carries one positive and one negative case for a
+whole-program rule (R10-R13); ``expected.json`` freezes the exact
+findings the corpus must round-trip. The directory is excluded from
+normal lint walks (see ``LintEngine._iter_files``) and is only analyzed
+when rooted here explicitly — these files are never imported at runtime.
+"""

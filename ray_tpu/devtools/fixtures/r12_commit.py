@@ -1,0 +1,24 @@
+"""R12 fixture: a checkpoint-commit barrier dominated by a rank branch —
+the regression shape for the rank-divergent commit deadlock."""
+
+
+def barrier():
+    """Stand-in collective; R12 keys on the callee NAME."""
+
+
+def divergent_commit(rank, state):
+    if rank == 0:
+        _commit(state)
+        barrier()
+
+
+def uniform_commit(rank, state):
+    # negative: the branch is rank-dependent but every rank still reaches
+    # the same collective sequence afterwards
+    if rank == 0:
+        _commit(state)
+    barrier()
+
+
+def _commit(state):
+    state["committed"] = True

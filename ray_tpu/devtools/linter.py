@@ -57,7 +57,30 @@ R9   direct-checkpoint-io
                     crash-atomic manifest commit; go through
                     ``ray_tpu.checkpoint`` (the engine itself and
                     ``air/`` are out of scope)
+R10  async-transitive
+                    a blocking primitive (R1's set) reachable from an
+                    ``async def`` through the whole-program call graph —
+                    the interprocedural closure of R1
+R11  lock-order-graph
+                    lock acquisitions collected across function
+                    boundaries into one global order graph; cycles are
+                    reported with the full call path and in lockwatch's
+                    runtime cycle format
+R12  collective-divergence
+                    a collective/barrier/checkpoint-commit call (direct
+                    or transitive) dominated by a branch on rank-,
+                    world-size-, or local-exception-dependent state —
+                    the classic SPMD deadlock
+R13  config-drift   every config knob must be read somewhere and every
+                    ``_config.<name>`` read must be defined; same
+                    closure for chaos points declared in the runtime
+                    vs. exercised by ``tests/``
 ==== ============== ====================================================
+
+R10-R12 run on the whole-program call graph built by
+:mod:`ray_tpu.devtools.callgraph`; unresolvable dynamic calls degrade to
+"unknown" (no edges), so the interprocedural rules can under-report but
+never invent a path.
 """
 
 from __future__ import annotations
@@ -71,8 +94,10 @@ import tokenize
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterable, Iterator, List, Optional, Set, Tuple
 
+from ray_tpu.devtools import callgraph as _cg
+
 __all__ = ["Finding", "LintEngine", "rule", "project_rule", "RULES",
-           "PROJECT_RULES"]
+           "PROJECT_RULES", "rule_listing"]
 
 _ALLOW_RE = re.compile(r"#\s*raylint:\s*allow\(([A-Za-z0-9_,\- ]+)\)")
 
@@ -104,10 +129,23 @@ class FileContext:
         self.allow = self._collect_allows(source)
         # name -> module it was imported from ("from ray_tpu import get")
         self.from_imports: Dict[str, str] = {}
+        # name -> fully-qualified origin ("from ray_tpu import chaos as ch"
+        # binds ch -> "ray_tpu.chaos"; "import ray_tpu.chaos as ch" likewise)
+        self.import_origin: Dict[str, str] = {}
         for node in ast.walk(self.tree):
             if isinstance(node, ast.ImportFrom) and node.module:
                 for alias in node.names:
-                    self.from_imports[alias.asname or alias.name] = node.module
+                    bound = alias.asname or alias.name
+                    self.from_imports[bound] = node.module
+                    self.import_origin[bound] = \
+                        node.module + "." + alias.name
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        self.import_origin[alias.asname] = alias.name
+                    else:
+                        top = alias.name.split(".")[0]
+                        self.import_origin[top] = top
 
     @staticmethod
     def _collect_allows(source: str) -> Dict[int, Set[str]]:
@@ -893,19 +931,498 @@ def check_proto_drift(ctxs: List[FileContext], engine) -> Iterator[Finding]:
 
 
 # --------------------------------------------------------------------------
+# R10: blocking primitives reachable from async defs (whole-program)
+
+@project_rule("R10", "async-transitive")
+def check_async_transitive(ctxs: List[FileContext],
+                           engine) -> Iterator[Finding]:
+    """R1 catches ``time.sleep`` written *inside* an ``async def``; this is
+    its interprocedural closure: a blocking primitive (R1's set) anywhere
+    in the synchronous call graph below an async function still stalls the
+    event loop.  Propagation follows ``call`` edges and ``loop`` edges
+    (``asyncio.create_task`` coroutines run on the same loop) but never
+    ``spawn`` edges (thread targets / executor submissions run off-loop).
+    Blocking sites written directly in an ``async def`` body are R1's job
+    and are not re-reported here."""
+    idx = engine.index(ctxs)
+    direct: Dict[str, List[Tuple[int, Tuple[str, int, str]]]] = {}
+    for q, fn in idx.functions.items():
+        for line, desc in fn.blocking:
+            direct.setdefault(q, []).append((line, (q, line, desc)))
+    closure = idx.transitive_paths(direct, kinds=("call", "loop"))
+    seen: Set[Tuple[str, int]] = set()
+    for q in sorted(idx.functions):
+        root = idx.functions[q]
+        if not root.is_async:
+            continue
+        for key, path in sorted(closure.get(q, {}).items()):
+            site_q, site_line, desc = key
+            site_fn = idx.functions[site_q]
+            if site_fn.is_async:
+                continue  # inline in an async body: R1 reports it
+            if (site_q, site_line) in seen:
+                continue
+            seen.add((site_q, site_line))
+            if site_fn.ctx.allowed(site_line, "R10", "async-transitive"):
+                continue
+            chain = " -> ".join(
+                f"{idx.functions[s].cls + '.' if idx.functions[s].cls else ''}"
+                f"{idx.functions[s].name}" for s, _ in path)
+            yield Finding(
+                "R10", "async-transitive", site_fn.ctx.relpath, site_line,
+                f"{desc} inside '{site_fn.name}' is reachable from "
+                f"'async def {root.name}' ({root.ctx.relpath}) via "
+                f"{chain} — it blocks the event loop; resolve off-loop or "
+                f"justify with '# raylint: allow(async-transitive) <why>'")
+
+
+# --------------------------------------------------------------------------
+# R11: global static lock-order graph (whole-program closure of R2)
+
+@project_rule("R11", "lock-order-graph")
+def check_lock_order_graph(ctxs: List[FileContext],
+                           engine) -> Iterator[Finding]:
+    """R2 sees lock nestings written in one function; this collects lock
+    acquisitions *across* function boundaries into one global order graph:
+    holding A while calling ``f()`` orders A before every lock ``f`` may
+    acquire transitively.  Cycles are potential deadlocks; each is
+    reported once, anchored at an interprocedural edge's call site, with
+    the full call path and in lockwatch's runtime cycle format (same
+    ``sites`` identity), so a static finding and a lockwatch runtime
+    report of the same inversion correlate.  Cycles whose every edge is a
+    single-function nesting in ONE file are R2's findings and are not
+    re-reported; cross-file direct nestings stay here, because R2's
+    syntactic lock identity cannot merge ``LOCK`` with ``othermod.LOCK``."""
+    from ray_tpu.devtools import lockwatch
+    idx = engine.index(ctxs)
+    direct: Dict[str, List[Tuple[int, str]]] = {}
+    for q, fn in idx.functions.items():
+        for lid, line, _held in fn.acquires:
+            direct.setdefault(q, []).append((line, lid))
+    closure = idx.transitive_paths(direct, kinds=("call",))
+    # edge (a, b): a held while b acquired; witness = (fn, line, path, inter)
+    edges: Dict[Tuple[str, str], Tuple[object, int, List[Tuple[str, int]],
+                                       bool]] = {}
+    for q in sorted(idx.functions):
+        fn = idx.functions[q]
+        for lid, line, held in fn.acquires:
+            for h in held:
+                if h != lid:
+                    edges.setdefault((h, lid), (fn, line, [(q, line)], False))
+        for site in fn.call_sites:
+            if site.kind != "call" or not site.locks_held or \
+                    site.target not in idx.functions:
+                continue
+            for lid, path in closure.get(site.target, {}).items():
+                for h in site.locks_held:
+                    if h != lid:
+                        edges.setdefault(
+                            (h, lid),
+                            (fn, site.line, [(q, site.line)] + path, True))
+    succ: Dict[str, List[str]] = {}
+    for a, b in edges:
+        succ.setdefault(a, []).append(b)
+    for comp in lockwatch._sccs(sorted(succ), succ):
+        if len(comp) < 2:
+            continue
+        in_comp = set(comp)
+        comp_edges = [(k, v) for k, v in sorted(edges.items())
+                      if k[0] in in_comp and k[1] in in_comp]
+        inter = [(k, v) for k, v in comp_edges if v[3]]
+        files = {v[0].ctx.relpath for _, v in comp_edges}
+        if not inter and len(files) < 2:
+            continue  # single-file intra-function nesting: R2's domain
+        anchor = None
+        for key, (fn, line, path, _i) in (inter or comp_edges):
+            if not fn.ctx.allowed(line, "R11", "lock-order-graph"):
+                anchor = (key, fn, line, path)
+                break
+        if anchor is None:
+            continue  # every interprocedural edge carries a justification
+        (a, b), fn, line, path = anchor
+        steps = " -> ".join(
+            f"{idx.functions[s].name}@{idx.functions[s].ctx.relpath}:{ln}"
+            for s, ln in path)
+        others = "; ".join(
+            f"{x} -> {y} at {v[0].ctx.relpath}:{v[1]}"
+            for (x, y), v in comp_edges if (x, y) != (a, b))
+        yield Finding(
+            "R11", "lock-order-graph", fn.ctx.relpath, line,
+            f"static {lockwatch.format_cycle('site-order', sorted(comp))}; "
+            f"edge {a} -> {b} via {steps}"
+            + (f"; conflicting edges: {others}" if others else "")
+            + " (potential deadlock — same cycle identity as a lockwatch "
+              "runtime report over these sites)")
+
+
+# --------------------------------------------------------------------------
+# R12: SPMD collective divergence (rank-dependent control flow)
+
+_RANKISH = re.compile(
+    r"(^|[._])(rank|world_rank|local_rank|node_rank|global_rank|world_size|"
+    r"process_index|process_count|num_hosts|host_id|is_head|is_master|"
+    r"is_chief|is_coordinator)($|[._(])", re.IGNORECASE)
+
+_EXIT_CALLS = {"sys.exit", "os._exit", "exit", "quit", "os.abort"}
+
+
+def _rank_dependent(test: ast.AST) -> Optional[str]:
+    """The rank-ish name that makes *test* SPMD-divergent, or None."""
+    for node in ast.walk(test):
+        dn = _dotted(node) if isinstance(node, (ast.Name, ast.Attribute)) \
+            else None
+        if dn and _RANKISH.search(dn):
+            return dn
+        if isinstance(node, ast.Call):
+            dn = _dotted(node.func)
+            if dn and _RANKISH.search(dn):
+                return dn + "()"
+    return None
+
+
+def _arm_exits(stmts: List[ast.stmt]) -> bool:
+    """True if the statement list can leave the function (return/raise/
+    sys.exit) — execution past the enclosing If then differs by rank."""
+    for stmt in stmts:
+        for node in _walk_pruned(stmt):
+            if isinstance(node, (ast.Return, ast.Raise)):
+                return True
+            if isinstance(node, ast.Call) and \
+                    _dotted(node.func) in _EXIT_CALLS:
+                return True
+    return False
+
+
+@project_rule("R12", "collective-divergence")
+def check_collective_divergence(ctxs: List[FileContext],
+                                engine) -> Iterator[Finding]:
+    """Every rank must execute the same collective sequence (podracer /
+    pjit-at-scale SPMD contract): a collective, barrier, or
+    checkpoint-commit call — direct or through the call graph — that only
+    *some* ranks reach deadlocks the others.  Flagged shapes: a collective
+    under a branch on rank/world-size state that the other arm does not
+    match; a collective after a rank-dependent early exit; a collective
+    inside a rank-dependent loop; and a collective inside an ``except``
+    handler (locally-divergent exception state — one rank's fault must
+    not desync the collective schedule).  Uniform-by-construction
+    branches are justified with
+    ``# raylint: allow(collective-divergence) <why>``."""
+    idx = engine.index(ctxs)
+    direct: Dict[str, List[Tuple[int, str]]] = {}
+    for q, fn in idx.functions.items():
+        for line, name in fn.collectives:
+            direct.setdefault(q, []).append((line, name))
+    closure = idx.transitive_paths(direct, kinds=("call",))
+
+    def site_for(fn, node):
+        return fn.site_by_node.get(id(node))
+
+    def collectives_in(fn, stmts) -> Dict[str, Tuple[int, str]]:
+        """name -> (line, via) for collectives in *stmts*, direct or
+        through resolved calls (one witness each)."""
+        out: Dict[str, Tuple[int, str]] = {}
+        for stmt in stmts:
+            for node in _walk_pruned(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                dn = _dotted(node.func) or ""
+                last = dn.rsplit(".", 1)[-1]
+                site = site_for(fn, node)
+                if last in _cg.COLLECTIVE_NAMES or (
+                        site and site.target in _cg.BARRIER_QNAMES):
+                    out.setdefault(last, (node.lineno, dn))
+                elif site and site.target in closure:
+                    for name, path in closure[site.target].items():
+                        chain = " -> ".join(
+                            idx.functions[s].name for s, _ in path)
+                        out.setdefault(name,
+                                       (node.lineno, f"{dn} -> {chain}"))
+        return out
+
+    findings: Dict[Tuple[str, int, str], Finding] = {}
+
+    def flag(fn, line, name, via, why):
+        if fn.ctx.allowed(line, "R12", "collective-divergence"):
+            return
+        key = (fn.ctx.relpath, line, name)
+        if key in findings:
+            return
+        findings[key] = Finding(
+            "R12", "collective-divergence", fn.ctx.relpath, line,
+            f"collective '{name}'"
+            + (f" (via {via})" if via and via != name else "")
+            + f" {why} — ranks that skip it deadlock the ones that don't; "
+            f"make the schedule rank-uniform or justify with "
+            f"'# raylint: allow(collective-divergence) <why>'")
+
+    def walk_stmts(fn, stmts, div: Optional[str]):
+        for stmt in stmts:
+            if div is not None:
+                for name, (line, via) in sorted(
+                        collectives_in(fn, [stmt]).items()):
+                    flag(fn, line, name, via, div)
+            if isinstance(stmt, ast.If):
+                dep = _rank_dependent(stmt.test)
+                if dep and div is None:
+                    body_cols = collectives_in(fn, stmt.body)
+                    else_cols = collectives_in(fn, stmt.orelse)
+                    for name, (line, via) in sorted(body_cols.items()):
+                        if name not in else_cols:
+                            flag(fn, line, name, via,
+                                 f"is dominated by a branch on '{dep}' "
+                                 f"(line {stmt.lineno}) with no matching "
+                                 f"call on the other path")
+                    for name, (line, via) in sorted(else_cols.items()):
+                        if name not in body_cols:
+                            flag(fn, line, name, via,
+                                 f"is dominated by a branch on '{dep}' "
+                                 f"(line {stmt.lineno}) with no matching "
+                                 f"call on the other path")
+                    # arms still get walked (except handlers, nested
+                    # rank branches); duplicate sites dedup by key
+                    walk_stmts(fn, stmt.body, div)
+                    walk_stmts(fn, stmt.orelse, div)
+                    body_exit = _arm_exits(stmt.body)
+                    else_exit = _arm_exits(stmt.orelse) if stmt.orelse \
+                        else False
+                    if body_exit != else_exit:
+                        div = (f"follows a rank-dependent early exit "
+                               f"(branch on '{dep}' at line {stmt.lineno})")
+                else:
+                    walk_stmts(fn, stmt.body, div)
+                    walk_stmts(fn, stmt.orelse, div)
+            elif isinstance(stmt, (ast.While, ast.For)):
+                cond = stmt.test if isinstance(stmt, ast.While) else stmt.iter
+                dep = _rank_dependent(cond)
+                loop_div = div
+                if dep and div is None:
+                    loop_div = (f"sits in a loop whose trip count depends "
+                                f"on '{dep}' (line {stmt.lineno})")
+                    for name, (line, via) in sorted(
+                            collectives_in(fn, stmt.body).items()):
+                        flag(fn, line, name, via, loop_div)
+                else:
+                    walk_stmts(fn, stmt.body, loop_div)
+                walk_stmts(fn, stmt.orelse, div)
+            elif isinstance(stmt, ast.Try):
+                walk_stmts(fn, stmt.body, div)
+                for handler in stmt.handlers:
+                    hdiv = div or ("sits in an 'except' handler — entered "
+                                   "only on the rank that hit the fault")
+                    for name, (line, via) in sorted(
+                            collectives_in(fn, handler.body).items()):
+                        flag(fn, line, name, via, hdiv)
+                walk_stmts(fn, stmt.orelse, div)
+                walk_stmts(fn, stmt.finalbody, div)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                walk_stmts(fn, stmt.body, div)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                continue  # separate FunctionInfo / scope
+        return
+
+    for q in sorted(idx.functions):
+        fn = idx.functions[q]
+        walk_stmts(fn, list(fn.node.body), None)
+    for key in sorted(findings):
+        yield findings[key]
+
+
+# --------------------------------------------------------------------------
+# R13: config-knob and chaos-point drift (declared vs. used closure)
+
+_CONFIG_METHODS = {"get", "set", "define", "apply_system_config", "to_dict",
+                   "keys", "items", "values", "setdefault", "snapshot",
+                   "reset"}
+_CHAOS_SPEC_RE = re.compile(
+    r"^(?:\d+\s*:)?\s*([a-z_][a-z0-9_]*(?:\.[a-z0-9_]+)+)\s*"
+    r"(?:\[[^\]]*\])*\s*(?:@[\w%+.]+)?\s*=\s*(?:delay|drop|reset|error|exit)")
+
+
+def _is_test_path(relpath: str) -> bool:
+    norm = relpath.replace("\\", "/")
+    return norm.startswith("tests/") or \
+        os.path.basename(norm).startswith("test_")
+
+
+def _config_receiver(name: str, ctx: FileContext) -> bool:
+    """Is local name *name* bound to the global ``_config`` registry?
+
+    True only when the file imported it from ``ray_tpu._private.config``
+    (any alias) or *is* that module — bare ``cfg`` locals elsewhere are
+    plain dicts/dataclasses, not the knob registry."""
+    origin = ctx.import_origin.get(name, "")
+    if origin == "ray_tpu._private.config._config" or \
+            origin == "ray_tpu._private.config":
+        return True
+    return name == "_config" and \
+        ctx.relpath.replace("\\", "/").endswith("_private/config.py")
+
+
+def _chaos_inject_point(node: ast.Call, ctx: FileContext) -> Optional[str]:
+    """Constant point name if *node* is a ``chaos.inject("...")`` call."""
+    dn = _dotted(node.func)
+    is_inject = False
+    if dn is not None and dn.split(".")[-1] == "inject":
+        head = dn.split(".")[0]
+        origin = ctx.import_origin.get(head, "")
+        is_inject = ("chaos" in dn or "chaos" in origin or
+                     ctx.from_imports.get("inject", "").startswith(
+                         "ray_tpu.chaos"))
+    if is_inject and node.args and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        return node.args[0].value
+    return None
+
+
+@project_rule("R13", "config-drift")
+def check_config_drift(ctxs: List[FileContext], _engine) -> Iterator[Finding]:
+    """Two declared-vs-used closures that otherwise drift silently.
+
+    **Config knobs**: every ``_config.define("name", ...)`` must be read
+    somewhere (``_config.get("name")`` or ``_config.name``) — a dead knob
+    is a promise the runtime no longer keeps — and every read/set must
+    name a defined knob (an undefined name fails at runtime, but only on
+    the path that reads it).  **Chaos points**: every
+    ``chaos.inject("point")`` site in the runtime must be exercised by at
+    least one test (a spec string or direct inject in ``tests/``), else
+    the fault path is dead weight chaos never validates; and every
+    dotted point a test spec references must exist in the runtime (or be
+    injected by the test itself), else the test silently runs fault-free."""
+    defines: Dict[str, Tuple[FileContext, int]] = {}
+    reads: Set[str] = set()
+    uses: List[Tuple[str, FileContext, int]] = []   # get/set/attr sites
+    dynamic_access = False
+    declared_points: Dict[str, Tuple[FileContext, int]] = {}
+    test_points: Set[str] = set()
+    test_injects: Set[str] = set()
+    spec_refs: List[Tuple[str, FileContext, int]] = []
+    have_tests = any(_is_test_path(c.relpath) for c in ctxs)
+
+    for ctx in ctxs:
+        is_test = _is_test_path(ctx.relpath)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                point = _chaos_inject_point(node, ctx)
+                if point is not None:
+                    if is_test:
+                        test_injects.add(point)
+                        test_points.add(point)
+                    else:
+                        declared_points.setdefault(point,
+                                                   (ctx, node.lineno))
+                if isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name) and \
+                        _config_receiver(node.func.value.id, ctx):
+                    attr = node.func.attr
+                    if attr in ("get", "set", "define") and node.args:
+                        arg = node.args[0]
+                        if isinstance(arg, ast.Constant) and \
+                                isinstance(arg.value, str):
+                            if attr == "define":
+                                defines.setdefault(arg.value,
+                                                   (ctx, node.lineno))
+                            else:
+                                if attr == "get":
+                                    reads.add(arg.value)
+                                uses.append((arg.value, ctx, node.lineno))
+                        else:
+                            dynamic_access = True
+            elif isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    _config_receiver(node.value.id, ctx) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    node.attr not in _CONFIG_METHODS and \
+                    not node.attr.startswith("_"):
+                reads.add(node.attr)
+                uses.append((node.attr, ctx, node.lineno))
+            elif isinstance(node, ast.Constant) and is_test and \
+                    isinstance(node.value, str) and "=" in node.value:
+                for seg in node.value.split(";"):
+                    m = _CHAOS_SPEC_RE.match(seg.strip())
+                    if m:
+                        test_points.add(m.group(1))
+                        spec_refs.append((m.group(1), ctx,
+                                          getattr(node, "lineno", 1)))
+
+    if defines:
+        if not dynamic_access:
+            for name in sorted(defines):
+                ctx, line = defines[name]
+                if name in reads or ctx.allowed(line, "R13", "config-drift"):
+                    continue
+                yield Finding(
+                    "R13", "config-drift", ctx.relpath, line,
+                    f"config knob '{name}' is defined but never read "
+                    f"anywhere in the tree — dead knob or missing wiring; "
+                    f"wire it in, delete it, or justify with "
+                    f"'# raylint: allow(config-drift) <why>'")
+        for name, ctx, line in sorted(uses, key=lambda u: (u[1].relpath,
+                                                           u[2], u[0])):
+            if name in defines or ctx.allowed(line, "R13", "config-drift"):
+                continue
+            yield Finding(
+                "R13", "config-drift", ctx.relpath, line,
+                f"config knob '{name}' is accessed here but never defined "
+                f"— this raises at runtime, but only on the path that "
+                f"reads it")
+
+    if have_tests and declared_points:
+        for point in sorted(declared_points):
+            ctx, line = declared_points[point]
+            if point in test_points or \
+                    ctx.allowed(line, "R13", "config-drift"):
+                continue
+            yield Finding(
+                "R13", "config-drift", ctx.relpath, line,
+                f"chaos point '{point}' is declared here but never "
+                f"exercised by tests/ — the fault path is unvalidated; "
+                f"add a chaos test or justify with "
+                f"'# raylint: allow(config-drift) <why>'")
+        for point, ctx, line in sorted(spec_refs,
+                                       key=lambda r: (r[1].relpath, r[2])):
+            if point in declared_points or point in test_injects or \
+                    ctx.allowed(line, "R13", "config-drift"):
+                continue
+            yield Finding(
+                "R13", "config-drift", ctx.relpath, line,
+                f"test chaos spec references injection point '{point}' "
+                f"which no runtime inject() declares — the test runs "
+                f"fault-free")
+
+
+# --------------------------------------------------------------------------
 # engine
 
 class LintEngine:
     def __init__(self, roots: Iterable[str], baseline_path: Optional[str] = None,
                  only_rules: Optional[Set[str]] = None,
-                 proto_pairs: Optional[List[Tuple[str, str, str]]] = None):
+                 proto_pairs: Optional[List[Tuple[str, str, str]]] = None,
+                 allow_in: Optional[List[Tuple[str, Set[str]]]] = None,
+                 changed_only: Optional[Set[str]] = None):
         self.roots = [os.path.abspath(r) for r in roots]
         self.baseline = self._load_baseline(baseline_path)
         self.only_rules = only_rules
         # explicit (proto_path, pb2_path, relpath) triples override R6's
         # autodiscovery — the drift tests point this at mutated fixtures
         self.proto_pairs = proto_pairs
+        # scoped allow profile: (path prefix, {rule ids/tags}) pairs —
+        # findings under the prefix for those rules are suppressed (the
+        # gate relaxes a few rules for tests/ without allowlisting files)
+        self.allow_in = allow_in or []
+        # incremental mode: the whole tree is still parsed (project rules
+        # need global context) but only findings in these repo-relative
+        # paths are reported
+        self.changed_only = changed_only
         self.errors: List[str] = []
+        self._index: Optional[_cg.ProjectIndex] = None
+
+    def index(self, ctxs: List[FileContext]) -> _cg.ProjectIndex:
+        """Whole-program symbol table / call graph, built once per run and
+        shared by every interprocedural rule (R10-R12)."""
+        if self._index is None:
+            self._index = _cg.ProjectIndex(ctxs)
+        return self._index
 
     @staticmethod
     def _load_baseline(path: Optional[str]) -> Set[Tuple[str, str]]:
@@ -933,8 +1450,12 @@ class LintEngine:
                 continue
             base = os.path.dirname(root.rstrip(os.sep))
             for dirpath, dirnames, filenames in os.walk(root):
+                # devtools/fixtures holds deliberately-findings-bearing
+                # corpus files for --self-check; only an explicit root
+                # pointing inside it lints them
                 dirnames[:] = sorted(d for d in dirnames
-                                     if d not in ("__pycache__", ".git"))
+                                     if d not in ("__pycache__", ".git",
+                                                  "fixtures"))
                 for fname in sorted(filenames):
                     if fname.endswith(".py"):
                         full = os.path.join(dirpath, fname)
@@ -958,10 +1479,80 @@ class LintEngine:
                 findings.extend(fn(ctxs, self))
         findings = [f for f in findings
                     if (f.rule, f.path) not in self.baseline]
+        if self.allow_in:
+            findings = [f for f in findings
+                        if not any(
+                            f.path.replace("\\", "/").startswith(prefix) and
+                            ({f.rule, f.tag, "all"} & rules)
+                            for prefix, rules in self.allow_in)]
+        if self.changed_only is not None:
+            changed = {p.replace("\\", "/") for p in self.changed_only}
+            findings = [f for f in findings
+                        if f.path.replace("\\", "/") in changed]
         # nested loops can both see one sleep/handler — report each site once
         findings = sorted(set(findings),
                           key=lambda f: (f.path, f.line, f.rule))
         return findings
+
+
+def rule_listing() -> List[dict]:
+    """Machine-readable registry listing (``--rules`` with no value).
+
+    ``run_static_analysis.sh`` and the docs regeneration check consume
+    this, so the script header and the ARCHITECTURE.md rule table can
+    never drift from the rules actually registered."""
+    out = []
+    for kind, reg in (("file", RULES), ("project", PROJECT_RULES)):
+        for rule_id, tag, fn in reg:
+            doc = " ".join((fn.__doc__ or "").strip().split())
+            out.append({"id": rule_id, "tag": tag, "kind": kind,
+                        "summary": doc.split(". ")[0][:240]})
+    out.sort(key=lambda r: int(r["id"][1:]))
+    return out
+
+
+def _changed_files(ref: str) -> Optional[Set[str]]:
+    """Repo-relative ``*.py`` paths changed vs *ref* plus untracked files,
+    or None when git is unavailable (caller falls back to a full lint)."""
+    import subprocess
+    files: Set[str] = set()
+    for cmd in (["git", "diff", "--name-only", "--diff-filter=d", ref],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            return None
+        files |= {line.strip() for line in proc.stdout.splitlines()
+                  if line.strip().endswith(".py")}
+    return files
+
+
+def _run_self_check() -> int:
+    """Round-trip the shipped fixture corpus against expected.json: every
+    expected finding must fire at its exact line, and nothing else may."""
+    base = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures")
+    expected_path = os.path.join(base, "expected.json")
+    with open(expected_path, encoding="utf-8") as f:
+        expected = json.load(f)
+    engine = LintEngine([base])
+    got = {(f.rule, f.path, f.line) for f in engine.run()}
+    want = {(e["rule"], e["path"], e["line"]) for e in expected}
+    for rule_id, path, line in sorted(want - got):
+        print(f"self-check: MISSING expected finding "
+              f"{rule_id} at {path}:{line}")
+    for rule_id, path, line in sorted(got - want):
+        print(f"self-check: UNEXPECTED finding {rule_id} at {path}:{line}")
+    for err in engine.errors:
+        print(f"self-check: warning: {err}")
+    if got == want:
+        print(f"self-check: OK ({len(want)} fixture findings round-trip)")
+        return 0
+    print("self-check: FAIL")
+    return 1
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -975,15 +1566,52 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="emit findings as a JSON array")
     parser.add_argument("--baseline", default=None,
                         help="allowlist file of 'RULE path' lines")
-    parser.add_argument("--rules", default=None,
+    parser.add_argument("--rules", nargs="?", const="<list>", default=None,
+                        metavar="IDS",
                         help="comma-separated rule ids/tags to run "
-                             "(default: all)")
+                             "(default: all); with no value, print the "
+                             "machine-readable rule listing as JSON")
+    parser.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                        metavar="REF",
+                        help="incremental mode: parse the whole tree "
+                             "(project rules need it) but only report "
+                             "findings in files changed vs REF "
+                             "(git diff + untracked; default HEAD)")
+    parser.add_argument("--allow-in", action="append", default=[],
+                        metavar="PREFIX:RULES",
+                        help="scoped allow profile, e.g. "
+                             "'tests/:R12,bare-retry' — suppress those "
+                             "rules under the path prefix (repeatable)")
+    parser.add_argument("--self-check", action="store_true",
+                        help="lint the shipped fixture corpus and verify "
+                             "it round-trips expected.json exactly")
     parser.add_argument("--write-baseline", default=None, metavar="FILE",
                         help="write current findings as a baseline and exit 0")
     args = parser.parse_args(argv)
 
+    if args.self_check:
+        return _run_self_check()
+    if args.rules == "<list>":
+        print(json.dumps(rule_listing(), indent=2))
+        return 0
+
     only = {r.strip() for r in args.rules.split(",")} if args.rules else None
-    engine = LintEngine(args.roots or ["ray_tpu"], args.baseline, only)
+    allow_in = []
+    for spec in args.allow_in:
+        prefix, _, rules_csv = spec.partition(":")
+        if not prefix or not rules_csv:
+            parser.error(f"--allow-in wants PREFIX:RULES, got {spec!r}")
+        allow_in.append((prefix, {r.strip() for r in rules_csv.split(",")}))
+    changed_only = None
+    if args.changed is not None:
+        changed_only = _changed_files(args.changed)
+        if changed_only is not None and not changed_only:
+            # nothing changed: cheap exit, same contract as a clean lint
+            print("raylint: 0 finding(s) (no changed *.py files)"
+                  if not args.json else "[]")
+            return 0
+    engine = LintEngine(args.roots or ["ray_tpu"], args.baseline, only,
+                        allow_in=allow_in, changed_only=changed_only)
     findings = engine.run()
 
     if args.write_baseline:

@@ -50,7 +50,17 @@ import time
 from typing import Dict, List, Optional, Set, Tuple
 
 __all__ = ["install", "uninstall", "installed", "wrap", "Lock", "RLock",
-           "report", "cycles", "reset"]
+           "report", "cycles", "reset", "format_cycle"]
+
+
+def format_cycle(kind: str, sites) -> str:
+    """Canonical one-line rendering of a lock-order cycle.
+
+    Shared by the runtime exit report and raylint R11's static findings:
+    both identify a cycle by its sorted participant sites, so
+    ``CYCLE (site-order): A -> B`` from either tool names the same
+    inversion and one allow/fix covers both."""
+    return f"CYCLE ({kind}): " + " -> ".join(sites)
 
 # raw primitives so the watchdog never traces itself
 _graph_lock = _thread.allocate_lock()
@@ -367,8 +377,8 @@ def _exit_report() -> None:
           f"{len(rep['long_holds'])} long holds", file=sys.stderr)
     if n_cycles:
         for cyc in rep["cycles"]:
-            print(f"LOCKWATCH CYCLE ({cyc['kind']}): "
-                  + " -> ".join(cyc["sites"]), file=sys.stderr)
+            print("LOCKWATCH " + format_cycle(cyc["kind"], cyc["sites"]),
+                  file=sys.stderr)
         for e in rep["edges"]:
             print(f"LOCKWATCH edge: {e['from']} -> {e['to']} "
                   f"x{e['count']} [{e['thread']}]", file=sys.stderr)

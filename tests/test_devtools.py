@@ -431,3 +431,542 @@ def test_cli_exits_zero_on_clean_tree(tmp_path):
     bad = tmp_path / "bad.py"
     bad.write_text("try:\n    pass\nexcept Exception:\n    pass\n")
     assert main([str(bad), "--json"]) == 1
+
+
+# -- whole-program engine: multi-file helper ----------------------------------
+
+def run_tree(tmp_path, rule_id, files):
+    """Lint a multi-file tree rooted at ``proj/`` with one rule active."""
+    root = tmp_path / "proj"
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    eng = LintEngine([str(root)],
+                     only_rules={rule_id} if rule_id else None)
+    findings = eng.run()
+    assert not eng.errors, eng.errors
+    return findings
+
+
+def build_index(tmp_path, files):
+    """ProjectIndex over a written tree, for call-graph unit tests."""
+    from ray_tpu.devtools import callgraph
+    from ray_tpu.devtools.linter import FileContext
+    root = tmp_path / "proj"
+    ctxs = []
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        text = textwrap.dedent(src)
+        p.write_text(text)
+        ctxs.append(FileContext(str(p), f"proj/{rel}", text))
+    return callgraph.ProjectIndex(ctxs)
+
+
+# -- call graph: resolution unit tests ----------------------------------------
+
+def test_callgraph_resolves_self_methods_and_module_aliases(tmp_path):
+    idx = build_index(tmp_path, {
+        "helpers.py": """\
+            def util():
+                return 1
+        """,
+        "mod.py": """\
+            import proj.helpers as h
+            from proj import helpers as h2
+
+            class Worker:
+                def run(self):
+                    self.step()
+                    h.util()
+                    h2.util()
+
+                def step(self):
+                    return 0
+        """,
+    })
+    run = idx.functions["proj.mod:Worker.run"]
+    targets = {s.raw: s.target for s in run.call_sites}
+    assert targets["self.step"] == "proj.mod:Worker.step"
+    assert targets["h.util"] == "proj.helpers:util"
+    assert targets["h2.util"] == "proj.helpers:util"
+
+
+def test_callgraph_dynamic_call_degrades_to_unknown(tmp_path):
+    idx = build_index(tmp_path, {
+        "mod.py": """\
+            def apply(callback):
+                callback()
+
+            def indirect(obj):
+                obj.method()
+        """,
+    })
+    for fname in ("proj.mod:apply", "proj.mod:indirect"):
+        sites = idx.functions[fname].call_sites
+        assert len(sites) == 1
+        assert sites[0].target is None   # unknown, never a guess
+
+
+# -- R10: transitive async blocking -------------------------------------------
+
+def test_r10_fires_on_blocking_reached_through_helpers(tmp_path):
+    findings = run_tree(tmp_path, "R10", {
+        "svc.py": """\
+            import time
+
+            from proj import util
+
+            async def handler():
+                util.relay()
+        """,
+        "util.py": """\
+            import time
+
+            def relay():
+                backoff()
+
+            def backoff():
+                time.sleep(0.5)
+        """,
+    })
+    assert [f.rule for f in findings] == ["R10"]
+    assert "handler" in findings[0].message
+    assert "relay" in findings[0].message      # witness path is shown
+    assert findings[0].path.endswith("util.py")
+
+
+def test_r10_quiet_on_spawn_edges_dynamic_calls_and_allow(tmp_path):
+    findings = run_tree(tmp_path, "R10", {
+        "svc.py": """\
+            import threading
+            import time
+
+            def backoff():
+                time.sleep(0.5)
+
+            async def spawns():
+                threading.Thread(target=backoff).start()
+
+            async def dynamic(cb):
+                cb()
+
+            def allowed_block():
+                time.sleep(0.1)  # raylint: allow(async-transitive) shutdown path: loop is gone
+
+            async def uses_allowed():
+                allowed_block()
+        """,
+    })
+    assert findings == []
+
+
+# -- R11: static lock-order graph ---------------------------------------------
+
+def test_r11_fires_on_cross_function_lock_cycle(tmp_path):
+    findings = run_tree(tmp_path, "R11", {
+        "a.py": """\
+            import threading
+
+            from proj import b
+
+            LOCK_A = threading.Lock()
+
+            def with_a_then_b():
+                with LOCK_A:
+                    b.grab_b()
+
+            def grab_a():
+                with LOCK_A:
+                    pass
+        """,
+        "b.py": """\
+            import threading
+
+            from proj import a
+
+            LOCK_B = threading.Lock()
+
+            def grab_b():
+                with LOCK_B:
+                    pass
+
+            def with_b_then_a():
+                with LOCK_B:
+                    a.grab_a()
+        """,
+    })
+    assert len(findings) == 1 and findings[0].rule == "R11"
+    assert "CYCLE (site-order)" in findings[0].message
+    assert "LOCK_A" in findings[0].message and "LOCK_B" in findings[0].message
+
+
+def test_r11_fires_on_cross_file_direct_nesting_inversion(tmp_path):
+    # No call edge at all: each file nests both locks directly, in opposite
+    # orders.  R2's syntactic identity cannot merge LOCK_B with b.LOCK_B,
+    # so this cycle is R11's to report (module-alias lock attributes are
+    # resolved to the defining module's node).
+    findings = run_tree(tmp_path, "R11", {
+        "a.py": """\
+            import threading
+
+            from proj import b
+
+            LOCK_A = threading.Lock()
+
+            def a_then_b():
+                with LOCK_A:
+                    with b.LOCK_B:
+                        pass
+        """,
+        "b.py": """\
+            import threading
+
+            from proj import a
+
+            LOCK_B = threading.Lock()
+
+            def b_then_a():
+                with LOCK_B:
+                    with a.LOCK_A:
+                        pass
+        """,
+    })
+    assert len(findings) == 1 and findings[0].rule == "R11"
+    assert "proj.a.LOCK_A" in findings[0].message
+    assert "proj.b.LOCK_B" in findings[0].message
+
+
+def test_r11_quiet_on_single_file_direct_nesting_inversion(tmp_path):
+    # Both orders written inside one file are R2's finding; R11 stays
+    # quiet so the same deadlock is not double-reported.
+    findings = run_tree(tmp_path, "R11", {
+        "a.py": """\
+            import threading
+
+            LOCK_A = threading.Lock()
+            LOCK_B = threading.Lock()
+
+            def a_then_b():
+                with LOCK_A:
+                    with LOCK_B:
+                        pass
+
+            def b_then_a():
+                with LOCK_B:
+                    with LOCK_A:
+                        pass
+        """,
+    })
+    assert findings == []
+
+
+def test_r11_quiet_on_consistent_cross_function_order(tmp_path):
+    findings = run_tree(tmp_path, "R11", {
+        "a.py": """\
+            import threading
+
+            from proj import b
+
+            LOCK_A = threading.Lock()
+
+            def with_a_then_b():
+                with LOCK_A:
+                    b.grab_b()
+
+            def also_a_then_b():
+                with LOCK_A:
+                    b.grab_b()
+        """,
+        "b.py": """\
+            import threading
+
+            LOCK_B = threading.Lock()
+
+            def grab_b():
+                with LOCK_B:
+                    pass
+        """,
+    })
+    assert findings == []
+
+
+def test_r11_quiet_when_cycle_needs_a_spawn_edge(tmp_path):
+    # the "reverse" order only happens on a freshly spawned thread, which
+    # starts with an empty hold set: no cycle
+    findings = run_tree(tmp_path, "R11", {
+        "a.py": """\
+            import threading
+
+            from proj import b
+
+            LOCK_A = threading.Lock()
+
+            def with_a_then_b():
+                with LOCK_A:
+                    b.grab_b()
+
+            def grab_a():
+                with LOCK_A:
+                    pass
+        """,
+        "b.py": """\
+            import threading
+
+            from proj import a
+
+            LOCK_B = threading.Lock()
+
+            def grab_b():
+                with LOCK_B:
+                    pass
+
+            def spawn_reverse():
+                with LOCK_B:
+                    threading.Thread(target=a.grab_a).start()
+        """,
+    })
+    assert findings == []
+
+
+# -- R12: collective divergence -----------------------------------------------
+
+def test_r12_fires_on_rank_guarded_collective(tmp_path):
+    findings = run_tree(tmp_path, "R12", {
+        "spmd.py": """\
+            def barrier():
+                pass
+
+            def commit(rank, state):
+                if rank == 0:
+                    barrier()
+        """,
+    })
+    assert [f.rule for f in findings] == ["R12"]
+    assert "barrier" in findings[0].message
+
+
+def test_r12_fires_on_except_handler_collective(tmp_path):
+    findings = run_tree(tmp_path, "R12", {
+        "spmd.py": """\
+            def allreduce(x):
+                return x
+
+            def step(x):
+                try:
+                    x = x + 1
+                except ValueError:
+                    allreduce(x)
+                return x
+        """,
+    })
+    assert [f.rule for f in findings] == ["R12"]
+    assert "except" in findings[0].message
+
+
+def test_r12_quiet_on_uniform_schedules_and_allow(tmp_path):
+    findings = run_tree(tmp_path, "R12", {
+        "spmd.py": """\
+            def barrier():
+                pass
+
+            def both_arms(rank, state):
+                if rank == 0:
+                    state["leader"] = True
+                    barrier()
+                else:
+                    barrier()
+
+            def after_branch(rank, state):
+                if rank == 0:
+                    state["leader"] = True
+                barrier()
+
+            def justified(rank):
+                if rank == 0:
+                    barrier()  # raylint: allow(collective-divergence) single-rank test harness
+        """,
+    })
+    assert findings == []
+
+
+def test_r12_regression_divergent_commit_deadlocks_under_chaos(tmp_path):
+    """The acceptance shape: a rank-divergent checkpoint-commit branch is
+    (a) flagged statically, and (b) actually deadlocks when the chaos gate
+    faults one rank out of the commit barrier."""
+    findings = run_tree(tmp_path, "R12", {"ckpt.py": """\
+        def commit_and_sync(rank, tree, results):
+            if rank == 0:
+                results["manifest"] = tree
+                barrier()
+
+        def barrier():
+            pass
+    """})
+    assert [f.rule for f in findings] == ["R12"]
+
+    # runtime: two "ranks", chaos faults rank 1 before the commit barrier
+    from ray_tpu import chaos
+    from ray_tpu.chaos.engine import ChaosError
+    prev = chaos.schedule()
+    bar = threading.Barrier(2)
+    outcome = {}
+
+    def rank_main(rank):
+        try:
+            chaos.inject("ckpt.commit", rank=str(rank))
+            bar.wait(timeout=1.0)             # the commit barrier
+            outcome[rank] = "committed"
+        except ChaosError:
+            outcome[rank] = "faulted"         # diverged: never arrives
+        except threading.BrokenBarrierError:
+            outcome[rank] = "deadlocked"      # waited for a rank that won't come
+
+    try:
+        chaos.configure(7, "ckpt.commit[rank=1]@1=error")
+        threads = [threading.Thread(target=rank_main, args=(r,))
+                   for r in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert outcome == {0: "deadlocked", 1: "faulted"}, outcome
+    finally:
+        if prev is not None:
+            chaos.install(prev)
+        else:
+            chaos.clear()
+
+
+# -- R13: config-knob and chaos-point drift -----------------------------------
+
+def test_r13_fires_on_dead_and_undefined_knobs(tmp_path):
+    findings = run_tree(tmp_path, "R13", {
+        "conf.py": """\
+            from ray_tpu._private.config import _config
+
+            _config.define("live_knob", int, 1, "read below")
+            _config.define("dead_knob", int, 2, "never read")
+
+            def reader():
+                return _config.get("live_knob") + _config.get("ghost_knob")
+        """,
+    })
+    msgs = {f.message.split("'")[1]: f for f in findings}
+    assert set(msgs) == {"dead_knob", "ghost_knob"}
+    assert "never read" in msgs["dead_knob"].message
+    assert "never defined" in msgs["ghost_knob"].message
+
+
+def test_r13_ignores_unrelated_cfg_locals(tmp_path):
+    # a plain dict/dataclass named cfg or _config must not be mistaken for
+    # the knob registry: only the imported registry counts
+    findings = run_tree(tmp_path, "R13", {
+        "conf.py": """\
+            from ray_tpu._private.config import _config
+
+            _config.define("real_knob", int, 1, "read below")
+
+            def ok():
+                return _config.get("real_knob")
+        """,
+        "algo.py": """\
+            def train(cfg, _config):
+                cfg.setdefault("lr", 1e-3)
+                return cfg.batch_size + _config.get("whatever")
+        """,
+    })
+    assert findings == []
+
+
+def test_r13_chaos_point_closure(tmp_path):
+    findings = run_tree(tmp_path, "R13", {
+        "runtime.py": """\
+            from ray_tpu import chaos
+
+            def faults():
+                chaos.inject("svc.tested")
+                chaos.inject("svc.untested")
+        """,
+        "test_faults.py": """\
+            from ray_tpu import chaos as ch
+
+            def test_one():
+                ch.configure(3, "svc.tested@1=error")
+                spec = "svc.ghost@1=drop"
+                ch.inject("svc.direct")   # direct test inject: not "unknown"
+                return spec
+        """,
+    })
+    by_point = {f.message.split("'")[1]: f for f in findings}
+    assert set(by_point) == {"svc.untested", "svc.ghost"}
+    assert "never exercised" in by_point["svc.untested"].message
+    assert "no runtime inject" in by_point["svc.ghost"].message
+
+
+# -- CLI: --rules listing, --json, --changed, --allow-in, --self-check --------
+
+def test_cli_rules_listing_is_machine_readable(capsys):
+    import json as _json
+    from ray_tpu.devtools.linter import main
+    assert main(["--rules"]) == 0
+    rows = _json.loads(capsys.readouterr().out)
+    ids = [r["id"] for r in rows]
+    assert ids == sorted(ids, key=lambda i: int(i[1:]))
+    assert {"R1", "R10", "R11", "R12", "R13"} <= set(ids)
+    assert all({"id", "tag", "kind", "summary"} <= set(r) for r in rows)
+
+
+def test_cli_json_output_carries_structured_findings(tmp_path, capsys):
+    import json as _json
+    from ray_tpu.devtools.linter import main
+    bad = tmp_path / "bad.py"
+    bad.write_text("try:\n    pass\nexcept Exception:\n    pass\n")
+    assert main([str(bad), "--json"]) == 1
+    rows = _json.loads(capsys.readouterr().out)
+    assert rows and rows[0]["rule"] == "R4"
+    assert {"rule", "tag", "path", "line", "message"} <= set(rows[0])
+
+
+def test_cli_changed_filters_to_git_diff(tmp_path, monkeypatch, capsys):
+    import subprocess
+    repo = tmp_path / "repo"
+    (repo / "pkg").mkdir(parents=True)
+    clean = "def ok():\n    return 1\n"
+    swallow = "try:\n    pass\nexcept Exception:\n    pass\n"
+    (repo / "pkg" / "a.py").write_text(swallow)   # committed: pre-existing
+    (repo / "pkg" / "b.py").write_text(clean)
+    env = {"GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+           "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"}
+    for cmd in (["git", "init", "-q"], ["git", "add", "."],
+                ["git", "commit", "-qm", "seed"]):
+        subprocess.run(cmd, cwd=repo, check=True,
+                       env={**os.environ, **env})
+    monkeypatch.chdir(repo)
+    from ray_tpu.devtools.linter import main
+    # nothing changed: early exit, pre-existing finding in a.py not reported
+    assert main(["pkg", "--changed"]) == 0
+    assert "0 finding(s)" in capsys.readouterr().out
+    # touch b.py with a NEW finding: only b.py is reported
+    (repo / "pkg" / "b.py").write_text(swallow)
+    assert main(["pkg", "--changed"]) == 1
+    out = capsys.readouterr().out
+    assert "b.py" in out and "a.py" not in out
+
+
+def test_cli_allow_in_scopes_suppression_by_prefix(tmp_path):
+    root = tmp_path / "proj"
+    (root / "tests").mkdir(parents=True)
+    (root / "lib").mkdir()
+    swallow = "try:\n    pass\nexcept Exception:\n    pass\n"
+    (root / "tests" / "test_x.py").write_text(swallow)
+    (root / "lib" / "x.py").write_text(swallow)
+    eng = LintEngine([str(root)], allow_in=[("proj/tests/", {"R4"})])
+    findings = eng.run()
+    assert [f.path for f in findings] == ["proj/lib/x.py"]
+
+
+def test_cli_self_check_round_trips_fixture_corpus():
+    from ray_tpu.devtools.linter import main
+    assert main(["--self-check"]) == 0
