@@ -138,6 +138,50 @@ def bench_remote_fetch(prefix: str, mb: int = 32):
         emit(f"{prefix}_remote_fetch_gbps", measure(), "GB/s")
 
 
+def bench_trace_overhead(prefix: str, n: int = 800):
+    """Tracing cost on the hottest runtime path (1KB put/get), A/B'd by
+    flipping ``observability.ENABLED`` around identical loops:
+
+    - ``_trace_overhead_enabled_pct``: full-tracing latency (context
+      mint + span record per op) vs the disabled fast path;
+    - ``_trace_overhead_disabled_pct``: the disabled fast path measured
+      AFTER tracing ran and was turned off, vs before it ever ran — any
+      residual cost of the instrumentation when off (the module-bool
+      guard plus leaked state) shows up here.  The ``--check`` gate
+      bounds both from above (``_pct`` metrics are smaller-is-better).
+    """
+    import statistics
+
+    import ray_tpu
+    from ray_tpu import observability
+    from ray_tpu._private.config import _config
+    small = np.zeros(128, np.int64)
+
+    def put_get_us():
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ray_tpu.get(ray_tpu.put(small))
+        return (time.perf_counter() - t0) / n * 1e6
+
+    put_get_us()  # warm
+    off_before = statistics.median(put_get_us() for _ in range(3))
+    prof_was = bool(_config.get("profiling_enabled"))
+    _config.set("profiling_enabled", True)  # spans must actually record
+    observability.enable()
+    try:
+        on = statistics.median(put_get_us() for _ in range(3))
+    finally:
+        observability.disable()
+        _config.set("profiling_enabled", prof_was)
+    off_after = statistics.median(put_get_us() for _ in range(3))
+    base = min(off_before, off_after)
+    emit(f"{prefix}_put_get_traced_us", on, "us")
+    emit(f"{prefix}_trace_overhead_enabled_pct",
+         100.0 * (on - base) / base, "%")
+    emit(f"{prefix}_trace_overhead_disabled_pct",
+         100.0 * (off_after - off_before) / off_before, "%")
+
+
 def bench_checkpoint(mb: int = 64):
     """Checkpoint-engine data path, no cluster needed: cold save throughput
     (content-hash + framed chunk writes + atomic commit), warm save of an
@@ -206,6 +250,7 @@ def run_inproc():
     bench_tasks("inproc")
     bench_actor_calls("inproc")
     bench_put_get("inproc")
+    bench_trace_overhead("inproc")
     ray_tpu.shutdown()
 
 
@@ -227,10 +272,12 @@ def run_cluster():
 def check_against(baseline_path: str, tolerance: float) -> int:
     """Regression gate: compare this run's metrics against a tracked
     baseline. Throughput-style metrics (tasks/s, GB/s, calls/s) must stay
-    >= baseline * tolerance; latency metrics (``_us``) are inverted and
-    must stay <= baseline / tolerance. Metrics missing from either side
-    are skipped (a cluster-less environment still gates the inproc set).
-    Returns the number of regressions (process exit code)."""
+    >= baseline * tolerance; latency metrics (``_us``) and overhead
+    percentages (``_pct``) are inverted and must stay <= baseline /
+    tolerance (for ``_pct`` the baseline is the budget itself — e.g. the
+    1% disabled-tracing bound — not a past measurement). Metrics missing
+    from either side are skipped (a cluster-less environment still gates
+    the inproc set). Returns the number of regressions (exit code)."""
     with open(baseline_path) as f:
         baseline = {row["metric"]: row["value"] for row in json.load(f)}
     measured = {row["metric"]: row["value"] for row in RESULTS}
@@ -239,7 +286,7 @@ def check_against(baseline_path: str, tolerance: float) -> int:
         got = measured.get(metric)
         if got is None or base <= 0:
             continue
-        if metric.endswith("_us"):
+        if metric.endswith(("_us", "_pct")):
             ok = got <= base / tolerance
             bound = f"<= {base / tolerance:.2f}"
         else:

@@ -23,7 +23,8 @@ from ray_tpu._private.worker import (available_resources, cancel,
                                      is_initialized, kill, nodes, put,
                                      register_named_actor_class,
                                      register_named_function,
-                                     set_profiling_enabled, shutdown,
+                                     set_profiling_enabled,
+                                     set_tracing_enabled, shutdown,
                                      timeline, wait)
 from ray_tpu.actor import ActorClass, ActorHandle, ActorMethod  # noqa: F401
 from ray_tpu.exceptions import (ActorDiedError, GetTimeoutError,  # noqa: F401
@@ -40,6 +41,7 @@ __all__ = [
     "kill", "cancel", "get_actor", "available_resources", "cluster_resources",
     "register_named_actor_class",
     "register_named_function", "set_profiling_enabled",
+    "set_tracing_enabled",
     "nodes", "timeline", "ObjectRef", "ActorClass", "ActorHandle",
     "ActorMethod",
     "RemoteFunction", "get_runtime_context",

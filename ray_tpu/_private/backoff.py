@@ -58,7 +58,8 @@ class BackoffPolicy:
                  attempt_timeout_s: Optional[float] = None,
                  retryable: Tuple[Type[BaseException], ...] = RETRYABLE_DEFAULT,
                  jitter: bool = True,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None,
+                 label: str = ""):
         self.base_s = base_s
         self.max_s = max_s
         self.multiplier = multiplier
@@ -68,6 +69,7 @@ class BackoffPolicy:
         self.retryable = retryable
         self.jitter = jitter
         self.seed = seed
+        self.label = label  # metrics site tag for backoff_retries_total
 
     def classify(self, exc: BaseException) -> bool:
         """True when ``exc`` should be retried under this policy."""
@@ -125,6 +127,7 @@ class BackoffState:
         self._started = clock()
         self._deadline = (self._started + deadline) if deadline > 0 else None
         self.attempt = 0  # completed (failed) attempts so far
+        self.site = policy.label  # overridable per-sequence metrics tag
         self._rng = (random.Random(policy.seed)
                      if policy.seed is not None else _rng)
 
@@ -163,6 +166,7 @@ class BackoffState:
             if rem <= 0:
                 return None
             delay = min(delay, rem)  # never sleep past the deadline
+        _count_retry(self.site or "unlabeled")
         return delay
 
     def sleep(self, sleep: Callable[[float], None] = time.sleep) -> bool:
@@ -178,6 +182,24 @@ class BackoffState:
 
 _rng = random.Random()
 
+_retry_counter = None
+
+
+def _count_retry(site: str):
+    # Lazy singleton (metrics must not be a hard import here: backoff is
+    # used by the wire layer during bootstrap). One counter, tagged by
+    # call site, covers every BackoffPolicy loop in the runtime.
+    global _retry_counter
+    try:
+        from ray_tpu.util.metrics import Counter
+        if _retry_counter is None:
+            _retry_counter = Counter(
+                "backoff_retries_total",
+                "retry attempts by call site", tag_keys=("site",))
+        _retry_counter.inc(tags={"site": site})
+    except Exception:  # raylint: allow(swallow) metrics must never break a retry loop
+        pass
+
 
 def retry_call(fn: Callable[[Optional[float]], object],
                policy: Optional[BackoffPolicy] = None, *,
@@ -189,6 +211,8 @@ def retry_call(fn: Callable[[Optional[float]], object],
     ignore it. ``on_retry(attempt, exc)`` fires before each backoff sleep."""
     policy = policy or BackoffPolicy()
     state = policy.start()
+    if not state.site:
+        state.site = getattr(fn, "__qualname__", "") or "fn"
     while True:
         try:
             return fn(state.attempt_timeout())

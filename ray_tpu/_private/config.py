@@ -218,3 +218,6 @@ _config.define("metrics_report_interval_ms", int, 2000, "")
 # -- Tracing --------------------------------------------------------------------
 _config.define("tracing_enabled", bool, False, "emit per-task spans")
 _config.define("profiling_enabled", bool, True, "record timeline events")
+_config.define("trace_ring_size", int, 200_000,
+               "per-process span ring capacity; oldest spans drop when full "
+               "(drops exported as the profiler_spans_dropped counter)")

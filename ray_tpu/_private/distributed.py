@@ -55,7 +55,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import cloudpickle
 
-from ray_tpu import chaos
+from ray_tpu import chaos, observability
 from ray_tpu import exceptions as exc
 from ray_tpu._private.backoff import BackoffPolicy, BreakerBoard
 from ray_tpu._private.config import _config
@@ -97,6 +97,34 @@ _loads_framed = loads_framed
 
 def _fetch_chunk() -> int:
     return _config.get("fetch_chunk_bytes") or FETCH_CHUNK
+
+
+_stripe_hist_m = None
+_breaker_counter_m = None
+
+
+def _stripe_hist():
+    # Lazy singletons: metric objects are created at first use, not at
+    # import (the registry may be cleared between tests).
+    global _stripe_hist_m
+    if _stripe_hist_m is None:
+        _stripe_hist_m = _metrics.Histogram(
+            "fetch_stripe_ms",
+            "per-chunk striped-fetch round-trip by peer (ms)",
+            boundaries=(0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0, 500.0,
+                        1000.0, 5000.0),
+            tag_keys=("peer",))
+    return _stripe_hist_m
+
+
+def _breaker_transitions():
+    global _breaker_counter_m
+    if _breaker_counter_m is None:
+        _breaker_counter_m = _metrics.Counter(
+            "circuit_breaker_transitions_total",
+            "circuit-breaker state transitions by peer",
+            tag_keys=("peer", "to"))
+    return _breaker_counter_m
 
 
 def _data_sock_buf() -> int:
@@ -333,6 +361,10 @@ class DistributedRuntime(Runtime):
         self.heartbeat_misses = 0          # consecutive failed beats
         self.heartbeat_last_success = 0.0  # epoch seconds of last ack
         node_tag = self.local_node.node_id.hex()[:8]
+        if not is_driver:
+            # obs spans recorded in this daemon (rpc dispatch, fetches,
+            # checkpoint stages) group under the node's timeline row
+            observability.set_process_label(f"node:{node_tag}")
         self._hb_miss_gauge = _metrics.Gauge(
             "heartbeat_consecutive_misses",
             "consecutive failed heartbeats to the state service",
@@ -637,6 +669,10 @@ class DistributedRuntime(Runtime):
                                     max_s=max(4 * self._hb_interval, 5.0),
                                     deadline_s=0)
         node_tag = self.local_node.node_id.hex()[:8]
+        if not is_driver:
+            # obs spans recorded in this daemon (rpc dispatch, fetches,
+            # checkpoint stages) group under the node's timeline row
+            observability.set_process_label(f"node:{node_tag}")
         while not self._hb_stop.wait(self._hb_interval):
             try:
                 if chaos.ENABLED and chaos.inject(
@@ -1108,7 +1144,13 @@ class DistributedRuntime(Runtime):
             addrs.sort(key=lambda a: self.breakers.get(a).state_code() == 2)
         for addr in addrs:
             try:
-                value, err = self._fetch_from(addr, oid)
+                if observability.ENABLED:
+                    with observability.span("object.fetch", cat="data",
+                                            peer=addr,
+                                            object=oid.hex()[:8]):
+                        value, err = self._fetch_from(addr, oid)
+                else:
+                    value, err = self._fetch_from(addr, oid)
                 self.breakers.record_success(addr)
             except (RpcConnectionError, RpcRemoteError, TimeoutError) as e:
                 if not isinstance(e, RpcRemoteError):
@@ -1225,7 +1267,13 @@ class DistributedRuntime(Runtime):
                 done = threading.Event()       # reader threads; keep tiny
 
                 def _chunk_cb(off):
+                    t0 = time.monotonic() if observability.ENABLED else 0.0
+
                     def cb(env, error):
+                        if t0:
+                            _stripe_hist().observe(
+                                (time.monotonic() - t0) * 1e3,
+                                tags={"peer": addr})
                         try:
                             if error is None:
                                 crep = pb.FetchObjectReply()
@@ -2038,6 +2086,9 @@ class DistributedRuntime(Runtime):
         failures): shed scheduling traffic to it until the half-open probe
         succeeds — the existing suspect-address exclusion is the mechanism."""
         logger.warning("circuit breaker OPEN for peer %s", addr)
+        _breaker_transitions().inc(tags={"peer": addr, "to": "open"})
+        if observability.ENABLED:
+            observability.instant("breaker:open", cat="breaker", peer=addr)
         with self._view_lock:
             self._suspect_addrs[addr] = (time.monotonic()
                                          + _config.get("circuit_reset_s"))
@@ -2980,7 +3031,12 @@ class DistributedRuntime(Runtime):
             if hit is not None:
                 return hit[0]
         value = self.local_node.store.get(oid, timeout=0)
-        payload = FramedPayload(value)
+        # Frame provenance: the serving trace is embedded ONCE, at frame
+        # construction — the cached payload is shared across concurrent
+        # fetch requests, so a per-request stamp would be wrong.
+        trace = (observability.wire_context().encode("ascii")
+                 if observability.ENABLED else b"")
+        payload = FramedPayload(value, trace)
         with self._fetch_cache_lock:
             self._fetch_cache[oid] = [payload, None]
             while len(self._fetch_cache) > 8:
@@ -3015,7 +3071,10 @@ class DistributedRuntime(Runtime):
         req.ParseFromString(ctx.body)
         payload: Dict[str, Any] = {}
         if req.log_lines:
-            payload["logs"] = log_ring.tail(int(req.log_lines))
+            payload["logs"] = log_ring.tail(int(req.log_lines),
+                                            trace_id=req.trace_filter)
+        if req.include_metrics:
+            payload["metrics"] = _metrics.snapshot()
         if req.include_tasks:
             cap = int(req.max_tasks) or 1000
             with self.lock:
@@ -3042,10 +3101,16 @@ class DistributedRuntime(Runtime):
         from ray_tpu._private.profiling import get_profiler
         req = pb.TimelineRequest()
         req.ParseFromString(ctx.body)
-        if req.set_enabled:
+        if req.set_enabled or req.set_tracing:
             # pure toggle: the caller discards the reply — don't JSON a
             # potentially multi-MB span buffer for nothing
-            _config.set("profiling_enabled", bool(req.enabled))
+            if req.set_enabled:
+                _config.set("profiling_enabled", bool(req.enabled))
+            if req.set_tracing:
+                if req.tracing:
+                    observability.enable()
+                else:
+                    observability.disable()
             ctx.reply(pb.TimelineReply(
                 spans_json=b"[]").SerializeToString())
             return
@@ -3068,6 +3133,24 @@ class DistributedRuntime(Runtime):
                     timeout=10)
             except Exception as e:
                 logger.debug("timeline toggle push failed: %s", e)
+
+    def set_cluster_tracing(self, enabled: bool) -> None:
+        """Flip trace-context propagation on the driver AND every alive
+        daemon (implies span recording: tracing without a ring to land
+        spans in would be pure overhead)."""
+        if enabled:
+            observability.enable()
+        else:
+            observability.disable()
+        for addr in self._alive_daemon_addrs():
+            try:
+                self.pool.get(addr).call(
+                    pb.GET_TIMELINE, pb.TimelineRequest(
+                        set_tracing=True,
+                        tracing=bool(enabled)).SerializeToString(),
+                    timeout=10)
+            except Exception as e:
+                logger.debug("tracing toggle push failed: %s", e)
 
     def cluster_timeline(self) -> list:
         """Local spans + every alive daemon's (distinct pids per node)."""

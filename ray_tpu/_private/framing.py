@@ -35,9 +35,14 @@ FRAME_MAGIC = b"RTF5"
 _PAD = bytes(64)  # alignment gaps are always < 64 bytes
 
 
-def frame_layout(header_len: int, buf_lens: List[int]):
+def frame_layout(header_len: int, buf_lens: List[int], trace: bytes = b""):
+    """Frame geometry. ``trace`` is an optional provenance blob appended
+    to the index (after the fixed ``header_len, nbuf, buf_lens`` part) —
+    decoders detect it as ``idx_len > 8 + 8 * nbuf``. An empty trace keeps
+    the frame byte-identical to the pre-trace format, which the checkpoint
+    engine's content-addressed dedup relies on."""
     idx = _struct.pack(f">II{len(buf_lens)}Q", header_len, len(buf_lens),
-                       *buf_lens)
+                       *buf_lens) + trace
     header_off = 4 + 4 + len(idx)
     off = (header_off + header_len + 63) & ~63
     buf_offs = []
@@ -61,11 +66,11 @@ def _pickle_oob(value: Any):
     return header, raws
 
 
-def dumps_framed(value: Any) -> bytearray:
+def dumps_framed(value: Any, trace: bytes = b"") -> bytearray:
     """Serialize into one framed payload (single copy per array)."""
     header, raws = _pickle_oob(value)
     total, hoff, boffs, idx = frame_layout(len(header),
-                                           [r.nbytes for r in raws])
+                                           [r.nbytes for r in raws], trace)
     out = bytearray(total)
     out[0:4] = FRAME_MAGIC
     out[4:8] = _struct.pack(">I", len(idx))
@@ -91,10 +96,35 @@ def loads_framed(view) -> Tuple[Any, bool]:
     (idx_len,) = _struct.unpack(">I", mv[4:8])
     header_len, nbuf = _struct.unpack_from(">II", mv, 8)
     buf_lens = list(_struct.unpack_from(f">{nbuf}Q", mv, 16))
-    _, hoff, boffs, _ = frame_layout(header_len, buf_lens)
+    # Offsets from idx_len directly, so frames with a trailing trace blob
+    # in the index (idx_len > 8 + 8*nbuf) decode identically.
+    hoff = 8 + idx_len
+    off = (hoff + header_len + 63) & ~63
+    boffs = []
+    for ln in buf_lens:
+        boffs.append(off)
+        off = (off + ln + 63) & ~63
     header = bytes(mv[hoff:hoff + header_len])
     buffers = [mv[off:off + ln] for off, ln in zip(boffs, buf_lens)]
     return pickle.loads(header, buffers=buffers), nbuf > 0
+
+
+def frame_trace(view) -> str:
+    """The provenance blob embedded in a frame's index, decoded to str
+    (``"trace_id:span_id"``), or ``""`` when absent / not an RTF5 frame.
+    Reads only the fixed-size prefix — never decodes the payload."""
+    mv = memoryview(view)
+    if len(mv) < 16 or bytes(mv[:4]) != FRAME_MAGIC:
+        return ""
+    (idx_len,) = _struct.unpack(">I", mv[4:8])
+    (nbuf,) = _struct.unpack_from(">I", mv, 12)
+    base = 8 + 8 * nbuf
+    if idx_len <= base:
+        return ""
+    try:
+        return bytes(mv[8 + base:8 + idx_len]).decode("ascii")
+    except UnicodeDecodeError:
+        return ""
 
 
 class FramedPayload:
@@ -109,12 +139,14 @@ class FramedPayload:
     from the store mid-transfer.
     """
 
-    __slots__ = ("_segments", "_total")
+    __slots__ = ("_segments", "_total", "trace")
 
-    def __init__(self, value: Any):
+    def __init__(self, value: Any, trace: bytes = b""):
         header, raws = _pickle_oob(value)
         total, hoff, boffs, idx = frame_layout(len(header),
-                                               [r.nbytes for r in raws])
+                                               [r.nbytes for r in raws],
+                                               trace)
+        self.trace = trace
         prefix = bytearray(hoff + len(header))
         prefix[0:4] = FRAME_MAGIC
         prefix[4:8] = _struct.pack(">I", len(idx))

@@ -5,6 +5,10 @@ The per-daemon half of the dashboard's log viewer (reference:
 runtime's workers are threads of one daemon process, so the daemon keeps
 its own recent log lines in memory and serves them over the NODE_DEBUG
 RPC — no log-directory contract needed).
+
+Each stored line carries the trace id that was active when it was
+emitted (empty when tracing is off), so a NODE_DEBUG tail can be
+filtered down to the log lines of ONE distributed trace.
 """
 
 from __future__ import annotations
@@ -17,8 +21,19 @@ from typing import List, Optional
 _FMT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
 
 
+def _active_trace_id() -> str:
+    # Lazy import: log_ring installs very early; observability's one-bool
+    # fast path keeps this near-free when tracing is off.
+    try:
+        from ray_tpu import observability
+        return observability.current_trace_id()
+    except Exception:  # raylint: allow(swallow) cannot log from inside the log handler
+        return ""
+
+
 class RingLogHandler(logging.Handler):
-    """Keeps the last ``capacity`` formatted log lines."""
+    """Keeps the last ``capacity`` formatted log lines as
+    ``(line, trace_id)`` pairs."""
 
     def __init__(self, capacity: int = 2000):
         super().__init__()
@@ -29,15 +44,21 @@ class RingLogHandler(logging.Handler):
     def emit(self, record: logging.LogRecord):
         try:
             line = self.format(record)
+            tid = _active_trace_id()
+            if tid:
+                line = f"{line} trace_id={tid}"
         except Exception:  # noqa: BLE001  # raylint: allow(swallow) cannot log from inside the log handler
             return
         with self._lock2:
-            self._ring.append(line)
+            self._ring.append((line, tid))
 
-    def tail(self, n: int) -> List[str]:
+    def tail(self, n: int, trace_id: str = "") -> List[str]:
         with self._lock2:
             items = list(self._ring)
-        return items[-n:] if n > 0 else []
+        if trace_id:
+            items = [it for it in items if it[1] == trace_id]
+        lines = [it[0] for it in items]
+        return lines[-n:] if n > 0 else []
 
 
 _handler: Optional[RingLogHandler] = None
@@ -54,5 +75,5 @@ def install(capacity: int = 2000) -> RingLogHandler:
         return _handler
 
 
-def tail(n: int) -> List[str]:
-    return _handler.tail(n) if _handler is not None else []
+def tail(n: int, trace_id: str = "") -> List[str]:
+    return _handler.tail(n, trace_id=trace_id) if _handler is not None else []

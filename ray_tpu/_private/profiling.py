@@ -14,25 +14,41 @@ XLA trace (HLO timings, HBM usage) lands next to the host spans.
 
 from __future__ import annotations
 
+import collections
 import json
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Deque, Dict, List, Optional
 
 from ray_tpu._private.config import _config
 
 
 class Profiler:
-    """Bounded in-memory span buffer. Thread-safe, cheap when disabled."""
+    """Bounded in-memory span ring. Thread-safe, cheap when disabled.
 
-    def __init__(self, max_spans: int = 200_000):
+    Eviction is drop-oldest (a true ring): when the buffer is full the
+    oldest span falls off and ``dropped`` is bumped, so the tail of the
+    timeline — the part an operator is usually debugging — is never lost
+    to a bulk eviction. ``chrome_trace``/``dump`` copy under the lock, so
+    they are safe while other threads keep recording.
+    """
+
+    def __init__(self, max_spans: Optional[int] = None):
         self._lock = threading.Lock()
-        self._spans: List[dict] = []
+        if max_spans is None:
+            max_spans = int(_config.get("trace_ring_size"))
         self._max = max_spans
+        self._spans: Deque[dict] = collections.deque(maxlen=max_spans)
+        self._dropped = 0
 
     @property
     def enabled(self) -> bool:
         return bool(_config.get("profiling_enabled"))
+
+    @property
+    def dropped(self) -> int:
+        """Spans evicted by the ring since the last clear()."""
+        return self._dropped
 
     def record(self, name: str, cat: str, pid: str, start_s: float,
                dur_s: float, args: Optional[Dict[str, Any]] = None):
@@ -49,10 +65,36 @@ class Profiler:
         }
         if args:
             span["args"] = args
+        self._append(span)
+
+    def instant(self, name: str, cat: str, pid: str,
+                args: Optional[Dict[str, Any]] = None,
+                ts_s: Optional[float] = None):
+        """Record a chrome instant event ("i" phase) — a point in time
+        (chaos injection, breaker flip) rather than a duration."""
+        if not self.enabled:
+            return
+        event = {
+            "name": name,
+            "cat": cat,
+            "ph": "i",
+            "s": "p",  # process-scoped instant marker
+            "pid": pid,
+            "tid": threading.current_thread().name,
+            "ts": (time.time() if ts_s is None else ts_s) * 1e6,
+        }
+        if args:
+            event["args"] = args
+        self._append(event)
+
+    def _append(self, span: dict):
         with self._lock:
+            dropped = len(self._spans) == self._max
+            if dropped:
+                self._dropped += 1
             self._spans.append(span)
-            if len(self._spans) > self._max:
-                del self._spans[: self._max // 2]
+        if dropped:  # metric bump outside the ring lock (own lock inside)
+            _spans_dropped_metric()
 
     def chrome_trace(self) -> List[dict]:
         with self._lock:
@@ -61,6 +103,21 @@ class Profiler:
     def clear(self):
         with self._lock:
             self._spans.clear()
+            self._dropped = 0
+
+
+_dropped_counter = None
+
+
+def _spans_dropped_metric():
+    # Lazy: metrics imports config; keep profiling importable standalone.
+    global _dropped_counter
+    if _dropped_counter is None:
+        from ray_tpu.util.metrics import Counter
+        _dropped_counter = Counter(
+            "profiler_spans_dropped",
+            "Spans evicted from the bounded span ring")
+    _dropped_counter.inc()
 
 
 _profiler = Profiler()
@@ -73,7 +130,8 @@ def get_profiler() -> Profiler:
 def dump_timeline(filename: Optional[str] = None) -> Any:
     """Chrome-tracing dump of recorded spans (``ray timeline``,
     ``state.py:419``). Returns the event list, or writes it to
-    ``filename`` and returns the path."""
+    ``filename`` and returns the path. Safe while recording continues:
+    the span list is snapshotted under the ring lock before writing."""
     trace = _profiler.chrome_trace()
     if filename is None:
         return trace

@@ -20,10 +20,11 @@ import os
 import socket
 import struct
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, Optional, Tuple
 
-from ray_tpu import chaos
+from ray_tpu import chaos, observability
 from ray_tpu._private.config import _config
 from ray_tpu.protocol import pb
 
@@ -53,6 +54,23 @@ class RpcConnectionError(ConnectionError):
 def _method_name(method: int) -> str:
     return (pb.Method.Name(method) if method in pb.Method.values()
             else str(method))
+
+
+_rpc_hist = None
+
+
+def _rpc_latency_hist():
+    # Lazy singleton: only paid when tracing is enabled, and metrics must
+    # not be a hard import for the wire layer.
+    global _rpc_hist
+    if _rpc_hist is None:
+        from ray_tpu.util.metrics import Histogram
+        _rpc_hist = Histogram(
+            "rpc_client_call_ms", "RPC round-trip latency by method (ms)",
+            boundaries=(0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0,
+                        500.0, 1000.0, 5000.0),
+            tag_keys=("method",))
+    return _rpc_hist
 
 
 class RpcRemoteError(RuntimeError):
@@ -240,6 +258,12 @@ class RpcClient:
             seq = self._seq
             self._pending[seq] = pending
         env = pb.Envelope(seq=seq, method=method, body=body)
+        t0 = 0.0
+        if observability.ENABLED:
+            tctx = observability.wire_context()
+            if tctx:
+                env.trace = tctx
+            t0 = time.monotonic()
         try:
             self._send(env, raw=raw)
             if not pending.event.wait(timeout):
@@ -254,6 +278,10 @@ class RpcClient:
                 f"connection to {self.address} lost mid-call: {self._close_exc}")
         if reply.error:
             raise RpcRemoteError(reply.error)
+        if t0:
+            _rpc_latency_hist().observe(
+                (time.monotonic() - t0) * 1e3,
+                tags={"method": _method_name(method)})
         return reply
 
     def call_async(self, method: int, body: bytes,
@@ -265,6 +293,17 @@ class RpcClient:
         ``raw_sink`` as in :meth:`call` — filled before the callback.
         ``raw``: bulk-lane payload (one bytes-like or a gather list)
         shipped with the request, no protobuf copy."""
+        tctx = ""
+        if observability.ENABLED:
+            tctx = observability.wire_context()
+            _t0, _cb = time.monotonic(), callback
+
+            def callback(env, error, _cb=_cb, _t0=_t0, _m=method):
+                _rpc_latency_hist().observe(
+                    (time.monotonic() - _t0) * 1e3,
+                    tags={"method": _method_name(_m)})
+                _cb(env, error)
+
         pending = _Pending()
         pending.callback = callback  # type: ignore[attr-defined]
         pending.raw_sink = raw_sink
@@ -276,9 +315,11 @@ class RpcClient:
             self._seq += 1
             seq = self._seq
             self._pending[seq] = pending
+        env = pb.Envelope(seq=seq, method=method, body=body)
+        if tctx:
+            env.trace = tctx
         try:
-            self._send(pb.Envelope(seq=seq, method=method, body=body),
-                       raw=raw)
+            self._send(env, raw=raw)
         except Exception as e:
             with self._plock:
                 self._pending.pop(seq, None)
@@ -309,10 +350,13 @@ class RpcClient:
                 self._pending[self._seq] = pending
                 pendings.append(self._seq)
         # Tiny control bodies: one contiguous buffer beats a long iovec.
+        tctx = observability.wire_context() if observability.ENABLED else ""
         buf = bytearray()
         for seq, (method, body) in zip(pendings, items):
-            payload = pb.Envelope(seq=seq, method=method,
-                                  body=body).SerializeToString()
+            env = pb.Envelope(seq=seq, method=method, body=body)
+            if tctx:
+                env.trace = tctx
+            payload = env.SerializeToString()
             buf += _LEN.pack(len(payload))
             buf += payload
         try:
@@ -321,7 +365,12 @@ class RpcClient:
             self.fail_pending(pendings, e)
 
     def send_oneway(self, method: int, body: bytes = b"") -> None:
-        self._send(pb.Envelope(seq=0, method=method, body=body))
+        env = pb.Envelope(seq=0, method=method, body=body)
+        if observability.ENABLED:
+            tctx = observability.wire_context()
+            if tctx:
+                env.trace = tctx
+        self._send(env)
 
     def allocate_pending(self, callback) -> int:
         """Reserve a reply seq with a callback but send NOTHING — the
@@ -508,6 +557,7 @@ class RpcContext:
         self.method = env.method
         self.seq = env.seq
         self.body = env.body
+        self.trace = env.trace  # caller's "trace_id:span_id", or ""
         self.raw = None  # bulk-lane bytes of the REQUEST, if any
         self.peer = None  # set by server
         self._done = False
@@ -526,6 +576,7 @@ class RpcContext:
         env = pb.Envelope(seq=seq, method=method, body=body)
         ctx = RpcContext(None, self._sock, self._wlock, env)
         ctx.conn_id = getattr(self, "conn_id", None)
+        ctx.trace = self.trace  # batch items inherit the batch's context
         return ctx
 
     def reply_error(self, message: str):
@@ -694,13 +745,26 @@ class RpcServer:
                     logger.exception("on_disconnect failed")
 
     def _run_handler(self, ctx: RpcContext):
+        # Adopt the caller's trace context around dispatch so spans the
+        # handler opens (fetch, task execute, ...) join the caller's tree.
+        token = None
+        if observability.ENABLED and ctx.trace:
+            token = observability.adopt_wire(ctx.trace)
         try:
-            self._handler(ctx)
+            if token is not None:
+                with observability.span(f"rpc:{_method_name(ctx.method)}",
+                                        cat="rpc"):
+                    self._handler(ctx)
+            else:
+                self._handler(ctx)
         except Exception as e:  # noqa: BLE001 — report to caller
             logger.exception("rpc handler error for %s",
                              pb.Method.Name(ctx.method)
                              if ctx.method in pb.Method.values() else ctx.method)
             ctx.reply_error(f"{type(e).__name__}: {e}")
+        finally:
+            if token is not None:
+                observability.reset(token)
 
 
 class ConnectionPool:

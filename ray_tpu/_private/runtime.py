@@ -46,7 +46,7 @@ import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from ray_tpu import chaos
+from ray_tpu import chaos, observability
 from ray_tpu import exceptions as exc
 from ray_tpu._private.backoff import BackoffPolicy
 from ray_tpu._private.config import _config
@@ -88,6 +88,24 @@ import contextvars  # noqa: E402
 
 _trace_var: "contextvars.ContextVar" = contextvars.ContextVar(
     "ray_tpu_trace", default=None)  # (trace_id, span_id) | None
+
+
+def _obs_context_provider():
+    """Expose the executing task's trace context to the observability
+    layer, so a span opened anywhere inside a task body (object fetch,
+    checkpoint write, user span) parents under the task's span without
+    importing runtime state from observability (that import would be a
+    cycle)."""
+    async_ctx = _trace_var.get()
+    if async_ctx:
+        return async_ctx
+    ctx = task_context
+    if ctx.trace_id:
+        return (ctx.trace_id, ctx.span_id or "")
+    return None
+
+
+observability.register_context_provider(_obs_context_provider)
 
 
 class Node:
@@ -518,8 +536,13 @@ class Runtime:
         elif ctx.trace_id:
             spec.trace_id = ctx.trace_id
             spec.parent_span_id = ctx.span_id
-        elif _prof().enabled:
-            spec.trace_id = os.urandom(8).hex()
+        else:
+            obs_ctx = (observability.current()
+                       if observability.ENABLED else None)
+            if obs_ctx:  # explicit span (serve request, user span(...))
+                spec.trace_id, spec.parent_span_id = obs_ctx
+            elif _prof().enabled:
+                spec.trace_id = os.urandom(8).hex()
 
     def submit_task(self, spec: TaskSpec) -> List[ObjectID]:
         self._attach_trace(spec)

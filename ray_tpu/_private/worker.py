@@ -329,6 +329,21 @@ def set_profiling_enabled(enabled: bool) -> None:
     _config.set("profiling_enabled", bool(enabled))
 
 
+def set_tracing_enabled(enabled: bool) -> None:
+    """Switch end-to-end trace-context propagation on/off — cluster-wide
+    when connected (daemons adopt it via the timeline control RPC)."""
+    from ray_tpu import observability
+    rt = try_global_runtime()
+    cluster_set = getattr(rt, "set_cluster_tracing", None)
+    if cluster_set is not None:
+        cluster_set(enabled)
+        return
+    if enabled:
+        observability.enable()
+    else:
+        observability.disable()
+
+
 def register_named_function(name: str, fn=None):
     """Publish a function for cross-language callers (the C++ worker API
     submits by name with JSON args). Usable as a decorator::

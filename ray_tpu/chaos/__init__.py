@@ -55,7 +55,7 @@ from ray_tpu.chaos.engine import (ChaosConnectionReset, ChaosError,
 __all__ = [
     "ENABLED", "ChaosError", "ChaosConnectionReset", "FaultRule",
     "FaultSchedule", "parse_spec", "parse_env", "configure", "install",
-    "clear", "inject", "schedule", "trace_lines", "trace_text",
+    "clear", "inject", "schedule", "set_observer", "trace_lines", "trace_text",
 ]
 
 logger = logging.getLogger("ray_tpu")
@@ -65,6 +65,17 @@ logger = logging.getLogger("ray_tpu")
 ENABLED = False
 
 _schedule: Optional[FaultSchedule] = None
+
+#: Optional fault observer installed by ray_tpu.observability.enable():
+#: called as fn(point, labels, action) after every fault that fires (the
+#: action name, or the exception class name for raising actions). Kept as
+#: a registration hook — chaos stays importable with zero non-stdlib deps.
+_observer = None
+
+
+def set_observer(fn) -> None:
+    global _observer
+    _observer = fn
 
 
 def install(sched: FaultSchedule) -> FaultSchedule:
@@ -102,7 +113,17 @@ def inject(point: str, **labels) -> Optional[str]:
     sched = _schedule
     if sched is None:
         return None
-    return sched.fire(point, labels)
+    obs = _observer
+    if obs is None:
+        return sched.fire(point, labels)
+    try:
+        action = sched.fire(point, labels)
+    except BaseException as e:
+        obs(point, labels, type(e).__name__)
+        raise
+    if action is not None:
+        obs(point, labels, action)
+    return action
 
 
 def trace_lines():

@@ -51,7 +51,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ray_tpu import chaos
+from ray_tpu import chaos, observability
 from ray_tpu._private.config import _config
 from ray_tpu._private.framing import FramedPayload, dumps_framed, loads_framed
 from ray_tpu.checkpoint import manifest as mf
@@ -176,6 +176,9 @@ class _SaveJob:
     mesh: Optional[Dict[str, Any]]
     meta: Dict[str, Any]
     save_key: str
+    # (trace_id, span_id) captured at save(): the writer thread adopts it
+    # so hash/write/gather/commit child spans join the caller's trace
+    trace: Tuple[str, str] = ("", "")
 
 
 class CheckpointEngine:
@@ -228,6 +231,11 @@ class CheckpointEngine:
         arrays: List[Tuple[str, np.ndarray]] = []
         skeleton = _extract_arrays(tree, (), arrays)
         handle = SaveHandle(step, rank)
+        trace: Tuple[str, str] = ("", "")
+        if observability.ENABLED:
+            # checkpoint save is a trace entry point: join the caller's
+            # trace when one is active, mint a fresh one otherwise
+            trace = observability.current() or (observability.mint_id(), "")
         job = _SaveJob(
             handle=handle,
             skeleton_frame=bytes(dumps_framed(skeleton)),
@@ -236,7 +244,8 @@ class CheckpointEngine:
             shard_paths=(None if shard_paths is None
                          else tuple(str(p) for p in shard_paths)),
             mesh=mesh, meta=dict(meta or {}),
-            save_key=save_key or f"step-{step:08d}")
+            save_key=save_key or f"step-{step:08d}",
+            trace=trace)
         self._ensure_writer()
         with self._writer_lock:
             self._inflight.append(handle)
@@ -302,12 +311,27 @@ class CheckpointEngine:
         self.stats.chunk_bytes_written += nbytes
 
     def _process(self, job: _SaveJob) -> Optional[str]:
+        # Writer thread: adopt the context captured at save() so the
+        # stage spans below land in the submitting trace.
+        token = (observability.set_current(*job.trace)
+                 if observability.ENABLED and job.trace[0] else None)
+        try:
+            with observability.span("checkpoint.save", cat="checkpoint",
+                                    step=str(job.step), rank=str(job.rank)):
+                return self._process_stages(job)
+        finally:
+            if token is not None:
+                observability.reset(token)
+
+    def _process_stages(self, job: _SaveJob) -> Optional[str]:
         self.stats.saves += 1
         protected: List[str] = []
         try:
             entries: List[ArrayEntry] = []
             for slot, (path, arr) in enumerate(job.arrays):
-                chunk_id = _hash_array(arr)
+                with observability.span("checkpoint.hash", cat="checkpoint",
+                                        path=path):
+                    chunk_id = _hash_array(arr)
                 protected.append(chunk_id)
                 self._inflight_chunks.add(chunk_id)
                 dropped = False
@@ -316,7 +340,10 @@ class CheckpointEngine:
                                            rank=str(job.rank)) == "drop"
                 if not dropped:
                     payload = FramedPayload(arr)
-                    self._write_chunk(chunk_id, payload.pieces, arr.nbytes)
+                    with observability.span("checkpoint.write",
+                                            cat="checkpoint", path=path):
+                        self._write_chunk(chunk_id, payload.pieces,
+                                          arr.nbytes)
                 # a dropped (lost) write still indexes the chunk: the
                 # committer's presence check then fails the save loudly
                 # instead of publishing a manifest missing the array
@@ -332,8 +359,10 @@ class CheckpointEngine:
             if chaos.ENABLED:
                 chaos.inject("checkpoint.write", path="<skeleton>",
                              rank=str(job.rank))
-            self._write_chunk(skel_id, [job.skeleton_frame],
-                              len(job.skeleton_frame))
+            with observability.span("checkpoint.write", cat="checkpoint",
+                                    path="<skeleton>"):
+                self._write_chunk(skel_id, [job.skeleton_frame],
+                                  len(job.skeleton_frame))
             shard = ShardIndex(rank=job.rank, skeleton=skel_id,
                                skeleton_nbytes=len(job.skeleton_frame),
                                arrays=entries)
@@ -350,7 +379,10 @@ class CheckpointEngine:
             self._inflight_chunks.difference_update(protected)
 
     def _commit(self, job: _SaveJob, pend_dir: str) -> str:
-        shards = self._gather_shards(job, pend_dir)
+        with observability.span("checkpoint.gather", cat="checkpoint",
+                                step=str(job.step),
+                                world_size=str(job.world_size)):
+            shards = self._gather_shards(job, pend_dir)
         if job.shard_axis is not None:
             _finalize_sharding(shards, job.shard_axis)
         m = Manifest(id=mf.new_manifest_id(), step=job.step,
@@ -361,14 +393,16 @@ class CheckpointEngine:
                 f"step {job.step}: chunk(s) missing at commit time "
                 "(lost or dropped write) — refusing to publish a torn "
                 "manifest")
-        if chaos.ENABLED:
-            chaos.inject("checkpoint.commit", stage="manifest",
-                         step=str(job.step))
-        name = mf.write_manifest(self.root, m)
-        if chaos.ENABLED:
-            chaos.inject("checkpoint.commit", stage="latest",
-                         step=str(job.step))
-        mf.set_latest(self.root, name)
+        with observability.span("checkpoint.commit", cat="checkpoint",
+                                step=str(job.step)):
+            if chaos.ENABLED:
+                chaos.inject("checkpoint.commit", stage="manifest",
+                             step=str(job.step))
+            name = mf.write_manifest(self.root, m)
+            if chaos.ENABLED:
+                chaos.inject("checkpoint.commit", stage="latest",
+                             step=str(job.step))
+            mf.set_latest(self.root, name)
         self.stats.commits += 1
         self._register(name)
         self._cleanup_pending(pend_dir)

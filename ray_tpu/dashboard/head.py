@@ -14,11 +14,22 @@ Endpoints:
   /api/pgs          — placement groups
   /api/jobs         — job table
   /api/stats        — state-service counters
-  /api/node_debug?node=X&lines=N&tasks=1
+  /api/node_debug?node=X&lines=N&tasks=1&trace=T
                     — per-daemon log tail + local task rows, fetched
                       live from the daemon over NODE_DEBUG (the log
                       viewer / task drill-down the reference serves via
-                      dashboard/modules/log/log_agent.py)
+                      dashboard/modules/log/log_agent.py); ``trace=T``
+                      filters the log tail to one trace id
+  /api/timeline     — merged chrome://tracing timeline: every alive
+                      daemon's span ring (GET_TIMELINE fan-out) plus the
+                      head's own, distinct pids per host
+  /api/trace?id=X   — one distributed trace's spans + instant events,
+                      filtered out of the merged timeline
+  /api/metrics      — per-host metric snapshots (NODE_DEBUG
+                      include_metrics fan-out), JSON keyed by node
+  /metrics          — the same federation rendered as one cluster-wide
+                      Prometheus exposition, each sample labeled with
+                      its source node
 """
 
 from __future__ import annotations
@@ -193,7 +204,7 @@ class DashboardHead:
         return {"error": f"actor {actor_id_hex} not found"}
 
     def _node_debug(self, node_id_hex: str, lines: int,
-                    include_tasks: bool) -> dict:
+                    include_tasks: bool, trace_filter: str = "") -> dict:
         from ray_tpu.protocol import pb
         addr = next((n.address for n in self.state.list_nodes()
                      if n.node_id.hex() == node_id_hex and n.alive), None)
@@ -204,12 +215,68 @@ class DashboardHead:
         rep.ParseFromString(client.call(
             pb.NODE_DEBUG, pb.NodeDebugRequest(
                 log_lines=lines,
-                include_tasks=include_tasks).SerializeToString(),
+                include_tasks=include_tasks,
+                trace_filter=trace_filter).SerializeToString(),
             timeout=15).body)
         out = json.loads(bytes(rep.payload_json).decode())
         out["node_id"] = node_id_hex
         out["address"] = addr
         return out
+
+    # -- tracing / metrics federation ------------------------------------
+    def _alive_addrs(self) -> list:
+        return [(n.node_id.hex(), n.address)
+                for n in self.state.list_nodes() if n.alive and n.address]
+
+    def _timeline(self) -> list:
+        """One merged chrome://tracing event list: the head's own span
+        ring plus every alive daemon's, pulled over GET_TIMELINE. Hosts
+        keep distinct ``pid`` labels so the merged view separates them."""
+        from ray_tpu.protocol import pb
+        from ray_tpu._private.profiling import get_profiler
+        events = list(get_profiler().chrome_trace())
+        for nid, addr in self._alive_addrs():
+            try:
+                rep = pb.TimelineReply()
+                rep.ParseFromString(self.pool.get(addr).call(
+                    pb.GET_TIMELINE,
+                    pb.TimelineRequest().SerializeToString(),
+                    timeout=30).body)
+                events.extend(json.loads(bytes(rep.spans_json).decode()))
+            except Exception as e:
+                logger.debug("dashboard: timeline fetch from %s failed: %s",
+                             addr, e)
+        return events
+
+    def _trace(self, trace_id: str) -> dict:
+        from ray_tpu import observability
+        if not trace_id:
+            return {"error": "missing ?id=<trace_id>"}
+        events = observability.spans_for_trace(trace_id, self._timeline())
+        events.sort(key=lambda e: e.get("ts", 0))
+        return {"trace_id": trace_id, "num_events": len(events),
+                "events": events}
+
+    def _metric_snapshots(self) -> dict:
+        """{node_label: metrics.snapshot()} across the cluster — the
+        head's own registry plus each alive daemon's via NODE_DEBUG."""
+        from ray_tpu.protocol import pb
+        from ray_tpu.util import metrics as _metrics
+        snaps = {"head": _metrics.snapshot()}
+        for nid, addr in self._alive_addrs():
+            try:
+                rep = pb.NodeDebugReply()
+                rep.ParseFromString(self.pool.get(addr).call(
+                    pb.NODE_DEBUG, pb.NodeDebugRequest(
+                        log_lines=0, include_tasks=False,
+                        include_metrics=True).SerializeToString(),
+                    timeout=15).body)
+                payload = json.loads(bytes(rep.payload_json).decode())
+                snaps[f"node:{nid[:8]}"] = payload.get("metrics") or []
+            except Exception as e:
+                logger.debug("dashboard: metrics fetch from %s failed: %s",
+                             addr, e)
+        return snaps
 
     # -- server ----------------------------------------------------------
     def start(self) -> int:
@@ -252,7 +319,19 @@ class DashboardHead:
                         self._json(head._node_debug(
                             q.get("node", [""])[0],
                             int(q.get("lines", ["200"])[0]),
-                            q.get("tasks", ["1"])[0] not in ("0", "")))
+                            q.get("tasks", ["1"])[0] not in ("0", ""),
+                            q.get("trace", [""])[0]))
+                    elif route == "/api/timeline":
+                        self._json(head._timeline())
+                    elif route == "/api/trace":
+                        self._json(head._trace(q.get("id", [""])[0]))
+                    elif route == "/api/metrics":
+                        self._json(head._metric_snapshots())
+                    elif route == "/metrics":
+                        from ray_tpu.util.metrics import render_federated
+                        self._send(
+                            render_federated(head._metric_snapshots())
+                            .encode(), "text/plain; version=0.0.4")
                     else:
                         self._json({"error": "not found"}, 404)
                 except Exception as e:  # noqa: BLE001

@@ -75,6 +75,10 @@ R13  config-drift   every config knob must be read somewhere and every
                     ``_config.<name>`` read must be defined; same
                     closure for chaos points declared in the runtime
                     vs. exercised by ``tests/``
+R14  span-leak      ``observability.span(...)`` used outside a ``with``
+                    statement (outside the observability package): a
+                    span not closed on every exit path leaks its
+                    context var and never records
 ==== ============== ====================================================
 
 R10-R12 run on the whole-program call graph built by
@@ -1389,6 +1393,63 @@ def check_config_drift(ctxs: List[FileContext], _engine) -> Iterator[Finding]:
                 f"test chaos spec references injection point '{point}' "
                 f"which no runtime inject() declares — the test runs "
                 f"fault-free")
+
+
+# --------------------------------------------------------------------------
+# R14: observability spans must be context-managed (closed on every path)
+
+_OBS_MODULE = "ray_tpu.observability"
+
+
+def _is_obs_span_call(node: ast.Call, ctx: FileContext) -> bool:
+    """True when *node* calls ``ray_tpu.observability``'s ``span``."""
+    dn = _dotted(node.func)
+    if dn is None:
+        return False
+    if dn == "span":
+        origin = ctx.import_origin.get("span", "")
+        return origin == _OBS_MODULE + ".span" or \
+            ctx.from_imports.get("span", "") == _OBS_MODULE
+    if not dn.endswith(".span"):
+        return False
+    head = dn.split(".")[0]
+    origin = ctx.import_origin.get(head, "")
+    return origin == _OBS_MODULE or \
+        origin + "." + dn.split(".", 1)[1] == _OBS_MODULE + ".span" or \
+        dn == _OBS_MODULE + ".span"
+
+
+@rule("R14", "span-leak")
+def check_span_leak(ctx: FileContext) -> Iterator[Finding]:
+    """``observability.span(...)`` is context-manager-only outside the
+    observability package: constructed bare (bound to a name, passed
+    around, or ``__enter__``-ed by hand) there is an exit path — an
+    exception between enter and exit — on which the span never records
+    and its context var never resets, silently re-parenting every later
+    span in that thread.  ``with observability.span(...):`` closes both
+    on every path."""
+    norm = ctx.relpath.replace("\\", "/")
+    if "observability" in norm.split("/")[:-1]:
+        return  # the package itself implements the context manager
+    with_calls: Set[int] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Call):
+                    with_calls.add(id(item.context_expr))
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or id(node) in with_calls:
+            continue
+        if not _is_obs_span_call(node, ctx):
+            continue
+        if ctx.allowed(node.lineno, "R14", "span-leak"):
+            continue
+        yield Finding(
+            "R14", "span-leak", ctx.relpath, node.lineno,
+            "observability.span(...) outside a 'with' statement: the span "
+            "is not closed on every exit path (leaked context var, span "
+            "never recorded) — use 'with observability.span(...):', or "
+            "justify with '# raylint: allow(span-leak) <why>'")
 
 
 # --------------------------------------------------------------------------

@@ -35,6 +35,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
+from ray_tpu import observability
 from ray_tpu.serve._private.long_poll import LongPollClient
 from ray_tpu.serve.controller import ROUTE_TABLE_KEY
 from ray_tpu.serve.handle import DeploymentHandle
@@ -112,24 +113,30 @@ class HTTPProxy:
                                               "requests"})
                     return
                 try:
-                    name = proxy._match(path)
-                    if name is None:
-                        self._json(404, {"error": "no route"})
-                        return
-                    arg = None
-                    if body:
-                        try:
-                            arg = json.loads(body)
-                        except json.JSONDecodeError:
-                            arg = body
-                    handle = proxy._get_handle(name)
-                    result = handle.remote(arg).result(
-                        timeout=proxy._timeout_s)
-                    if (isinstance(result, (list, tuple))
-                            and self.headers.get("X-Serve-Stream")):
-                        self._stream(result)
-                        return
-                    self._send_value(result)
+                    # Serve request = trace entry point: the span below
+                    # mints a trace_id (no enclosing context in a proxy
+                    # thread), and the replica task submitted by
+                    # handle.remote() inherits it via TaskSpec.
+                    with observability.span("serve.request", cat="serve",
+                                            route=path):
+                        name = proxy._match(path)
+                        if name is None:
+                            self._json(404, {"error": "no route"})
+                            return
+                        arg = None
+                        if body:
+                            try:
+                                arg = json.loads(body)
+                            except json.JSONDecodeError:
+                                arg = body
+                        handle = proxy._get_handle(name)
+                        result = handle.remote(arg).result(
+                            timeout=proxy._timeout_s)
+                        if (isinstance(result, (list, tuple))
+                                and self.headers.get("X-Serve-Stream")):
+                            self._stream(result)
+                            return
+                        self._send_value(result)
                 except Exception as e:  # noqa: BLE001 - surface to caller
                     if getattr(self, "_headers_sent", False):
                         # Mid-stream failure: a second status line would

@@ -178,6 +178,43 @@ def generate_prometheus_text() -> str:
     return "\n".join(lines) + "\n"
 
 
+def snapshot() -> List[dict]:
+    """JSON-able dump of every registered metric — the per-host half of
+    cluster metrics federation (shipped in NODE_DEBUG replies and merged
+    by the dashboard head into one exposition)."""
+    out = []
+    for m in _registry.metrics():
+        out.append({
+            "name": m.name,
+            "type": m.TYPE,
+            "help": m.description,
+            "samples": [[name, list(map(list, tags)), value]
+                        for name, tags, value in m.samples()],
+        })
+    return out
+
+
+def render_federated(snapshots: Dict[str, List[dict]]) -> str:
+    """Prometheus text for many hosts' :func:`snapshot` dumps, each
+    sample labeled with its source ``node`` — the cluster-wide exposition
+    endpoint (one scrape covers every host, the reference's per-node
+    metrics agents rolled up by the dashboard)."""
+    lines = []
+    typed = set()
+    for node, families in snapshots.items():
+        for fam in families:
+            if fam["name"] not in typed:
+                typed.add(fam["name"])
+                if fam.get("help"):
+                    lines.append(f"# HELP {fam['name']} {fam['help']}")
+                lines.append(f"# TYPE {fam['name']} {fam['type']}")
+            for name, tags, value in fam["samples"]:
+                merged = (("node", node),) + tuple(
+                    (k, v) for k, v in tags)
+                lines.append(f"{name}{_fmt_tags(merged)} {value}")
+    return "\n".join(lines) + "\n"
+
+
 _server = None
 
 

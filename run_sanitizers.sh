@@ -57,7 +57,7 @@ echo "== chaos gate (core suite under a fixed delay-only fault schedule) =="
 RAY_TPU_CHAOS="20260805:rpc.client.send@3%7=delay(0.02);state.heartbeat@2%3=delay(0.05);object.push@2%5=delay(0.01);checkpoint.write@2%4=delay(0.01)" \
 JAX_PLATFORMS=cpu \
 python -m pytest tests/test_core.py tests/test_actors.py tests/test_data_plane.py \
-    tests/test_checkpoint.py -q
+    tests/test_checkpoint.py tests/test_tracing.py -q
 
 echo "== bench regression gate (bench_micro --check vs tracked baseline) =="
 # Throughput must stay within --tolerance of BENCH_MICRO.json; latency

@@ -1,0 +1,241 @@
+"""End-to-end distributed tracing (ray_tpu.observability).
+
+Acceptance path for the tracing plane: one trace_id minted at the driver
+must stitch task submit, worker-side execution in OTHER processes, the
+cross-daemon object fetch that moved the producer's array, and the
+checkpoint engine's write/commit (recorded on its writer thread) — with
+chaos injections interleaved as instant events tagged with the same
+trace. Reference role: ``python/ray/tests/test_tracing.py`` over the
+OpenTelemetry ``tracing_helper.py`` hooks.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import chaos, observability
+from ray_tpu._private.config import _config
+from ray_tpu._private.profiling import get_profiler
+
+
+@pytest.fixture(autouse=True)
+def _tracing_hygiene():
+    """Tracing/chaos/profiling are process-global switches: always restore
+    them so a failing assertion here cannot poison later test files."""
+    yield
+    chaos.clear()
+    observability.disable()
+    _config.set("profiling_enabled", False)
+    get_profiler().clear()
+
+
+def _with_trace(events, name_suffix, trace_id):
+    return [e for e in events if e.get("name", "").endswith(name_suffix)
+            and (e.get("args") or {}).get("trace_id") == trace_id]
+
+
+def _require_state_service():
+    """ProcessCluster needs the C++ state service (protoc + g++)."""
+    from ray_tpu._native.build import build_state_service
+    try:
+        build_state_service()
+    except Exception as e:
+        pytest.skip(f"state service unavailable: {e}")
+
+
+def test_one_trace_spans_submit_execute_fetch_and_checkpoint(tmp_path):
+    """The headline guarantee: a single trace_id covers the driver's
+    submit span, execute spans in two different daemon processes, the
+    object.fetch that pulled the producer's array into the consumer's
+    daemon, and the checkpoint save/write/commit stages — and a chaos
+    fault fired mid-save appears as an instant event inside that trace."""
+    from ray_tpu.checkpoint import CheckpointEngine
+    from ray_tpu.cluster_utils import ProcessCluster
+    _require_state_service()
+    ray_tpu.shutdown()
+    # Distinct custom resources pin producer and consumer to DIFFERENT
+    # daemons, forcing a cross-process fetch of the argument object.
+    c = ProcessCluster(num_daemons=1, num_cpus=2, resources={"src": 2})
+    try:
+        c.add_daemon(resources={"dst": 2})
+        ray_tpu.init(address=c.address)
+        ray_tpu.set_profiling_enabled(True)
+        ray_tpu.set_tracing_enabled(True)
+        # Driver-local fault schedule: first checkpoint chunk write is
+        # delayed — harmless, but it must surface as a chaos instant
+        # event INSIDE the submitting trace.
+        chaos.configure(20260805, "checkpoint.write@1=delay(0.001)")
+
+        @ray_tpu.remote(resources={"src": 1})
+        def produce():
+            return np.arange(1 << 18, dtype=np.int64)
+
+        @ray_tpu.remote(resources={"dst": 1})
+        def consume(arr):
+            return int(arr[-1])
+
+        eng = CheckpointEngine(str(tmp_path / "ckpt"))
+        try:
+            with observability.span("client.submit", cat="driver") as s:
+                tid = s.trace_id
+                ref = produce.remote()
+                assert ray_tpu.get(consume.remote(ref),
+                                   timeout=60) == (1 << 18) - 1
+                eng.save({"w": np.ones((64, 64), np.float32)},
+                         step=1, wait=True)
+        finally:
+            eng.close()
+        assert tid
+
+        trace = ray_tpu.timeline()
+        produces = _with_trace(trace, "produce", tid)
+        consumes = _with_trace(trace, "consume", tid)
+        assert len(produces) == 1 and len(consumes) == 1, (
+            [e.get("name") for e in trace][:20])
+        # ... and they really ran in two different daemon processes
+        assert all(e["pid"].startswith("node:")
+                   for e in produces + consumes)
+        assert produces[0]["pid"] != consumes[0]["pid"]
+
+        # the consumer's daemon pulled the argument from the producer's
+        # daemon; that fetch is attributed to the same trace
+        fetches = _with_trace(trace, "object.fetch", tid)
+        assert fetches, [e.get("name") for e in trace][:30]
+        assert any(e["pid"].startswith("node:") for e in fetches)
+
+        # checkpoint stage spans adopt the submitting trace across the
+        # engine's writer thread
+        for stage in ("checkpoint.save", "checkpoint.write",
+                      "checkpoint.commit"):
+            assert _with_trace(trace, stage, tid), stage
+
+        # the injected fault is an instant event inside the same trace
+        chaos_events = [e for e in trace
+                        if e.get("name") == "chaos:checkpoint.write"]
+        assert chaos_events
+        for e in chaos_events:
+            assert e["ph"] == "i"
+            assert e["args"]["trace_id"] == tid
+            assert e["args"]["action"] == "delay"
+
+        # drill-down helper: filtering the merged timeline by trace_id
+        # returns exactly the spans asserted above (the /api/trace path)
+        only = observability.spans_for_trace(tid, trace)
+        assert len(only) >= 6
+        assert all(e["args"]["trace_id"] == tid for e in only)
+
+        ray_tpu.set_tracing_enabled(False)
+        ray_tpu.set_profiling_enabled(False)
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+def test_chaos_retry_spans_share_parent_trace():
+    """Retries under an ambient span stay in its trace: each attempt span
+    is a child of the same parent, the failed attempt records its error,
+    and the chaos fault that forced the retry lands as an instant event
+    parented under the attempt it broke."""
+    from ray_tpu._private.backoff import BackoffPolicy, retry_call
+    get_profiler().clear()
+    _config.set("profiling_enabled", True)
+    observability.enable()
+    # one-shot fault: first call to the point errors, the retry succeeds
+    chaos.configure(7, "test.retry.op@1=error(flaky)")
+    attempt_span_ids = []
+
+    def op(_timeout):
+        with observability.span("retry.attempt", cat="retry") as a:
+            attempt_span_ids.append(a.span_id)
+            chaos.inject("test.retry.op")
+        return 42
+
+    with observability.span("retry.parent", cat="retry") as parent:
+        tid, parent_sid = parent.trace_id, parent.span_id
+        got = retry_call(op, BackoffPolicy(
+            base_s=0.001, max_s=0.002, max_attempts=4,
+            retryable=(chaos.ChaosError,), label="test.retry"))
+    assert got == 42
+
+    trace = get_profiler().chrome_trace()
+    attempts = [e for e in trace if e.get("name") == "retry.attempt"]
+    assert len(attempts) == 2  # failed + succeeded
+    for e in attempts:
+        assert e["args"]["trace_id"] == tid
+        assert e["args"]["parent_span_id"] == parent_sid
+    assert attempts[0]["args"]["error"] == "ChaosError"
+    assert "error" not in attempts[1]["args"]
+
+    instants = [e for e in trace if e.get("name") == "chaos:test.retry.op"]
+    assert len(instants) == 1
+    assert instants[0]["ph"] == "i"
+    assert instants[0]["args"]["trace_id"] == tid
+    assert instants[0]["args"]["parent_span_id"] == attempt_span_ids[0]
+    assert instants[0]["args"]["action"] == "ChaosError"
+
+
+def test_span_ring_drop_oldest_counts_dropped():
+    """The profiler buffer is a true ring: over-capacity recording drops
+    the OLDEST spans and counts them (surfaced as a metric), instead of
+    silently refusing new ones."""
+    from ray_tpu._private.profiling import Profiler
+    prof = Profiler(max_spans=4)
+    _config.set("profiling_enabled", True)
+    for i in range(7):
+        prof.record(f"s{i}", "t", pid="p", start_s=float(i), dur_s=0.0)
+    names = [e["name"] for e in prof.chrome_trace()]
+    assert names == ["s3", "s4", "s5", "s6"]
+    assert prof.dropped == 3
+    prof.clear()
+    assert prof.dropped == 0
+
+
+def test_log_ring_filters_by_trace_id():
+    """Log lines emitted inside a span carry its trace_id, and a tail()
+    can be filtered down to one distributed trace (/api/node_debug's
+    ?trace=T path)."""
+    import logging
+    from ray_tpu._private.log_ring import RingLogHandler
+    _config.set("profiling_enabled", True)
+    observability.enable()
+    handler = RingLogHandler(capacity=16)
+    log = logging.getLogger("ray_tpu.test_tracing")
+    log.addHandler(handler)
+    log.setLevel(logging.INFO)
+    try:
+        log.info("before any trace")
+        with observability.span("logged.op", cat="test") as s:
+            tid = s.trace_id
+            log.info("inside the traced op")
+        log.info("after the trace")
+    finally:
+        log.removeHandler(handler)
+    all_lines = handler.tail(16)
+    assert len(all_lines) == 3
+    traced = handler.tail(16, trace_id=tid)
+    assert len(traced) == 1
+    assert "inside the traced op" in traced[0]
+    assert f"trace_id={tid}" in traced[0]
+
+
+def test_wire_context_round_trip():
+    """The 'trace_id:span_id' wire encoding survives a round trip, and
+    bad strings are rejected rather than adopted."""
+    _config.set("profiling_enabled", True)
+    observability.enable()
+    with observability.span("wire.parent", cat="test") as s:
+        tid, sid = s.trace_id, s.span_id
+        wire = observability.wire_context()
+        assert wire == f"{tid}:{sid}"
+    assert observability.parse_wire(wire) == (tid, sid)
+    assert observability.parse_wire("") is None
+    assert observability.parse_wire("no-separator") is None
+    token = observability.adopt_wire(wire)
+    try:
+        assert observability.current() == (tid, sid)
+    finally:
+        observability.reset(token)
+    observability.disable()
+    # disabled: the hot-path helpers collapse to constants
+    assert observability.wire_context() == ""
+    assert observability.current_trace_id() == ""
