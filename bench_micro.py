@@ -182,6 +182,44 @@ def bench_trace_overhead(prefix: str, n: int = 800):
          100.0 * (off_after - off_before) / off_before, "%")
 
 
+def bench_recorder_overhead(prefix: str, n: int = 800):
+    """Always-on flight recorder cost on the 1KB put/get hot path, A/B'd
+    by pausing/resuming the process-wide spool thread around identical
+    loops (the recorder cannot be uninstalled — it records the process).
+    ``_recorder_overhead_pct`` is a smaller-is-better budget: the spool
+    runs off-path at ``flight_recorder_spool_ms`` cadence, so steady
+    state must stay within a couple percent of the paused baseline."""
+    import statistics
+
+    import ray_tpu
+    from ray_tpu.observability import recorder as _flight
+    rec = _flight.get_recorder() or _flight.install("driver")
+    if rec is None:  # flight_recorder_enabled=0 in the env: nothing to A/B
+        emit(f"{prefix}_recorder_overhead_pct", 0.0, "%")
+        return
+    small = np.zeros(128, np.int64)
+
+    def put_get_us():
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ray_tpu.get(ray_tpu.put(small))
+        return (time.perf_counter() - t0) / n * 1e6
+
+    put_get_us()  # warm
+    # paired A/B: alternate paused/running back-to-back so slow machine
+    # drift cancels inside each pair instead of polluting the delta
+    pcts = []
+    for _ in range(5):
+        rec.pause()
+        try:
+            off = put_get_us()
+        finally:
+            rec.resume()
+        on = put_get_us()
+        pcts.append(100.0 * (on - off) / off)
+    emit(f"{prefix}_recorder_overhead_pct", statistics.median(pcts), "%")
+
+
 def bench_checkpoint(mb: int = 64):
     """Checkpoint-engine data path, no cluster needed: cold save throughput
     (content-hash + framed chunk writes + atomic commit), warm save of an
@@ -251,6 +289,7 @@ def run_inproc():
     bench_actor_calls("inproc")
     bench_put_get("inproc")
     bench_trace_overhead("inproc")
+    bench_recorder_overhead("inproc")
     ray_tpu.shutdown()
 
 

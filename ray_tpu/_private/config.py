@@ -221,3 +221,22 @@ _config.define("profiling_enabled", bool, True, "record timeline events")
 _config.define("trace_ring_size", int, 200_000,
                "per-process span ring capacity; oldest spans drop when full "
                "(drops exported as the profiler_spans_dropped counter)")
+
+# -- Flight recorder (post-mortem forensics) -------------------------------------
+_config.define("flight_recorder_enabled", bool, True,
+               "spool spans/logs/metrics to a crash-safe on-disk ring so a "
+               "SIGKILL'd process still leaves evidence behind")
+_config.define("flight_recorder_dir", str, "/tmp/ray_tpu/flight",
+               "root for per-process recording dirs and sealed crash bundles")
+_config.define("flight_recorder_spool_ms", int, 500,
+               "spool-thread tick period; lower = fresher last words after "
+               "a hard kill, higher = cheaper")
+_config.define("flight_recorder_segment_bytes", int, 4 << 20,
+               "spool segment rotation threshold; two segments are kept, so "
+               "on-disk spool per process is bounded at ~2x this")
+_config.define("flight_recorder_tail_events", int, 256,
+               "ring size for the span/log/chaos tails carried per spool "
+               "record and into a sealed bundle")
+_config.define("flight_recorder_retention_s", int, 3600,
+               "dead recordings (clean exits and sealed bundles) older than "
+               "this are pruned at the next recorder install")

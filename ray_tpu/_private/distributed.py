@@ -365,6 +365,11 @@ class DistributedRuntime(Runtime):
             # obs spans recorded in this daemon (rpc dispatch, fetches,
             # checkpoint stages) group under the node's timeline row
             observability.set_process_label(f"node:{node_tag}")
+        # Flight-recorder state provider: every spool tick carries this
+        # runtime's identity + heartbeat health, so a sealed bundle shows
+        # whether the control plane was already degraded before death.
+        from ray_tpu.observability import recorder as _flight
+        _flight.register_state_provider(self._flight_state)
         self._hb_miss_gauge = _metrics.Gauge(
             "heartbeat_consecutive_misses",
             "consecutive failed heartbeats to the state service",
@@ -3061,6 +3066,16 @@ class DistributedRuntime(Runtime):
                 entry[1] = key
         return key
 
+    def _flight_state(self) -> Dict[str, Any]:
+        """Per-tick flight-recorder state: who this runtime is and how its
+        control-plane link looked at spool time (bundle forensics)."""
+        return {
+            "node_id": self.local_node.node_id.hex(),
+            "heartbeat_misses": self.heartbeat_misses,
+            "heartbeat_last_success": self.heartbeat_last_success,
+            "hb_stopped": self._hb_stop.is_set(),
+        }
+
     def _handle_node_debug(self, ctx: RpcContext):
         """Dashboard drill-down feed: recent log lines (in-process ring,
         ``log_ring.py``) + this daemon's task-state rows (the per-node
@@ -3075,6 +3090,17 @@ class DistributedRuntime(Runtime):
                                             trace_id=req.trace_filter)
         if req.include_metrics:
             payload["metrics"] = _metrics.snapshot()
+        if req.include_stacks:
+            # live hang diagnosis: the doctor samples stacks of a host
+            # whose heartbeats are missing but whose RPC plane still answers
+            from ray_tpu.observability import recorder as _flight
+            payload["stacks"] = _flight.thread_stacks()
+            payload["inflight"] = _flight.inflight_snapshot()
+        if req.include_bundles:
+            # cluster-wide forensics without a shared filesystem: each
+            # daemon ships its host's recordings + sealed crash bundles
+            from ray_tpu.observability import recorder as _flight
+            payload["forensics"] = _flight.disk_report()
         if req.include_tasks:
             cap = int(req.max_tasks) or 1000
             with self.lock:

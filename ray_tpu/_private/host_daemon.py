@@ -83,6 +83,16 @@ def main(argv=None) -> int:
     from ray_tpu._private import log_ring
     log_ring.install()
 
+    # Flight recorder: installed before the runtime so even a crash during
+    # startup leaves a recording; sealed by exit hooks, or posthumously by
+    # a surviving daemon/doctor if this process is SIGKILL'd.
+    from ray_tpu.observability import recorder as _flight
+    recorder = None
+    try:
+        recorder = _flight.install("host_daemon")
+    except Exception:
+        logging.warning("flight recorder unavailable", exc_info=True)
+
     prof_dir = os.environ.get("RAY_TPU_PROFILE_DIR")
     if prof_dir:
         _install_thread_profiler(prof_dir)
@@ -139,6 +149,8 @@ def main(argv=None) -> int:
     logging.info("host daemon %s serving at %s (resources %s)",
                  runtime.local_node.node_id.hex()[:8], runtime.address,
                  amounts)
+    if recorder is not None:
+        recorder.set_label(f"node:{runtime.local_node.node_id.hex()[:8]}")
 
     # Per-node reporter agent (dashboard/agent.py role): publishes proc +
     # store stats into the state-service KV for the dashboard head.
@@ -150,9 +162,19 @@ def main(argv=None) -> int:
     except Exception:
         logging.warning("node reporter unavailable", exc_info=True)
 
+    # Posthumous-sealing sweep: a surviving daemon on the host seals crash
+    # bundles for siblings that died without running their hooks (SIGKILL).
+    next_sweep = time.monotonic() + 2.0
     try:
         while not stop["flag"] and not runtime._hb_stop.is_set():
+            # raylint: allow(bare-retry) serve-loop pacing, not a retry: the swallowed sweep is periodic best-effort work
             time.sleep(0.2)
+            if recorder is not None and time.monotonic() >= next_sweep:
+                next_sweep = time.monotonic() + 2.0
+                try:
+                    _flight.seal_orphans(sealed_by="host_daemon")
+                except Exception:  # noqa: BLE001  # raylint: allow(swallow) sweep is best-effort; next pass retries
+                    pass
     finally:
         if reporter is not None:
             reporter.stop()
@@ -160,6 +182,11 @@ def main(argv=None) -> int:
             runtime.shutdown()
         except Exception:
             logging.exception("daemon shutdown error")
+        if recorder is not None:
+            try:
+                recorder.close(clean=True)
+            except Exception:  # noqa: BLE001  # raylint: allow(swallow) exiting anyway; recording stays unsealed at worst
+                pass
     return 0
 
 

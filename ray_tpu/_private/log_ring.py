@@ -40,6 +40,9 @@ class RingLogHandler(logging.Handler):
         self.setFormatter(logging.Formatter(_FMT))
         self._lock2 = threading.Lock()
         self._ring: collections.deque = collections.deque(maxlen=capacity)
+        # Monotonic append counter for incremental readers (the flight
+        # recorder spools only lines it has not shipped yet).
+        self._seq = 0
 
     def emit(self, record: logging.LogRecord):
         try:
@@ -51,6 +54,20 @@ class RingLogHandler(logging.Handler):
             return
         with self._lock2:
             self._ring.append((line, tid))
+            self._seq += 1
+
+    def tail_since(self, cursor: int) -> "tuple[int, List[str]]":
+        """Incremental tail: lines appended after ``cursor`` (previously
+        returned by this method; start at 0). Lines that fell off the ring
+        between reads are lost; returns ``(new_cursor, lines)``."""
+        with self._lock2:
+            new = self._seq - cursor
+            if new <= 0:
+                return self._seq, []
+            if new > len(self._ring):
+                new = len(self._ring)
+            items = list(self._ring)[-new:] if new else []
+            return self._seq, [it[0] for it in items]
 
     def tail(self, n: int, trace_id: str = "") -> List[str]:
         with self._lock2:
@@ -77,3 +94,9 @@ def install(capacity: int = 2000) -> RingLogHandler:
 
 def tail(n: int, trace_id: str = "") -> List[str]:
     return _handler.tail(n, trace_id=trace_id) if _handler is not None else []
+
+
+def tail_since(cursor: int) -> "tuple[int, List[str]]":
+    if _handler is None:
+        return cursor, []
+    return _handler.tail_since(cursor)

@@ -40,6 +40,9 @@ class Profiler:
         self._max = max_spans
         self._spans: Deque[dict] = collections.deque(maxlen=max_spans)
         self._dropped = 0
+        # Monotonic append counter — survives clear() so incremental
+        # readers (the flight recorder's spool thread) never double-read.
+        self._seq = 0
 
     @property
     def enabled(self) -> bool:
@@ -93,12 +96,27 @@ class Profiler:
             if dropped:
                 self._dropped += 1
             self._spans.append(span)
+            self._seq += 1
         if dropped:  # metric bump outside the ring lock (own lock inside)
             _spans_dropped_metric()
 
     def chrome_trace(self) -> List[dict]:
         with self._lock:
             return list(self._spans)
+
+    def events_since(self, cursor: int) -> "tuple[int, List[dict]]":
+        """Incremental read: events appended after ``cursor`` (a value
+        previously returned by this method; start from 0). Returns
+        ``(new_cursor, events)``. Events that fell off the ring between
+        reads are lost — the spool cadence bounds that window."""
+        with self._lock:
+            new = self._seq - cursor
+            if new <= 0:
+                return self._seq, []
+            if new > len(self._spans):
+                new = len(self._spans)
+            tail = list(self._spans)[-new:] if new else []
+            return self._seq, tail
 
     def clear(self):
         with self._lock:
@@ -135,8 +153,10 @@ def dump_timeline(filename: Optional[str] = None) -> Any:
     trace = _profiler.chrome_trace()
     if filename is None:
         return trace
-    with open(filename, "w") as f:
-        json.dump(trace, f)
+    # Atomic: a crash mid-dump must not leave a torn half-JSON file where
+    # an operator expects a readable timeline (tmp + fsync + rename).
+    from ray_tpu.checkpoint.manifest import atomic_write_bytes
+    atomic_write_bytes(filename, json.dumps(trace).encode())
     return filename
 
 
@@ -163,19 +183,38 @@ def stop_device_trace() -> Optional[str]:
 
 class profile_span:
     """Context manager for user code spans (reference:
-    ``ray.profiling.profile`` events, ``_raylet.pyx:1613``)."""
+    ``ray.profiling.profile`` events, ``_raylet.pyx:1613``).
+
+    Records under the REAL process identity (``observability.process_label``
+    — daemons relabel to ``node:<hex8>``), and when tracing is on the span
+    routes through :class:`observability.span` so user phases parent into
+    the active distributed trace instead of floating beside it."""
 
     def __init__(self, name: str, cat: str = "user",
                  args: Optional[Dict[str, Any]] = None):
         self.name = name
         self.cat = cat
         self.args = args
+        self._span = None
 
     def __enter__(self):
-        self._t0 = time.time()
+        # Lazy import: observability imports this module at load time.
+        from ray_tpu import observability
+        if observability.ENABLED:
+            # raylint: allow(span-leak) delegated CM: our __exit__ closes it
+            self._span = observability.span(
+                self.name, cat=self.cat, **(self.args or {}))
+            self._span.__enter__()
+        else:
+            self._t0 = time.time()
         return self
 
     def __exit__(self, *exc_info):
-        _profiler.record(self.name, self.cat, pid="driver",
+        if self._span is not None:
+            span, self._span = self._span, None
+            return span.__exit__(*exc_info)
+        from ray_tpu import observability
+        _profiler.record(self.name, self.cat,
+                         pid=observability.process_label(),
                          start_s=self._t0, dur_s=time.time() - self._t0,
                          args=self.args)

@@ -48,6 +48,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu import chaos, observability
 from ray_tpu import exceptions as exc
+from ray_tpu.observability import recorder as _flight
 from ray_tpu._private.backoff import BackoffPolicy
 from ray_tpu._private.config import _config
 from ray_tpu._private.ids import (ActorID, JobID, NodeID, ObjectID,
@@ -850,6 +851,11 @@ class Runtime:
         ctx.trace_id = spec.trace_id
         span_id = os.urandom(8).hex() if spec.trace_id else ""
         ctx.span_id = span_id
+        if _flight.ENABLED:
+            # flight recorder: a hard-killed process's bundle names what
+            # was RUNNING (and which trace it belonged to) when it died
+            _flight.task_started(spec.task_id.hex(), spec.function_name,
+                                 trace_id=spec.trace_id, span_id=span_id)
         t0 = time.monotonic()
         try:
             if cancel.is_set():
@@ -876,6 +882,8 @@ class Runtime:
         except BaseException as e:  # noqa: BLE001
             self._handle_task_failure(spec, node, e)
         finally:
+            if _flight.ENABLED:
+                _flight.task_finished(spec.task_id.hex())
             alloc_target.release(request)
             self._unpin_args(spec)
             dur = time.monotonic() - t0

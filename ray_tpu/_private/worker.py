@@ -85,6 +85,14 @@ def init(num_cpus: Optional[float] = None,
             raise RuntimeError("ray_tpu.init() called twice; pass "
                                "ignore_reinit_error=True to ignore")
         _config.apply_system_config(_system_config)
+        # Always-on flight recorder (process-scoped: it records THIS
+        # process, so it survives shutdown()/init() cycles and is sealed
+        # by exit hooks or — after a hard kill — by a surviving sweeper).
+        from ray_tpu.observability import recorder as _flight
+        try:
+            _flight.install("driver")
+        except Exception as e:
+            logger.warning("flight recorder unavailable: %s", e)
         if auth_token:
             # Process-wide: every RPC connection (state client, daemon
             # peers) opens with this shared secret (rpc.default_auth_token).
@@ -311,8 +319,8 @@ def timeline(filename: Optional[str] = None):
         trace = cluster_fetch()
         if filename is None:
             return trace
-        with open(filename, "w") as f:
-            _json.dump(trace, f)
+        from ray_tpu.checkpoint.manifest import atomic_write_bytes
+        atomic_write_bytes(filename, _json.dumps(trace).encode())
         return filename
     from ray_tpu._private.profiling import dump_timeline
     return dump_timeline(filename)

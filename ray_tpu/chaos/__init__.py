@@ -50,12 +50,13 @@ from typing import Optional
 
 from ray_tpu.chaos.engine import (ChaosConnectionReset, ChaosError,
                                   FaultRule, FaultSchedule, parse_env,
-                                  parse_spec)
+                                  parse_spec, register_exit_hook)
 
 __all__ = [
     "ENABLED", "ChaosError", "ChaosConnectionReset", "FaultRule",
     "FaultSchedule", "parse_spec", "parse_env", "configure", "install",
     "clear", "inject", "schedule", "set_observer", "trace_lines", "trace_text",
+    "register_exit_hook",
 ]
 
 logger = logging.getLogger("ray_tpu")

@@ -50,8 +50,24 @@ from typing import Dict, List, Optional, Tuple
 
 __all__ = [
     "ChaosError", "ChaosConnectionReset", "FaultRule", "FaultSchedule",
-    "parse_spec", "parse_env",
+    "parse_spec", "parse_env", "register_exit_hook",
 ]
+
+# Pre-death callbacks for the ``exit`` action, called (point, exit_code)
+# right before ``os._exit``. Registration-hook pattern (same reason as the
+# observer in ``chaos/__init__``): this module stays stdlib-only, yet the
+# flight recorder can seal a crash bundle on the way down — ``exit`` is the
+# deterministic stand-in for a SIGKILL'd host, and a hook here is the only
+# cleanup that runs (``os._exit`` skips atexit/finally).
+_exit_hooks: List = []
+
+
+def register_exit_hook(fn) -> None:
+    """Register ``fn(point, exit_code)`` to run before a chaos ``exit``
+    kills the process. Hooks are best-effort: exceptions are swallowed
+    (the process is dying either way) and must not block."""
+    if fn not in _exit_hooks:
+        _exit_hooks.append(fn)
 
 
 class ChaosError(RuntimeError):
@@ -305,4 +321,9 @@ class FaultSchedule:
         sys.stderr.write(f"chaos: injected process exit({fired.arg}) at "
                          f"{point} pid={os.getpid()}\n")
         sys.stderr.flush()
+        for hook in list(_exit_hooks):
+            try:
+                hook(point, fired.arg)
+            except BaseException:  # noqa: BLE001  # raylint: allow(swallow) dying process: sealing is best-effort
+                pass
         os._exit(fired.arg)

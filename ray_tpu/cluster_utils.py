@@ -118,10 +118,13 @@ class ProcessCluster:
 
     def add_daemon(self, num_cpus: Optional[float] = None,
                    resources: Optional[Dict[str, float]] = None,
-                   num_tpus: float = 0):
+                   num_tpus: float = 0,
+                   env: Optional[Dict[str, str]] = None):
         from ray_tpu._private.node import spawn_daemon
+        extra = dict(env or {})  # e.g. RAY_TPU_CHAOS / flight-recorder knobs
         env = ({} if os.environ.get("JAX_PLATFORMS")
                else {"JAX_PLATFORMS": "cpu"})  # test daemons stay CPU
+        env.update(extra)
         proc, addr = spawn_daemon(
             self.address,
             num_cpus=(num_cpus if num_cpus is not None

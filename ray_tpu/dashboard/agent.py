@@ -119,6 +119,18 @@ class NodeReporterAgent:
                 stats["memory_monitor"] = monitor.snapshot()
             except Exception as e:
                 logger.debug("memory-monitor stats failed: %s", e)
+        try:
+            from ray_tpu.observability import recorder as _flight
+            rec = _flight.get_recorder()
+            if rec is not None:
+                report = _flight.disk_report()
+                stats["flight_recorder"] = {
+                    "dir": rec.dir,
+                    "recordings": len(report["recordings"]),
+                    "sealed_bundles": len(report["bundles"]),
+                }
+        except Exception as e:
+            logger.debug("flight-recorder stats failed: %s", e)
         return stats
 
     def publish_once(self):

@@ -22,14 +22,25 @@ Endpoints:
                       filters the log tail to one trace id
   /api/timeline     — merged chrome://tracing timeline: every alive
                       daemon's span ring (GET_TIMELINE fan-out) plus the
-                      head's own, distinct pids per host
+                      head's own, distinct pids per host. Partial
+                      failures degrade, not error: hosts that could not
+                      be reached are listed in ``missing_hosts``
   /api/trace?id=X   — one distributed trace's spans + instant events,
                       filtered out of the merged timeline
   /api/metrics      — per-host metric snapshots (NODE_DEBUG
-                      include_metrics fan-out), JSON keyed by node
+                      include_metrics fan-out), JSON keyed by node,
+                      with unreachable hosts in ``missing_hosts``
   /metrics          — the same federation rendered as one cluster-wide
                       Prometheus exposition, each sample labeled with
-                      its source node
+                      its source node; unreachable hosts surface as
+                      ``federation_missing_hosts`` samples
+  /api/forensics    — cluster-wide crash forensics: every alive
+                      daemon's live thread stacks, in-flight tasks and
+                      on-disk flight recordings / sealed crash bundles
+                      (NODE_DEBUG include_stacks+include_bundles
+                      fan-out) plus the head's own — the wire the
+                      health doctor (``python -m ray_tpu.doctor``)
+                      collects through
 """
 
 from __future__ import annotations
@@ -228,13 +239,17 @@ class DashboardHead:
         return [(n.node_id.hex(), n.address)
                 for n in self.state.list_nodes() if n.alive and n.address]
 
-    def _timeline(self) -> list:
+    def _timeline(self) -> dict:
         """One merged chrome://tracing event list: the head's own span
         ring plus every alive daemon's, pulled over GET_TIMELINE. Hosts
-        keep distinct ``pid`` labels so the merged view separates them."""
+        keep distinct ``pid`` labels so the merged view separates them.
+        A daemon that is registered alive but unreachable (dying, net
+        partition) degrades into ``missing_hosts`` instead of failing
+        the whole merge."""
         from ray_tpu.protocol import pb
         from ray_tpu._private.profiling import get_profiler
         events = list(get_profiler().chrome_trace())
+        missing = []
         for nid, addr in self._alive_addrs():
             try:
                 rep = pb.TimelineReply()
@@ -246,23 +261,30 @@ class DashboardHead:
             except Exception as e:
                 logger.debug("dashboard: timeline fetch from %s failed: %s",
                              addr, e)
-        return events
+                missing.append({"node_id": nid, "address": addr,
+                                "error": str(e)})
+        return {"traceEvents": events, "missing_hosts": missing}
 
     def _trace(self, trace_id: str) -> dict:
         from ray_tpu import observability
         if not trace_id:
             return {"error": "missing ?id=<trace_id>"}
-        events = observability.spans_for_trace(trace_id, self._timeline())
+        merged = self._timeline()
+        events = observability.spans_for_trace(
+            trace_id, merged["traceEvents"])
         events.sort(key=lambda e: e.get("ts", 0))
         return {"trace_id": trace_id, "num_events": len(events),
-                "events": events}
+                "events": events,
+                "missing_hosts": merged["missing_hosts"]}
 
-    def _metric_snapshots(self) -> dict:
-        """{node_label: metrics.snapshot()} across the cluster — the
-        head's own registry plus each alive daemon's via NODE_DEBUG."""
+    def _metric_snapshots(self) -> "tuple[dict, list]":
+        """({node_label: metrics.snapshot()}, missing_hosts) across the
+        cluster — the head's own registry plus each alive daemon's via
+        NODE_DEBUG. Unreachable daemons land in ``missing_hosts``."""
         from ray_tpu.protocol import pb
         from ray_tpu.util import metrics as _metrics
         snaps = {"head": _metrics.snapshot()}
+        missing = []
         for nid, addr in self._alive_addrs():
             try:
                 rep = pb.NodeDebugReply()
@@ -276,7 +298,48 @@ class DashboardHead:
             except Exception as e:
                 logger.debug("dashboard: metrics fetch from %s failed: %s",
                              addr, e)
-        return snaps
+                missing.append({"node_id": nid, "address": addr,
+                                "error": str(e)})
+        return snaps, missing
+
+    def _forensics(self) -> dict:
+        """Cluster-wide crash forensics, the doctor's collection wire:
+        per-node live thread stacks, in-flight task registry, and the
+        on-disk flight-recorder report (recordings + sealed bundles),
+        plus the head process's own. Dead/unreachable nodes degrade
+        into ``missing_hosts`` — their story lives in the bundles the
+        surviving daemons sealed for them."""
+        from ray_tpu.protocol import pb
+        from ray_tpu.observability import recorder as _flight
+        nodes = {}
+        missing = []
+        for nid, addr in self._alive_addrs():
+            try:
+                rep = pb.NodeDebugReply()
+                rep.ParseFromString(self.pool.get(addr).call(
+                    pb.NODE_DEBUG, pb.NodeDebugRequest(
+                        log_lines=0, include_tasks=True,
+                        include_stacks=True,
+                        include_bundles=True).SerializeToString(),
+                    timeout=15).body)
+                payload = json.loads(bytes(rep.payload_json).decode())
+                payload["address"] = addr
+                nodes[nid] = payload
+            except Exception as e:
+                logger.debug("dashboard: forensics fetch from %s failed: %s",
+                             addr, e)
+                missing.append({"node_id": nid, "address": addr,
+                                "error": str(e)})
+        return {
+            "ts": time.time(),
+            "head": {
+                "stacks": _flight.thread_stacks(),
+                "inflight": _flight.inflight_snapshot(),
+                "forensics": _flight.disk_report(),
+            },
+            "nodes": nodes,
+            "missing_hosts": missing,
+        }
 
     # -- server ----------------------------------------------------------
     def start(self) -> int:
@@ -326,11 +389,16 @@ class DashboardHead:
                     elif route == "/api/trace":
                         self._json(head._trace(q.get("id", [""])[0]))
                     elif route == "/api/metrics":
-                        self._json(head._metric_snapshots())
+                        snaps, missing = head._metric_snapshots()
+                        self._json({"snapshots": snaps,
+                                    "missing_hosts": missing})
+                    elif route == "/api/forensics":
+                        self._json(head._forensics())
                     elif route == "/metrics":
                         from ray_tpu.util.metrics import render_federated
+                        snaps, missing = head._metric_snapshots()
                         self._send(
-                            render_federated(head._metric_snapshots())
+                            render_federated(snaps, missing_hosts=missing)
                             .encode(), "text/plain; version=0.0.4")
                     else:
                         self._json({"error": "not found"}, 404)

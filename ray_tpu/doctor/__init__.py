@@ -1,0 +1,392 @@
+"""Cluster health doctor: collect crash forensics, diagnose, explain.
+
+``python -m ray_tpu.doctor`` is the post-mortem / triage entry point on
+top of the always-on flight recorder (:mod:`ray_tpu.observability.
+recorder`) and the dashboard's forensics federation:
+
+1. **collect** — seal orphaned recordings on this machine (processes that
+   died without running their hooks), inventory the local flight dir, and
+   — when ``--address`` points at a live state service — pull every alive
+   daemon's thread stacks, in-flight tasks, bundle inventory, metric
+   snapshots and merged timeline through the same NODE_DEBUG fan-out the
+   dashboard head serves.
+2. **diagnose** — correlate: sealed bundles become crash reports carrying
+   the in-flight trace_id, last spans/log/chaos lines and breaker/
+   heartbeat state at death; ``heartbeat_consecutive_misses > 0`` plus
+   live stacks flags a hang; cross-process task-span outliers flag
+   stragglers; hosts the head could not reach are called out.
+3. **render** — human-readable diagnosis, or ``--json`` for machines.
+
+The doctor holds no state and never needs the cluster to be healthy: with
+no ``--address`` it still reads (and seals) whatever the dead processes
+left on disk.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["collect", "diagnose", "render_text", "main"]
+
+
+def collect(flight_dir: Optional[str] = None,
+            address: Optional[str] = None,
+            seal: bool = True) -> dict:
+    """Gather everything diagnosable. Local disk always; cluster-wide
+    live state only when ``address`` (state-service host:port) is given.
+    Collection never raises for a sick cluster — per-source errors land
+    in ``errors`` and diagnosis runs on what was reachable."""
+    from ray_tpu.observability import recorder as _flight
+    out: Dict[str, Any] = {"ts": time.time(), "errors": []}
+    sealed_now: List[str] = []
+    if seal:
+        try:
+            sealed_now = _flight.seal_orphans(root=flight_dir,
+                                              sealed_by="doctor")
+        except Exception as e:  # noqa: BLE001
+            out["errors"].append(f"seal_orphans: {e!r}")
+    out["sealed_now"] = sealed_now
+    try:
+        out["local"] = _flight.disk_report(root=flight_dir)
+    except Exception as e:  # noqa: BLE001
+        out["errors"].append(f"disk_report: {e!r}")
+        out["local"] = {"root": flight_dir or "", "recordings": [],
+                        "bundles": []}
+    out["cluster"] = None
+    if address:
+        from ray_tpu.dashboard.head import DashboardHead
+        head = DashboardHead(address)  # API methods only; never start()ed
+        try:
+            cluster: Dict[str, Any] = {}
+            for key, fetch in (
+                    ("nodes", head._cluster),
+                    ("forensics", head._forensics),
+                    ("timeline", head._timeline)):
+                try:
+                    cluster[key] = fetch()
+                except Exception as e:  # noqa: BLE001
+                    out["errors"].append(f"{key}: {e!r}")
+                    cluster[key] = None
+            try:
+                snaps, missing = head._metric_snapshots()
+                cluster["metrics"] = {"snapshots": snaps,
+                                      "missing_hosts": missing}
+            except Exception as e:  # noqa: BLE001
+                out["errors"].append(f"metrics: {e!r}")
+                cluster["metrics"] = None
+            out["cluster"] = cluster
+        finally:
+            head.stop()
+    return out
+
+
+def _all_bundles(collected: dict) -> List[dict]:
+    """Every sealed bundle the collection saw, deduped: the local disk
+    report plus each daemon's NODE_DEBUG ``include_bundles`` payload
+    (which on a single test machine usually point at the same dirs)."""
+    seen = set()
+    bundles: List[dict] = []
+
+    def add(b: dict):
+        key = (b.get("dir") or "", b.get("pid"), b.get("sealed_ts"))
+        if key in seen:
+            return
+        seen.add(key)
+        bundles.append(b)
+
+    for b in (collected.get("local") or {}).get("bundles") or []:
+        add(b)
+    cluster = collected.get("cluster") or {}
+    forensics = cluster.get("forensics") or {}
+    for payload in (forensics.get("nodes") or {}).values():
+        for b in ((payload.get("forensics") or {}).get("bundles") or []):
+            add(b)
+    return bundles
+
+
+def _crash_reports(bundles: List[dict]) -> List[dict]:
+    reports = []
+    for b in bundles:
+        if b.get("clean"):
+            continue
+        inflight = b.get("inflight") or {}
+        chaos_tail = b.get("chaos") or []
+        state = b.get("state") or {}
+        reports.append({
+            "role": b.get("role", "?"),
+            "label": b.get("label", ""),
+            "pid": b.get("pid"),
+            "dir": b.get("dir", ""),
+            "exit_reason": b.get("exit_reason", "?"),
+            "sealed_by": b.get("sealed_by", "?"),
+            "sealed_ts": b.get("sealed_ts"),
+            "trace_ids": b.get("trace_ids") or [],
+            "inflight_tasks": [
+                {"task_id": tid, "name": t.get("name", "?"),
+                 "trace_id": t.get("trace_id", "")}
+                for tid, t in sorted(inflight.items())],
+            "chaos_spec": b.get("chaos_spec", ""),
+            "chaos_points_fired": chaos_tail[-8:],
+            "heartbeat_misses": state.get("heartbeat_misses"),
+            "last_logs": (b.get("logs") or [])[-5:],
+            "last_spans": [s.get("name") for s in
+                           (b.get("spans") or [])[-5:]],
+            "exception": (b.get("exception") or {}).get("type", ""),
+            "faulthandler": bool(b.get("faulthandler")),
+        })
+    reports.sort(key=lambda r: r.get("sealed_ts") or 0)
+    return reports
+
+
+def _hang_reports(collected: dict) -> List[dict]:
+    """Heartbeat-miss-triggered hang detection: any node whose
+    ``heartbeat_consecutive_misses`` gauge is nonzero is sampled — its
+    live thread stacks (already in the forensics fan-out) say where it
+    is stuck."""
+    cluster = collected.get("cluster") or {}
+    metrics = cluster.get("metrics") or {}
+    snaps = metrics.get("snapshots") or {}
+    forensics = cluster.get("forensics") or {}
+    nodes = forensics.get("nodes") or {}
+    hangs = []
+    for src, families in snaps.items():
+        for fam in families or []:
+            if fam.get("name") != "heartbeat_consecutive_misses":
+                continue
+            for _name, tags, value in fam.get("samples") or []:
+                if not value or value <= 0:
+                    continue
+                node_tag = dict(tags).get("node", src)
+                stacks = {}
+                inflight = {}
+                for nid, payload in nodes.items():
+                    if nid.startswith(node_tag) or \
+                            node_tag.startswith(nid[:8]):
+                        stacks = payload.get("stacks") or {}
+                        inflight = payload.get("inflight") or {}
+                        break
+                hangs.append({"node": node_tag, "source": src,
+                              "consecutive_misses": value,
+                              "inflight_tasks": sorted(
+                                  t.get("name", "?")
+                                  for t in inflight.values()),
+                              "stacks": stacks})
+    return hangs
+
+
+def _straggler_reports(collected: dict,
+                       factor: float = 3.0) -> List[dict]:
+    """Cross-process step-time outliers: group completed task spans by
+    name across ``pid`` rows of the merged timeline; a process whose
+    mean duration exceeds ``factor`` × the cluster median (≥3 samples,
+    ≥2 processes) is a straggler."""
+    cluster = collected.get("cluster") or {}
+    timeline = cluster.get("timeline") or {}
+    events = timeline.get("traceEvents") or []
+    by_name: Dict[str, Dict[str, List[float]]] = {}
+    for ev in events:
+        if ev.get("ph") != "X" or ev.get("cat") != "task":
+            continue
+        dur = ev.get("dur")
+        if not dur:
+            continue
+        by_name.setdefault(ev.get("name", "?"), {}) \
+            .setdefault(str(ev.get("pid", "?")), []).append(float(dur))
+    out = []
+    for name, per_pid in by_name.items():
+        durs = [d for ds in per_pid.values() for d in ds]
+        if len(durs) < 3 or len(per_pid) < 2:
+            continue
+        median = statistics.median(durs)
+        if median <= 0:
+            continue
+        for pid, ds in per_pid.items():
+            mean = sum(ds) / len(ds)
+            if mean > factor * median:
+                out.append({"task": name, "process": pid,
+                            "mean_us": round(mean, 1),
+                            "cluster_median_us": round(median, 1),
+                            "slowdown": round(mean / median, 1),
+                            "samples": len(ds)})
+    out.sort(key=lambda r: -r["slowdown"])
+    return out
+
+
+def diagnose(collected: dict, straggler_factor: float = 3.0) -> dict:
+    """Turn a :func:`collect` result into findings. Machine-readable;
+    :func:`render_text` prints the same structure for humans."""
+    crashes = _crash_reports(_all_bundles(collected))
+    hangs = _hang_reports(collected)
+    stragglers = _straggler_reports(collected, factor=straggler_factor)
+    cluster = collected.get("cluster") or {}
+    missing: List[dict] = []
+    for key in ("forensics", "timeline"):
+        for h in ((cluster.get(key) or {}).get("missing_hosts") or []):
+            if all(m["node_id"] != h["node_id"] for m in missing):
+                missing.append(h)
+    for h in ((cluster.get("metrics") or {}).get("missing_hosts") or []):
+        if all(m["node_id"] != h["node_id"] for m in missing):
+            missing.append(h)
+    dead_nodes = [n for n in ((cluster.get("nodes") or {}).get("nodes")
+                              or []) if not n.get("alive")]
+    local = collected.get("local") or {}
+    n_issues = (len(crashes) + len(hangs) + len(stragglers) +
+                len(missing) + len(dead_nodes))
+    return {
+        "ts": collected.get("ts"),
+        "healthy": n_issues == 0,
+        "num_issues": n_issues,
+        "crashes": crashes,
+        "hangs": hangs,
+        "stragglers": stragglers,
+        "unreachable_hosts": missing,
+        "dead_nodes": [{"node_id": n.get("node_id", ""),
+                        "death_reason": n.get("death_reason", "")}
+                       for n in dead_nodes],
+        "sealed_now": collected.get("sealed_now") or [],
+        "flight_dir": local.get("root", ""),
+        "recordings": len(local.get("recordings") or []),
+        "bundles": len(local.get("bundles") or []),
+        "collection_errors": collected.get("errors") or [],
+    }
+
+
+def render_text(report: dict) -> str:
+    """Human-readable diagnosis of a :func:`diagnose` report."""
+    lines = []
+    lines.append("ray_tpu doctor")
+    lines.append(f"  flight dir: {report.get('flight_dir') or '(default)'}"
+                 f"  recordings: {report.get('recordings', 0)}"
+                 f"  sealed bundles: {report.get('bundles', 0)}")
+    if report.get("sealed_now"):
+        lines.append(f"  sealed {len(report['sealed_now'])} orphaned "
+                     "recording(s) this run:")
+        for p in report["sealed_now"]:
+            lines.append(f"    {p}")
+    crashes = report.get("crashes") or []
+    if crashes:
+        lines.append("")
+        lines.append(f"CRASHES ({len(crashes)})")
+        for c in crashes:
+            who = c["label"] or c["role"]
+            lines.append(f"  [{who} pid={c['pid']}] {c['exit_reason']}")
+            lines.append(f"    sealed by: {c['sealed_by']}")
+            if c.get("exception"):
+                lines.append(f"    exception: {c['exception']}")
+            for t in c["inflight_tasks"]:
+                lines.append(
+                    f"    in-flight: {t['name']} "
+                    f"(task {t['task_id'][:8]}"
+                    + (f", trace {t['trace_id']}" if t["trace_id"]
+                       else "") + ")")
+            if c["trace_ids"]:
+                lines.append("    trace ids: " + ", ".join(c["trace_ids"]))
+            if c["chaos_spec"]:
+                lines.append(f"    chaos spec: {c['chaos_spec']}")
+            for cl in c["chaos_points_fired"][-3:]:
+                lines.append(f"    chaos fired: {cl}")
+            if c.get("heartbeat_misses"):
+                lines.append("    control plane already degraded: "
+                             f"{c['heartbeat_misses']} consecutive "
+                             "heartbeat misses at death")
+            for log_line in c["last_logs"][-3:]:
+                lines.append(f"    log: {log_line}")
+    hangs = report.get("hangs") or []
+    if hangs:
+        lines.append("")
+        lines.append(f"HANGS ({len(hangs)})")
+        for h in hangs:
+            lines.append(f"  node {h['node']}: "
+                         f"{h['consecutive_misses']:.0f} consecutive "
+                         "heartbeat misses")
+            for name in h["inflight_tasks"]:
+                lines.append(f"    in-flight: {name}")
+            for tname in sorted(h.get("stacks") or {}):
+                lines.append(f"    stack sampled: thread {tname}")
+    stragglers = report.get("stragglers") or []
+    if stragglers:
+        lines.append("")
+        lines.append(f"STRAGGLERS ({len(stragglers)})")
+        for s in stragglers:
+            lines.append(
+                f"  {s['process']}: task {s['task']} mean "
+                f"{s['mean_us']}us = {s['slowdown']}x the cluster "
+                f"median ({s['cluster_median_us']}us, "
+                f"{s['samples']} samples)")
+    missing = report.get("unreachable_hosts") or []
+    if missing:
+        lines.append("")
+        lines.append(f"UNREACHABLE HOSTS ({len(missing)})")
+        for m in missing:
+            lines.append(f"  {m['node_id'][:8]} @ {m['address']}: "
+                         f"{m['error']}")
+    dead = report.get("dead_nodes") or []
+    if dead:
+        lines.append("")
+        lines.append(f"DEAD NODES ({len(dead)})")
+        for n in dead:
+            lines.append(f"  {n['node_id'][:8]}: "
+                         f"{n['death_reason'] or '(no reason recorded)'}")
+    errs = report.get("collection_errors") or []
+    if errs:
+        lines.append("")
+        lines.append(f"COLLECTION ERRORS ({len(errs)})")
+        for e in errs:
+            lines.append(f"  {e}")
+    lines.append("")
+    if report.get("healthy"):
+        lines.append("verdict: healthy — no crashes, hangs, stragglers "
+                     "or unreachable hosts")
+    else:
+        lines.append(f"verdict: {report.get('num_issues')} issue(s) found")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.doctor",
+        description="Collect crash bundles + live cluster state and "
+                    "diagnose crashes, hangs and stragglers.")
+    parser.add_argument("--flight-dir", default=None,
+                        help="flight recorder root (default: the "
+                             "flight_recorder_dir config knob)")
+    parser.add_argument("--address", default=None,
+                        help="state service host:port for live "
+                             "cluster-wide collection (omit for "
+                             "disk-only post-mortem)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the machine-readable report")
+    parser.add_argument("--no-seal", action="store_true",
+                        help="do not posthumously seal orphaned "
+                             "recordings, only read")
+    parser.add_argument("--out", default=None,
+                        help="also write the JSON report to this file "
+                             "(atomic)")
+    parser.add_argument("--straggler-factor", type=float, default=3.0,
+                        help="flag a process whose mean task time "
+                             "exceeds this multiple of the cluster "
+                             "median (default 3.0)")
+    args = parser.parse_args(argv)
+    try:
+        collected = collect(flight_dir=args.flight_dir,
+                            address=args.address,
+                            seal=not args.no_seal)
+        report = diagnose(collected,
+                          straggler_factor=args.straggler_factor)
+    except Exception as e:  # noqa: BLE001
+        print(f"doctor: collection failed: {e!r}", file=sys.stderr)
+        return 2
+    if args.out:
+        from ray_tpu.checkpoint.manifest import atomic_write_bytes
+        atomic_write_bytes(args.out,
+                           json.dumps(report, indent=2).encode())
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(render_text(report), end="")
+    return 0

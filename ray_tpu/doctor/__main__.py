@@ -1,0 +1,8 @@
+"""``python -m ray_tpu.doctor`` — see :mod:`ray_tpu.doctor`."""
+
+import sys
+
+from ray_tpu.doctor import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,6 +14,7 @@ Usage:
   python -m ray_tpu.scripts.cli memory
   python -m ray_tpu.scripts.cli timeline -o /tmp/trace.json
   python -m ray_tpu.scripts.cli events
+  python -m ray_tpu.scripts.cli doctor --json
 """
 
 from __future__ import annotations
@@ -93,6 +94,22 @@ def cmd_events(args):
         print(json.dumps(ev, default=str))
 
 
+def cmd_doctor(args):
+    from ray_tpu.doctor import main as doctor_main
+    argv = []
+    if args.flight_dir:
+        argv += ["--flight-dir", args.flight_dir]
+    if args.address:
+        argv += ["--address", args.address]
+    if args.json:
+        argv += ["--json"]
+    if args.no_seal:
+        argv += ["--no-seal"]
+    if args.output:
+        argv += ["--out", args.output]
+    sys.exit(doctor_main(argv))
+
+
 def cmd_dashboard(args):
     import time
     from ray_tpu.dashboard import start_dashboard
@@ -127,6 +144,15 @@ def main(argv=None):
     ep = sub.add_parser("events")
     ep.add_argument("--limit", type=int, default=100)
     ep.set_defaults(fn=cmd_events)
+    hp = sub.add_parser("doctor",
+                        help="crash forensics + cluster health diagnosis")
+    hp.add_argument("--flight-dir", default=None)
+    hp.add_argument("--address", default=None,
+                    help="state service host:port for live collection")
+    hp.add_argument("--json", action="store_true")
+    hp.add_argument("--no-seal", action="store_true")
+    hp.add_argument("-o", "--output", default=None)
+    hp.set_defaults(fn=cmd_doctor)
     dp = sub.add_parser("dashboard",
                         help="serve the cluster dashboard UI")
     dp.add_argument("--address", required=True,

@@ -194,11 +194,14 @@ def snapshot() -> List[dict]:
     return out
 
 
-def render_federated(snapshots: Dict[str, List[dict]]) -> str:
+def render_federated(snapshots: Dict[str, List[dict]],
+                     missing_hosts: Optional[List[dict]] = None) -> str:
     """Prometheus text for many hosts' :func:`snapshot` dumps, each
     sample labeled with its source ``node`` — the cluster-wide exposition
     endpoint (one scrape covers every host, the reference's per-node
-    metrics agents rolled up by the dashboard)."""
+    metrics agents rolled up by the dashboard). Hosts the head could not
+    reach this scrape surface as ``federation_missing_hosts`` samples so
+    alerting can distinguish "node quiet" from "node unscraped"."""
     lines = []
     typed = set()
     for node, families in snapshots.items():
@@ -212,6 +215,14 @@ def render_federated(snapshots: Dict[str, List[dict]]) -> str:
                 merged = (("node", node),) + tuple(
                     (k, v) for k, v in tags)
                 lines.append(f"{name}{_fmt_tags(merged)} {value}")
+    if missing_hosts:
+        lines.append("# HELP federation_missing_hosts Hosts registered "
+                     "alive but unreachable during this federated scrape")
+        lines.append("# TYPE federation_missing_hosts gauge")
+        for h in missing_hosts:
+            tags = (("node", str(h.get("node_id", ""))[:8]),
+                    ("address", str(h.get("address", ""))))
+            lines.append(f"federation_missing_hosts{_fmt_tags(tags)} 1.0")
     return "\n".join(lines) + "\n"
 
 

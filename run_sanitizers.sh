@@ -59,6 +59,17 @@ JAX_PLATFORMS=cpu \
 python -m pytest tests/test_core.py tests/test_actors.py tests/test_data_plane.py \
     tests/test_checkpoint.py tests/test_tracing.py -q
 
+echo "== forensics gate (crash bundles sealed + doctor reads them back) =="
+# Hard-death drill: the forensics suite kills processes mid-task — via a
+# deterministic chaos exit schedule (hooks run) and via raw SIGKILL (no
+# hooks run) — then asserts a sealed crash bundle exists and that
+# `python -m ray_tpu.doctor --json` reconstructs the in-flight trace_id,
+# last log lines and exit reason from it. The ProcessCluster variants
+# self-skip where the C++ state service can't build; the subprocess
+# variants cover both sealing paths everywhere.
+JAX_PLATFORMS=cpu \
+python -m pytest tests/test_forensics.py -q
+
 echo "== bench regression gate (bench_micro --check vs tracked baseline) =="
 # Throughput must stay within --tolerance of BENCH_MICRO.json; latency
 # (_us) metrics are inverted. Cluster metrics are skipped automatically

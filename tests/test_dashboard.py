@@ -29,6 +29,15 @@ def _get(port, path):
         return json.loads(r.read())
 
 
+def _require_state_service():
+    """ProcessCluster needs the C++ state service (protoc + g++)."""
+    from ray_tpu._native.build import build_state_service
+    try:
+        build_state_service()
+    except Exception as e:
+        pytest.skip(f"state service unavailable: {e}")
+
+
 def test_dashboard_cluster_and_reporter_stats(cluster):
     head = start_dashboard(cluster.address)
     try:
@@ -133,6 +142,59 @@ def test_dashboard_node_debug_logs_and_tasks(cluster):
         assert "error" in d
     finally:
         head.stop()
+
+
+def test_federation_partial_failure_returns_missing_hosts():
+    """The federation endpoints must DEGRADE when a daemon dies, not
+    error: /api/timeline and /api/metrics return the surviving hosts'
+    data plus a ``missing_hosts`` entry for the corpse (still marked
+    alive in the state service until its heartbeat times out), and the
+    Prometheus exposition advertises the gap as a sample."""
+    _require_state_service()
+    ray_tpu.shutdown()
+    c = ProcessCluster(num_daemons=2, num_cpus=2)
+    try:
+        ray_tpu.init(address=c.address)
+
+        @ray_tpu.remote
+        def touch():
+            return 1
+
+        assert ray_tpu.get([touch.remote() for _ in range(4)],
+                           timeout=60) == [1] * 4
+        from ray_tpu.dashboard import start_dashboard
+        head = start_dashboard(c.address)
+        try:
+            # healthy baseline: both daemons answer, nothing missing
+            tl = _get(head.port, "/api/timeline")
+            assert tl["missing_hosts"] == []
+            assert isinstance(tl["traceEvents"], list)
+            mx = _get(head.port, "/api/metrics")
+            assert mx["missing_hosts"] == []
+            assert "head" in mx["snapshots"]
+            n_sources = len(mx["snapshots"])
+
+            c.kill_daemon(0)  # SIGKILL: still registered alive for a beat
+
+            tl = _get(head.port, "/api/timeline")   # not a 500
+            assert "error" not in tl
+            assert len(tl["missing_hosts"]) == 1
+            assert tl["missing_hosts"][0]["node_id"]
+            assert tl["missing_hosts"][0]["error"]
+            mx = _get(head.port, "/api/metrics")
+            assert len(mx["missing_hosts"]) == 1
+            # survivors still report (head + the remaining daemon)
+            assert len(mx["snapshots"]) == n_sources - 1
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{head.port}/metrics",
+                    timeout=10) as r:
+                text = r.read().decode()
+            assert "federation_missing_hosts{" in text
+        finally:
+            head.stop()
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
 
 
 def test_dashboard_actor_detail(cluster):

@@ -57,6 +57,23 @@ def test_counter_rejects_negative_and_unknown_tags():
         c.inc(tags={"bogus": "x"})
 
 
+def test_render_federated_marks_missing_hosts():
+    """Unreachable hosts surface as federation_missing_hosts samples so
+    one scrape distinguishes 'node quiet' from 'node unscraped'."""
+    from ray_tpu.util.metrics import render_federated, snapshot
+    Counter("fed_total").inc(2)
+    snaps = {"head": snapshot()}
+    missing = [{"node_id": "ab12cd34ef567890", "address": "127.0.0.1:1",
+                "error": "connection refused"}]
+    text = render_federated(snaps, missing_hosts=missing)
+    assert 'fed_total{node="head"} 2.0' in text
+    assert '# TYPE federation_missing_hosts gauge' in text
+    assert ('federation_missing_hosts{node="ab12cd34",'
+            'address="127.0.0.1:1"} 1.0') in text
+    # no missing hosts → no placeholder family at all
+    assert "federation_missing_hosts" not in render_federated(snaps)
+
+
 def test_metrics_server_scrape():
     Counter("scrape_total").inc(5)
     port = start_metrics_server()
