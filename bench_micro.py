@@ -281,6 +281,65 @@ def bench_checkpoint(mb: int = 64):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_drain(mb: int = 32):
+    """Graceful-drain migration path on a live 3-daemon ProcessCluster:
+    drain the node holding an actor and a sole-copy ``mb``-MiB object
+    while tasks keep arriving. ``drain_migration_gbps`` times notice ->
+    decommission (quiesce + checkpoint + sole-copy PUSH_OBJECT, so it
+    lower-bounds the migration plane); ``drain_zero_loss`` is the binary
+    gate — 1.0 only when every task completed AND the object survived."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import ProcessCluster
+    ray_tpu.shutdown()
+    c = ProcessCluster(num_daemons=3, num_cpus=float(os.cpu_count() or 8))
+    ray_tpu.init(address=c.address)
+    try:
+        rt = ray_tpu._private.worker.global_worker().runtime
+
+        @ray_tpu.remote(max_restarts=1)
+        class Holder:
+            def where(self):
+                import ray_tpu._private.worker as w
+                return w.global_worker().runtime.local_node.node_id.hex()
+
+            def blob(self):
+                return np.zeros((mb, 1024, 1024), np.uint8)
+
+        h = Holder.remote()
+        victim = ray_tpu.get(h.where.remote(), timeout=60)
+        ref = h.blob.remote()           # sole copy on the victim node
+        ray_tpu.wait([ref], timeout=120)
+
+        @ray_tpu.remote(max_retries=3)
+        def tick(i):
+            time.sleep(0.05)
+            return i
+
+        n = 200
+        refs = [tick.remote(i) for i in range(n)]
+        t0 = time.perf_counter()
+        ray_tpu.drain_node(victim, reason="bench", deadline_s=60.0)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            info = {x.node_id.hex(): x for x in rt.state.list_nodes()}
+            nd = info.get(victim)
+            if nd is not None and not nd.alive:
+                break
+            time.sleep(0.1)
+        el = time.perf_counter() - t0
+        out = ray_tpu.get(refs, timeout=180)
+        arr = ray_tpu.get(ref, timeout=120)
+        nbytes = arr.nbytes
+        del arr
+        emit("drain_migration_gbps", nbytes / el / 1e9, "GB/s")
+        emit("drain_zero_loss",
+             1.0 if (sorted(out) == list(range(n))
+                     and nbytes == mb * 1024 * 1024) else 0.0, "bool")
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
 def run_inproc():
     import ray_tpu
     ray_tpu.shutdown()
@@ -370,6 +429,7 @@ def main():
         bench_checkpoint()   # filesystem-local; no cluster involved
     if args.mode in ("cluster", "both"):
         run_cluster()
+        bench_drain()   # graceful-drain migration + zero-loss gate
     if args.out:
         with open(args.out, "w") as f:
             json.dump(RESULTS, f, indent=1)

@@ -19,8 +19,9 @@ if _os.environ.get("RAY_TPU_LOCKWATCH"):
 
 from ray_tpu._private.config import _config  # noqa: F401
 from ray_tpu._private.worker import (available_resources, cancel,
-                                     cluster_resources, get, get_actor, init,
-                                     is_initialized, kill, nodes, put,
+                                     cluster_resources, drain_node, get,
+                                     get_actor, init, is_initialized, kill,
+                                     nodes, put,
                                      register_named_actor_class,
                                      register_named_function,
                                      set_profiling_enabled,
@@ -39,6 +40,7 @@ __version__ = "0.1.0"
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "kill", "cancel", "get_actor", "available_resources", "cluster_resources",
+    "drain_node",
     "register_named_actor_class",
     "register_named_function", "set_profiling_enabled",
     "set_tracing_enabled",

@@ -420,6 +420,8 @@ class StateService {
         return HandleListNodes(fd, env);
       case raytpu::MARK_NODE_DEAD:
         return HandleMarkNodeDead(fd, env);
+      case raytpu::DRAIN_NODE:
+        return HandleDrainNode(fd, env);
       case raytpu::KV_PUT:
         return HandleKvPut(fd, env);
       case raytpu::KV_GET:
@@ -481,6 +483,11 @@ class StateService {
     raytpu::NodeInfo info = req.info();
     info.set_alive(true);
     info.set_last_heartbeat_ms(now_ms());
+    // A (re-)registration is a fresh lifecycle: any stale DRAINING/DRAINED
+    // marker from a previous incarnation of this node id is cleared.
+    info.clear_state();
+    info.clear_drain_deadline_ms();
+    info.clear_drain_reason();
     nodes_[info.node_id()] = info;
     hb_deadline_[info.node_id()] = mono_ms() + hb_timeout_ms_;
   }
@@ -512,6 +519,14 @@ class StateService {
       rep.set_recognized(false);  // node must re-register
     } else {
       rep.set_recognized(true);
+      // Drain signal rides the ack: the node learns it is DRAINING even
+      // when the NODE_DRAINING pubsub push was lost or predates its
+      // subscription.
+      if (!it->second.state().empty()) {
+        rep.set_node_state(it->second.state());
+        rep.set_drain_deadline_ms(it->second.drain_deadline_ms());
+        rep.set_drain_reason(it->second.drain_reason());
+      }
       it->second.set_last_heartbeat_ms(now_ms());
       if (req.has_available()) {
         // Delta broadcast (ray_syncer role): CHANGED availability pushes
@@ -556,6 +571,12 @@ class StateService {
     if (it != nodes_.end()) {
       it->second.set_alive(false);
       it->second.set_death_reason(req.reason());
+      // A node that died while DRAINING completed (or forfeited) its
+      // lifecycle: terminal state is DRAINED either way — the drain
+      // orchestrator's mark_node_dead and a mid-drain heartbeat timeout
+      // are distinguished by death_reason, not state.
+      if (it->second.state() == "DRAINING")
+        it->second.set_state("DRAINED");
     }
     hb_deadline_.erase(req.node_id());
     // Objects on a dead node are gone.
@@ -594,6 +615,41 @@ class StateService {
     }
     Publish("nodes", "NODE_DEAD", info_bytes);
     counters_["nodes_dead"]++;
+  }
+
+  void ApplyDrainNode(const raytpu::DrainNodeRequest& req) {
+    auto it = nodes_.find(req.node_id());
+    if (it == nodes_.end() || !it->second.alive()) return;
+    it->second.set_state("DRAINING");
+    it->second.set_drain_reason(req.reason());
+    it->second.set_drain_deadline_ms(now_ms() + req.deadline_s() * 1e3);
+    // Heartbeats keep flowing while draining; the sweep still catches a
+    // node that dies mid-drain (MarkDead flips DRAINING -> DRAINED).
+  }
+
+  void HandleDrainNode(int fd, const raytpu::Envelope& env) {
+    raytpu::DrainNodeRequest req;
+    if (!req.ParseFromString(env.body()))
+      return ReplyError(fd, env, "bad DrainNodeRequest");
+    auto it = nodes_.find(req.node_id());
+    if (it == nodes_.end())
+      return ReplyError(fd, env, "unknown node");
+    if (!it->second.alive())
+      return ReplyError(fd, env, "node already dead");
+    bool was_draining = it->second.state() == "DRAINING";
+    ApplyDrainNode(req);
+    // Idempotent: a second drain request (watcher + operator racing)
+    // refreshes reason/deadline but is only journaled/published once per
+    // transition so subscribers see one NODE_DRAINING per lifecycle.
+    if (!was_draining) {
+      Journal(raytpu::DRAIN_NODE, env.body());
+      std::string info_bytes;
+      it->second.SerializeToString(&info_bytes);
+      Publish("nodes", "NODE_DRAINING", info_bytes);
+      counters_["nodes_draining"]++;
+    }
+    raytpu::Empty e;
+    Reply(fd, env, e);
   }
 
   void HandleMarkNodeDead(int fd, const raytpu::Envelope& env) {
@@ -979,6 +1035,11 @@ class StateService {
       case raytpu::MARK_NODE_DEAD: {
         raytpu::MarkNodeDeadRequest req;
         if (req.ParseFromString(rec.body())) ApplyMarkNodeDead(req);
+        break;
+      }
+      case raytpu::DRAIN_NODE: {
+        raytpu::DrainNodeRequest req;
+        if (req.ParseFromString(rec.body())) ApplyDrainNode(req);
         break;
       }
       case raytpu::KV_PUT: {

@@ -240,3 +240,23 @@ _config.define("flight_recorder_tail_events", int, 256,
 _config.define("flight_recorder_retention_s", int, 3600,
                "dead recordings (clean exits and sealed bundles) older than "
                "this are pruned at the next recorder install")
+
+# -- Node lifecycle / graceful drain --------------------------------------------
+_config.define("drain_deadline_s", float, 30.0,
+               "default drain budget when none is supplied: in-flight work "
+               "gets this long to finish before the node decommissions")
+_config.define("drain_poll_ms", int, 50,
+               "drain orchestrator poll period while waiting for in-flight "
+               "tasks to quiesce")
+_config.define("drain_checkpoint_root", str, "/tmp/ray_tpu_drain",
+               "shared directory for drained-actor snapshots; must be "
+               "reachable from the surviving nodes (NFS on real fleets)")
+_config.define("preempt_probe_url", str, "",
+               "GCE-metadata-style preemption probe URL polled by the host "
+               "daemon; a 200 response with a body other than NONE/FALSE "
+               "triggers a self-drain. Empty disables the probe.")
+_config.define("preempt_lead_s", float, 10.0,
+               "drain budget requested when the preemption watcher fires "
+               "(eviction lead time promised by the provider)")
+_config.define("preempt_poll_ms", int, 500,
+               "preemption watcher poll period in the host daemon")

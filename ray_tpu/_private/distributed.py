@@ -382,6 +382,21 @@ class DistributedRuntime(Runtime):
             "peer_breaker_state",
             "per-peer circuit breaker state (0=closed 1=half-open 2=open)",
             tag_keys=("peer",))
+        # Node lifecycle: ALIVE -> DRAINING -> DRAINED/DEAD. begin_drain()
+        # is the single entry point (DRAIN rpc, NODE_DRAINING pubsub,
+        # heartbeat-ack signal, preemption watcher) and is idempotent.
+        self._drain_lock = threading.Lock()
+        self._drain_started = False
+        self._drain_progress: Dict[str, Any] = {}
+        self._node_state_gauge = _metrics.Gauge(
+            "node_state",
+            "node lifecycle state (0=alive 1=draining 2=drained)",
+            tag_keys=("node",)).set_default_tags({"node": node_tag})
+        self._node_state_gauge.set(0)
+        self._drain_migrated_gauge = _metrics.Gauge(
+            "drain_objects_migrated",
+            "sole-copy objects re-replicated to healthy peers during drain",
+            tag_keys=("node",)).set_default_tags({"node": node_tag})
 
         # Register with the state service.
         info = pb.NodeInfo(node_id=self.local_node.node_id.binary(),
@@ -674,7 +689,7 @@ class DistributedRuntime(Runtime):
                                     max_s=max(4 * self._hb_interval, 5.0),
                                     deadline_s=0)
         node_tag = self.local_node.node_id.hex()[:8]
-        if not is_driver:
+        if not self.is_driver:
             # obs spans recorded in this daemon (rpc dispatch, fetches,
             # checkpoint stages) group under the node's timeline row
             observability.set_process_label(f"node:{node_tag}")
@@ -690,8 +705,16 @@ class DistributedRuntime(Runtime):
                 total = self.local_node.resources.total.to_dict()
                 now = self.local_node.resources.available.to_dict()
                 avail = {k: now.get(k, 0.0) for k in total}
-                recognized = self.state.heartbeat(
+                hb = self.state.heartbeat_ex(
                     self.local_node.node_id.binary(), avail)
+                recognized = hb.recognized
+                if recognized and hb.node_state == "DRAINING":
+                    # Belt-and-braces drain delivery: the signal rides the
+                    # heartbeat ack so a lost NODE_DRAINING pubsub push
+                    # cannot strand a node in ALIVE while the scheduler
+                    # already shuns it.
+                    self.begin_drain(hb.drain_reason or "state service",
+                                     deadline_ms=hb.drain_deadline_ms)
                 if not recognized:
                     # State service restarted: re-register + re-publish our
                     # object locations (raylet-notify-GCS-restart analogue).
@@ -775,6 +798,22 @@ class DistributedRuntime(Runtime):
         info.ParseFromString(ev.payload)
         if ev.kind == "NODE_DEAD":
             self._handle_remote_node_death(info)
+        elif ev.kind == "NODE_DRAINING":
+            if info.node_id == self.local_node.node_id.binary():
+                self.begin_drain(info.drain_reason or "state service",
+                                 deadline_ms=info.drain_deadline_ms)
+            else:
+                # Peer is draining: flip the cached view entry NOW so the
+                # next placement pass shuns it (the polled view refresh
+                # would take up to a second to notice).
+                with self._view_lock:
+                    known = self._view.get(info.node_id)
+                    if known is not None:
+                        known.state = "DRAINING"
+                    else:
+                        self._view[info.node_id] = info
+                    self._states_memo = None
+                self._kick()
         elif ev.kind == "NODE_ADDED":
             if info.node_id != self.local_node.node_id.binary():
                 with self._view_lock:
@@ -841,7 +880,302 @@ class DistributedRuntime(Runtime):
         self.emit_event("NODE_DEAD", node_id=info.node_id.hex())
         self._kick()
 
+    # ------------------------------------------------------------------ drain
+
+    @property
+    def draining(self) -> bool:
+        return self._drain_started
+
+    def begin_drain(self, reason: str = "", deadline_ms: float = 0.0,
+                    deadline_s: Optional[float] = None) -> bool:
+        """Start this node's graceful drain (idempotent; first call wins).
+
+        Reached from every delivery path — the DRAIN rpc, the
+        NODE_DRAINING pubsub push, the drain signal riding the heartbeat
+        ack, and the host daemon's preemption watcher. ``deadline_ms`` is
+        epoch milliseconds (the state service's absolute form);
+        ``deadline_s`` is a relative budget and wins when both are given.
+        Returns True when this call started the drain."""
+        with self._drain_lock:
+            if self._drain_started:
+                return False
+            self._drain_started = True
+        if deadline_s is not None and deadline_s > 0:
+            budget = deadline_s
+        elif deadline_ms > 0:
+            budget = max(0.0, deadline_ms / 1e3 - time.time())
+        else:
+            budget = _config.get("drain_deadline_s")
+        deadline = time.monotonic() + budget
+        self.local_node.draining = True
+        with self._view_lock:
+            self._states_memo = None  # placement must see the flip NOW
+        self._node_state_gauge.set(1)
+        if observability.ENABLED:
+            observability.instant("drain:begin", cat="drain", reason=reason,
+                                  budget_s=round(budget, 3))
+        self.emit_event("NODE_DRAINING",
+                        node_id=self.local_node.node_id.hex(), reason=reason)
+        try:
+            # Tell the cluster (no-op re-drain when the signal came FROM
+            # the state service): peers' schedulers shun us, the doctor
+            # reports progress instead of a hang.
+            self.state.drain_node(self.local_node.node_id.binary(), reason,
+                                  deadline_s=budget)
+        except Exception as e:
+            logger.debug("drain_node publish failed: %s", e)
+        t = threading.Thread(target=self._drain_worker,
+                             args=(reason, deadline), daemon=True,
+                             name="dist-drain")
+        t.start()
+        return True
+
+    def _drain_worker(self, reason: str, deadline: float):
+        """The drain orchestrator: quiesce -> checkpoint actors ->
+        re-replicate sole-copy objects -> decommission. Every phase is
+        bounded by the drain deadline; whatever does not finish in time is
+        recovered by the existing node-death machinery (resubmission,
+        actor restart) — slower, but never lost."""
+        try:
+            self._drain_progress = {
+                "node": self.local_node.node_id.hex(), "reason": reason,
+                "phase": "quiesce", "tasks_pending": 0,
+                "actors_checkpointed": 0, "objects_migrated": 0,
+                "started": time.time(),
+                "deadline": time.time() + max(0.0,
+                                              deadline - time.monotonic()),
+            }
+            self._publish_drain_progress()
+            self._drain_quiesce_tasks(deadline)
+            self._drain_progress["phase"] = "actors"
+            self._publish_drain_progress()
+            n_actors = self._drain_checkpoint_actors(reason, deadline)
+            self._drain_progress["actors_checkpointed"] = n_actors
+            self._drain_progress["phase"] = "objects"
+            self._publish_drain_progress()
+            n_objects = self._drain_migrate_objects(deadline)
+            self._drain_progress["objects_migrated"] = n_objects
+            self._drain_progress["phase"] = "decommission"
+            self._publish_drain_progress()
+        except Exception:
+            logger.exception("drain orchestrator failed; decommissioning "
+                             "anyway (node-death recovery takes over)")
+        try:
+            self.state.mark_node_dead(self.local_node.node_id.binary(),
+                                      f"drained: {reason}" if reason
+                                      else "drained")
+        except Exception as e:
+            logger.debug("drained mark_node_dead failed: %s", e)
+        self._node_state_gauge.set(2)
+        if observability.ENABLED:
+            observability.instant("drain:decommission", cat="drain",
+                                  reason=reason)
+        self._decommission(reason)
+
+    def _drain_quiesce_tasks(self, deadline: float):
+        """Let admitted work finish: new pushes are already being spilled
+        back (the callers' backoff path re-routes them), so this just
+        waits for the local pending queue and running tasks to empty, up
+        to the deadline."""
+        poll = max(0.005, _config.get("drain_poll_ms") / 1e3)
+        while time.monotonic() < deadline:
+            with self._pending_cv:
+                pending = len(self._pending) + self._dispatch_pass_n
+            with self.lock:
+                running = sum(1 for s in self.task_states.values()
+                              if s in ("PENDING", "RUNNING", "RESUBMITTED"))
+            self._drain_progress["tasks_pending"] = pending + running
+            if pending == 0 and running == 0:
+                if observability.ENABLED:
+                    observability.instant("drain:quiesced", cat="drain")
+                return
+            time.sleep(poll)
+        logger.warning("drain deadline hit with work still in flight; "
+                       "callers will resubmit via the node-death path")
+
+    def _drain_checkpoint_actors(self, reason: str, deadline: float) -> int:
+        """Snapshot every hosted actor through the checkpoint engine and
+        leave a pointer in the state KV (namespace ``drain``): the restart
+        machinery re-places the actor on a healthy node, whose
+        ``_restore_drained_actor`` hook resumes it from the snapshot
+        instead of re-running ``__init__``."""
+        import numpy as np
+        from ray_tpu.checkpoint import CheckpointEngine
+        count = 0
+        for state in list(self.actors.values()):
+            if state.instance is None or state.status != ActorState.ALIVE:
+                continue
+            if time.monotonic() > deadline:
+                logger.warning("drain deadline hit before actor %s was "
+                               "checkpointed; it restarts from __init__",
+                               state.cls.__name__)
+                break
+            try:
+                prep = getattr(state.instance, "prepare_for_shutdown", None)
+                if callable(prep):
+                    prep()
+                blob = cloudpickle.dumps(state.instance)
+                root = os.path.join(_config.get("drain_checkpoint_root"),
+                                    state.actor_id.hex())
+                eng = CheckpointEngine(root)
+                manifest = eng.save(
+                    {"actor_pickle": np.frombuffer(blob, dtype=np.uint8)},
+                    step=int(state.restart_count), wait=True).result()
+                rec = json.dumps({
+                    "root": root, "manifest": manifest,
+                    "cls": state.cls.__name__, "reason": reason,
+                    "node": self.local_node.node_id.hex()}).encode()
+                self.state.kv_put(b"actor:" + state.actor_id.binary(), rec,
+                                  namespace=b"drain")
+                count += 1
+                if observability.ENABLED:
+                    observability.instant(
+                        "drain:actor_checkpointed", cat="drain",
+                        actor=state.cls.__name__, bytes=len(blob))
+            except Exception:
+                logger.exception("drain checkpoint failed for actor %s; it "
+                                 "restarts from __init__",
+                                 state.cls.__name__)
+        return count
+
+    def _restore_drained_actor(self, state: ActorState):
+        """Runtime hook (see runtime.py _init_and_loop): a restarting
+        actor whose previous host drained resumes from its snapshot —
+        migration, not reconstruction."""
+        key = b"actor:" + state.actor_id.binary()
+        try:
+            rec = self.state.kv_get(key, namespace=b"drain")
+        except Exception:  # noqa: BLE001  # raylint: allow(swallow) no KV record reachable -> fresh __init__ is the documented fallback
+            return None
+        if rec is None:
+            return None
+        try:
+            meta = json.loads(rec.decode())
+            from ray_tpu.checkpoint import load as _ckpt_load
+            tree = _ckpt_load(meta["root"], meta["manifest"])
+            instance = cloudpickle.loads(tree["actor_pickle"].tobytes())
+            resume = getattr(instance, "resume_after_drain", None)
+            if callable(resume):
+                resume()  # e.g. clear a drain-rejection flag
+            self.state.kv_del(key, namespace=b"drain")
+            self.emit_event("ACTOR_DRAIN_RESTORED",
+                            actor=state.cls.__name__)
+            if observability.ENABLED:
+                observability.instant("drain:actor_restored", cat="drain",
+                                      actor=state.cls.__name__)
+            return instance
+        except Exception:
+            logger.exception("drained-actor restore failed for %s; "
+                             "constructing fresh", state.cls.__name__)
+            return None
+
+    def _drain_migrate_objects(self, deadline: float) -> int:
+        """Re-replicate objects whose ONLY live copy is here to healthy
+        peers over the data plane (receiver registers itself as a location
+        on eof) — migration instead of lineage re-execution."""
+        my_id = self.local_node.node_id.binary()
+        peers: List[Tuple[bytes, str]] = []
+        holders_alive = set()
+        with self._view_lock:
+            for nid, info in self._view.items():
+                if info.alive:
+                    holders_alive.add(nid)
+                    if info.state != "DRAINING" and info.address:
+                        peers.append((nid, info.address))
+        if not peers:
+            logger.warning("drain: no healthy peer to migrate objects to")
+            return 0
+        migrated = 0
+        skipped = 0
+        oids = list(self.local_node.store.object_ids())
+        for i, oid in enumerate(oids):
+            if time.monotonic() > deadline:
+                skipped = len(oids) - i
+                break
+            try:
+                if self.local_node.store.peek_error(oid) is not None:
+                    continue  # error markers re-raise at the caller anyway
+                locs = self.state.get_locations(oid.binary())
+                if any(n != my_id and n in holders_alive
+                       for n in locs.node_ids):
+                    continue  # another live copy exists: nothing to do
+                _nid, addr = peers[i % len(peers)]
+                if self._drain_push_object(oid, addr):
+                    migrated += 1
+                    self._drain_migrated_gauge.set(migrated)
+                    self._drain_progress["objects_migrated"] = migrated
+            except Exception as e:
+                logger.warning("drain migration failed for %s: %s",
+                               oid.hex()[:8], e)
+        if observability.ENABLED:
+            observability.instant("drain:objects_migrated", cat="drain",
+                                  migrated=migrated, skipped=skipped)
+        if skipped:
+            logger.warning("drain deadline hit with %d objects unmigrated "
+                           "(lineage re-execution covers them)", skipped)
+        return migrated
+
+    def _drain_push_object(self, oid: ObjectID, addr: str) -> bool:
+        """Synchronous full-object push (the _PushManager loop without the
+        threshold or the fire-and-forget pool: the orchestrator needs the
+        success signal for its zero-loss accounting)."""
+        payload = self._serialized_for_fetch(oid)
+        total = len(payload)
+        client = self.pool.get(addr)
+        chunk_sz = _fetch_chunk()
+        offset = 0
+        while offset < total or offset == 0:
+            end = min(total, offset + chunk_sz)
+            eof = end >= total
+            rep = pb.PushObjectReply()
+            rep.ParseFromString(client.call(
+                pb.PUSH_OBJECT, pb.PushObjectRequest(
+                    object_id=oid.binary(), offset=offset,
+                    total_size=total, eof=eof).SerializeToString(),
+                timeout=120, raw=payload.slices(offset, end)).body)
+            if not rep.accepted:
+                # first-chunk rejection = receiver already holds it (a
+                # copy exists after all); mid-stream = failed transfer
+                return offset == 0
+            offset = end
+            if eof:
+                return True
+        return False
+
+    def _publish_drain_progress(self):
+        """Doctor-visible progress record in the state KV."""
+        try:
+            self.state.kv_put(
+                b"progress:" + self.local_node.node_id.binary(),
+                json.dumps(self._drain_progress).encode(),
+                namespace=b"drain")
+        except Exception as e:
+            logger.debug("drain progress publish failed: %s", e)
+
+    def _decommission(self, reason: str):
+        """Orderly exit: stop accepting connections, let in-flight replies
+        finish, close the flight recorder as a DELIBERATE shutdown (no
+        crash bundle for a planned drain), then tear the runtime down."""
+        try:
+            self.server.quiesce()
+        except Exception as e:
+            logger.debug("server quiesce failed: %s", e)
+        try:
+            from ray_tpu.observability import recorder as _flight
+            rec = _flight.get_recorder()
+            if rec is not None:
+                rec.close(clean=True)
+        except Exception as e:
+            logger.debug("recorder close failed: %s", e)
+        self.shutdown()
+
     def shutdown(self):
+        # Idempotent: the drain orchestrator's decommission and the host
+        # daemon's exit path both land here.
+        with self._drain_lock:
+            if getattr(self, "_shutdown_done", False):
+                return
+            self._shutdown_done = True
         self._hb_stop.set()
         if self.memory_monitor is not None:
             self.memory_monitor.stop()
@@ -1411,9 +1745,10 @@ class DistributedRuntime(Runtime):
                 if nr is None:
                     nr = NodeResources(ResourceSet(dict(info.total.amounts)))
                     self._view_avail[nid] = nr
-                states.append(NodeState(NodeID(nid), nr, True))
-        if not include_suspects:
-            self._states_memo = (now, states)
+                states.append(NodeState(NodeID(nid), nr, True,
+                                        draining=info.state == "DRAINING"))
+            if not include_suspects:
+                self._states_memo = (now, states)
         return states
 
     def _select_node(self, spec: TaskSpec) -> Optional[NodeID]:
@@ -2645,6 +2980,13 @@ class DistributedRuntime(Runtime):
             req.ParseFromString(ctx.body)
             oid = ObjectID(req.object_id)
             deadline = time.monotonic() + req.timeout_ms / 1e3
+            # Seal-event wait with BackoffPolicy pacing (not a fixed
+            # 0.25s re-check): early attempts wake fast for objects that
+            # land promptly, later ones settle toward the cap instead of
+            # spinning a worker thread for the whole timeout.
+            pace = BackoffPolicy(base_s=0.005, max_s=0.25, deadline_s=0,
+                                 jitter=False)
+            attempt = 0
             ready = False
             while time.monotonic() < deadline:
                 if self.local_node.store.contains(oid):
@@ -2652,11 +2994,24 @@ class DistributedRuntime(Runtime):
                     break
                 self._wait_for_seal(
                     lambda: self.local_node.store.contains(oid),
-                    min(0.25, max(0.0, deadline - time.monotonic())))
+                    min(max(0.001, pace.delay_for(attempt)),
+                        max(0.0, deadline - time.monotonic())))
+                attempt += 1
             ctx.reply(pb.WaitObjectReply(ready=ready).SerializeToString())
         elif method == pb.DRAIN:
+            # Graceful drain request straight to this daemon. An empty
+            # body parses as the default DrainNodeRequest — the legacy
+            # kill-style DRAIN — which now ALSO runs the orchestrator
+            # (idle daemons decommission just as fast, busy ones stop
+            # dropping in-flight work).
+            req = pb.DrainNodeRequest()
+            try:
+                req.ParseFromString(ctx.body)
+            except Exception:  # noqa: BLE001  # raylint: allow(swallow) legacy/garbage body: the default DrainNodeRequest is the kill-compatible drain
+                pass
             ctx.reply()
-            threading.Thread(target=self.shutdown, daemon=True).start()
+            self.begin_drain(req.reason or "DRAIN rpc",
+                             deadline_s=req.deadline_s or None)
         else:
             ctx.reply_error(f"unhandled method {method}")
 
@@ -2792,6 +3147,12 @@ class DistributedRuntime(Runtime):
                 # cross-language caller: it cannot unpickle the error
                 rep.error_message = f"{type(e).__name__}: {e}"
             ctx.reply(rep.SerializeToString())
+            return
+        if self._drain_started:
+            # DRAINING: hand the task straight back (saturated spillback
+            # advertises zero availability, so the caller's view
+            # deprioritizes us) — the PR 2 backoff path re-routes it.
+            self._spillback_reply(ctx, saturated=True)
             return
         if not self._admission_check(spec.options.resources):
             self._spillback_reply(ctx)
@@ -2952,6 +3313,11 @@ class DistributedRuntime(Runtime):
     def _handle_create_actor(self, ctx: RpcContext):
         msg = pb.ActorSpecMsg()
         msg.ParseFromString(ctx.body)
+        if self._drain_started:
+            # DRAINING: never host a new actor on a node about to die.
+            ctx.reply(pb.CreateActorReply(
+                status="spillback").SerializeToString())
+            return
         try:
             cls = self._load_callable(bytes(msg.cls_hash))
             args, kwargs = cloudpickle.loads(msg.args_pickle)

@@ -61,6 +61,40 @@ def _install_thread_profiler(out_dir: str):
     atexit.register(dump)
 
 
+def _preempt_signaled(node_tag: str) -> "str | None":
+    """One poll of the pluggable preemption watcher. Two sources, checked
+    in order:
+
+    - the ``node.preempt`` chaos point — the deterministic test vehicle
+      (a "drop" return IS the eviction notice; side-effect-free, so the
+      signal composes with any other chaos running); and
+    - ``preempt_probe_url`` — a GCE-metadata-style HTTP probe for real
+      TPU VMs (``.../instance/preempted`` returns TRUE once the eviction
+      is scheduled; anything but NONE/FALSE counts as a notice).
+
+    Returns the drain reason, or None when no preemption is pending.
+    """
+    from ray_tpu import chaos
+    if chaos.ENABLED and chaos.inject("node.preempt",
+                                      node=node_tag) == "drop":
+        return "preemption notice (chaos)"
+    from ray_tpu._private.config import _config
+    url = _config.get("preempt_probe_url")
+    if url:
+        try:
+            import urllib.request
+            req = urllib.request.Request(
+                url, headers={"Metadata-Flavor": "Google"})
+            with urllib.request.urlopen(req, timeout=1.0) as resp:
+                body = resp.read(256).decode(
+                    "utf-8", "replace").strip().upper()
+            if body not in ("", "NONE", "FALSE"):
+                return f"preemption notice (probe: {body[:40]})"
+        except Exception:  # noqa: BLE001  # raylint: allow(swallow) probe outage must not kill the watcher; next poll retries
+            pass
+    return None
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="ray_tpu host daemon")
     parser.add_argument("--state-addr", required=True,
@@ -164,11 +198,27 @@ def main(argv=None) -> int:
 
     # Posthumous-sealing sweep: a surviving daemon on the host seals crash
     # bundles for siblings that died without running their hooks (SIGKILL).
+    from ray_tpu._private.config import _config
+    node_tag = runtime.local_node.node_id.hex()[:8]
+    preempt_poll_s = max(0.1, _config.get("preempt_poll_ms") / 1e3)
     next_sweep = time.monotonic() + 2.0
+    next_preempt_probe = time.monotonic() + preempt_poll_s
     try:
         while not stop["flag"] and not runtime._hb_stop.is_set():
             # raylint: allow(bare-retry) serve-loop pacing, not a retry: the swallowed sweep is periodic best-effort work
             time.sleep(0.2)
+            # Preemption watcher: an eviction notice starts the graceful
+            # drain (workload migration) instead of waiting to be killed.
+            if (not runtime.draining
+                    and time.monotonic() >= next_preempt_probe):
+                next_preempt_probe = time.monotonic() + preempt_poll_s
+                reason = _preempt_signaled(node_tag)
+                if reason:
+                    logging.warning("preemption notice: draining node %s "
+                                    "(%s)", node_tag, reason)
+                    runtime.begin_drain(
+                        reason,
+                        deadline_s=_config.get("preempt_lead_s"))
             if recorder is not None and time.monotonic() >= next_sweep:
                 next_sweep = time.monotonic() + 2.0
                 try:

@@ -648,6 +648,7 @@ class RpcServer:
         self._conns: Dict[int, Tuple[socket.socket, threading.Lock]] = {}
         self._conn_lock = threading.Lock()
         self._closed = False
+        self._quiesced = False
         self._on_disconnect: Optional[Callable[[int], None]] = None
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True,
@@ -656,6 +657,17 @@ class RpcServer:
 
     def set_on_disconnect(self, cb: Callable[[int], None]):
         self._on_disconnect = cb
+
+    def quiesce(self):
+        """Stop accepting NEW connections while established ones (and the
+        worker pool) keep running: in-flight requests finish and reply
+        normally. First phase of a graceful drain; ``close()`` stays the
+        hard stop."""
+        self._quiesced = True
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
 
     def close(self):
         self._closed = True

@@ -120,6 +120,7 @@ class Node:
         self.store = ObjectStore(self.node_id)
         self.labels = labels or {}
         self.alive = True
+        self.draining = False  # lifecycle: still alive, shun new placement
         self._pool = ThreadPoolExecutor(
             max_workers=_MAX_NODE_THREADS,
             thread_name_prefix=f"node-{self.node_id.hex()[:6]}")
@@ -130,7 +131,8 @@ class Node:
         self._pool.submit(fn, *args)
 
     def state(self) -> NodeState:
-        return NodeState(self.node_id, self.resources, self.alive)
+        return NodeState(self.node_id, self.resources, self.alive,
+                         draining=self.draining)
 
     def kill(self):
         """Simulate host failure: objects lost, resources gone (chaos tests)."""
@@ -993,6 +995,13 @@ class Runtime:
                 self.named_actors[key] = state.actor_id
         self._util_pool.submit(self._place_and_start_actor, state)
 
+    def _restore_drained_actor(self, state: ActorState):
+        """Hook for the distributed runtime: return a live instance to
+        resume a restarting actor from a drained node's snapshot, or None
+        to construct it normally. The in-process runtime has no drain
+        lifecycle, so there is never a snapshot to resume from."""
+        return None
+
     def _place_and_start_actor(self, state: ActorState, restart: bool = False):
         deadline = time.monotonic() + _config.get("worker_lease_timeout_s")
         pause = BackoffPolicy(base_s=0.005, max_s=0.05, deadline_s=0,
@@ -1045,14 +1054,20 @@ class Runtime:
             ctx.devices = state.devices
             ctx.placement_group = state.options.placement_group
             try:
-                args = _resolve_refs(state.args, self)
-                kwargs = _resolve_refs(state.kwargs, self)
-                env = _materialize_env_for_actor(state)
-                if env is not None:
-                    with env.applied():
-                        state.instance = state.cls(*args, **kwargs)
+                restored = self._restore_drained_actor(state)
+                if restored is not None:
+                    # Previous host drained gracefully: resume from its
+                    # snapshot instead of re-running __init__.
+                    state.instance = restored
                 else:
-                    state.instance = state.cls(*args, **kwargs)
+                    args = _resolve_refs(state.args, self)
+                    kwargs = _resolve_refs(state.kwargs, self)
+                    env = _materialize_env_for_actor(state)
+                    if env is not None:
+                        with env.applied():
+                            state.instance = state.cls(*args, **kwargs)
+                    else:
+                        state.instance = state.cls(*args, **kwargs)
                 state.status = ActorState.ALIVE
                 state.ready.set()
                 self.emit_event("ACTOR_ALIVE", actor=state.cls.__name__)

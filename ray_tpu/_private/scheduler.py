@@ -82,7 +82,9 @@ def _flatten(nodes: Sequence["NodeState"], request: ResourceSet):
     for j, k in enumerate(keys):
         req[j] = req_d.get(k, 0.0)
     for i, n in enumerate(nodes):
-        alive[i] = 1 if n.alive else 0
+        # Schedulability, not liveness: the native kernels need no notion
+        # of DRAINING — a draining node simply reads as ineligible.
+        alive[i] = 1 if n.schedulable else 0
         a = n.resources.available.to_dict()
         t = n.resources.total.to_dict()
         for j, k in enumerate(keys):
@@ -94,10 +96,19 @@ def _flatten(nodes: Sequence["NodeState"], request: ResourceSet):
 class NodeState:
     """Scheduler-visible view of one node."""
 
-    def __init__(self, node_id: NodeID, resources: NodeResources, alive: bool = True):
+    def __init__(self, node_id: NodeID, resources: NodeResources, alive: bool = True,
+                 draining: bool = False):
         self.node_id = node_id
         self.resources = resources
         self.alive = alive
+        self.draining = draining
+
+    @property
+    def schedulable(self) -> bool:
+        """Eligible for NEW placement. A DRAINING node is still alive (its
+        in-flight work runs to the drain deadline) but must not receive
+        anything new, so every policy filters on this, not ``alive``."""
+        return self.alive and not self.draining
 
 
 class Infeasible(Exception):
@@ -135,7 +146,7 @@ class HybridPolicy:
             return nodes[idx].node_id if idx >= 0 else None
         scored: List[Tuple[float, int, NodeID]] = []
         for i, n in enumerate(nodes):
-            if not n.alive or not n.resources.can_fit(request):
+            if not n.schedulable or not n.resources.can_fit(request):
                 continue
             util = n.resources.utilization()
             # Below threshold: score 0 (pack anywhere cheap); above: score by
@@ -167,7 +178,8 @@ class SpreadPolicy:
             idx = lib.sched_spread_select(avail, alive, req, n_nodes,
                                           n_res, cursor)
             return nodes[idx].node_id if idx >= 0 else None
-        feasible = [n for n in nodes if n.alive and n.resources.can_fit(request)]
+        feasible = [n for n in nodes
+                    if n.schedulable and n.resources.can_fit(request)]
         if not feasible:
             return None
         with self._lock:
@@ -184,7 +196,7 @@ class NodeAffinityPolicy:
             if n.node_id.hex() == node_id_hex:
                 target = n
                 break
-        if target is not None and target.alive:
+        if target is not None and target.schedulable:
             if target.resources.can_fit(request):
                 return target.node_id
             if target.resources.could_ever_fit(request):
@@ -202,7 +214,7 @@ def _bin_pack(nodes: List[NodeState], bundles: Sequence[ResourceSet],
               distinct: bool, minimize_nodes: bool) -> Optional[List[NodeID]]:
     """Greedy bundle placement over a copy of node availability."""
     avail: Dict[NodeID, ResourceSet] = {
-        n.node_id: n.resources.available for n in nodes if n.alive}
+        n.node_id: n.resources.available for n in nodes if n.schedulable}
     used_nodes: List[NodeID] = []
     placement: List[NodeID] = []
     order = sorted(range(len(bundles)),
@@ -240,7 +252,7 @@ def schedule_bundles(nodes: List[NodeState], bundles: Sequence[ResourceSet],
         for b in bundles:
             total = total.add(b)
         for n in nodes:
-            if n.alive and n.resources.can_fit(total):
+            if n.schedulable and n.resources.can_fit(total):
                 return [n.node_id] * len(bundles)
         return None
     if strategy == "STRICT_SPREAD":

@@ -350,6 +350,14 @@ class StateClient:
 
     def heartbeat(self, node_id: bytes,
                   available: Optional[Dict[str, float]] = None) -> bool:
+        return self.heartbeat_ex(node_id, available).recognized
+
+    def heartbeat_ex(self, node_id: bytes,
+                     available: Optional[Dict[str, float]] = None
+                     ) -> pb.HeartbeatReply:
+        """Full heartbeat reply: ``recognized`` plus the drain signal
+        (``node_state``/``drain_deadline_ms``/``drain_reason``) the
+        service piggybacks on the ack."""
         req = pb.HeartbeatRequest(node_id=node_id)
         if available is not None:
             req.available.amounts.update(available)
@@ -358,7 +366,7 @@ class StateClient:
         # the heartbeat thread for the full reconnect deadline
         rep.ParseFromString(self._call(pb.HEARTBEAT, req, timeout=10.0,
                                        deadline_s=5.0))
-        return rep.recognized
+        return rep
 
     def list_nodes(self) -> List[pb.NodeInfo]:
         rep = pb.ListNodesReply()
@@ -368,6 +376,15 @@ class StateClient:
     def mark_node_dead(self, node_id: bytes, reason: str = ""):
         self._call(pb.MARK_NODE_DEAD,
                    pb.MarkNodeDeadRequest(node_id=node_id, reason=reason))
+
+    def drain_node(self, node_id: bytes, reason: str = "",
+                   deadline_s: float = 0.0):
+        """Flip a node to DRAINING at the state service. The service
+        publishes NODE_DRAINING and repeats the signal on every heartbeat
+        ack; the node's own drain orchestrator does the migration."""
+        self._call(pb.DRAIN_NODE,
+                   pb.DrainNodeRequest(node_id=node_id, reason=reason,
+                                       deadline_s=deadline_s))
 
     # -------------------------------------------------------------------- kv
 

@@ -302,9 +302,32 @@ def nodes() -> List[dict]:
     return [{
         "NodeID": ns.node_id.hex(),
         "Alive": ns.alive,
+        "State": ("DRAINING" if getattr(ns, "draining", False)
+                  else "ALIVE" if ns.alive else "DEAD"),
         "Resources": ns.resources.total.to_dict(),
         "Available": ns.resources.available.to_dict(),
     } for ns in w.runtime.node_states()]
+
+
+def drain_node(node_id: str, reason: str = "",
+               deadline_s: float = 0.0) -> None:
+    """Gracefully drain a cluster node: flip it to DRAINING at the state
+    service so the scheduler stops placing work there, then let the
+    node's own drain orchestrator migrate its workload (in-flight tasks
+    finish, actors checkpoint and restart elsewhere, sole-copy objects
+    re-replicate) before it decommissions.
+
+    ``node_id`` is the hex id reported by :func:`nodes`. ``deadline_s``
+    is the migration budget; 0 uses the ``drain_deadline_s`` config.
+    """
+    w = global_worker()
+    state = getattr(w.runtime, "state", None)
+    if state is None:
+        raise RuntimeError(
+            "drain_node requires a distributed runtime "
+            "(ray_tpu.init(address=...)); the in-process runtime has no "
+            "node lifecycle")
+    state.drain_node(bytes.fromhex(node_id), reason, deadline_s)
 
 
 def timeline(filename: Optional[str] = None):

@@ -31,6 +31,9 @@ rpc.server.send       peer, method — before a reply frame is written
 state.call            method — StateClient._call, before the RPC
 state.reconnect       peer — StateClient._reconnect, before re-dialing
 state.heartbeat       node — daemon heartbeat loop, before each beat
+node.preempt          node — host daemon preemption watcher, per poll; a
+                      "drop" return is the eviction notice (deterministic
+                      stand-in for the metadata-server probe)
 object.push           peer, object — distributed pusher, per chunk
 object.fetch          peer, object — distributed fetch, per source attempt
 object.store.get      object — local ObjectStore.get

@@ -164,6 +164,8 @@ class DashboardHead:
                 "node_id": nid,
                 "address": n.address,
                 "alive": n.alive,
+                "state": (n.state or ("ALIVE" if n.alive else "DEAD")),
+                "drain_reason": n.drain_reason,
                 "is_head": n.is_head,
                 "total": dict(n.total.amounts),
                 "available": dict(n.available.amounts),

@@ -78,10 +78,43 @@ def collect(flight_dir: Optional[str] = None,
             except Exception as e:  # noqa: BLE001
                 out["errors"].append(f"metrics: {e!r}")
                 cluster["metrics"] = None
+            try:
+                cluster["drain"] = _drain_progress(head.state)
+            except Exception as e:  # noqa: BLE001
+                out["errors"].append(f"drain: {e!r}")
+                cluster["drain"] = None
             out["cluster"] = cluster
         finally:
             head.stop()
     return out
+
+
+def _drain_progress(state) -> Dict[str, dict]:
+    """Per-node migration progress published by drain orchestrators into
+    the state-service KV (namespace ``drain``, key ``progress:<node_id>``):
+    phase, tasks still pending, actors checkpointed, objects migrated."""
+    progress: Dict[str, dict] = {}
+    for key in state.kv_keys(prefix=b"progress:", namespace=b"drain"):
+        val = state.kv_get(key, namespace=b"drain")
+        if not val:
+            continue
+        try:
+            progress[key[len(b"progress:"):].hex()] = json.loads(val)
+        except (ValueError, UnicodeDecodeError):
+            continue
+    return progress
+
+
+def _node_states(collected: dict) -> Dict[str, str]:
+    """node_id(hex) -> lifecycle state, from the live cluster view
+    (empty when collection ran disk-only)."""
+    states: Dict[str, str] = {}
+    cluster = collected.get("cluster") or {}
+    for n in ((cluster.get("nodes") or {}).get("nodes") or []):
+        nid = n.get("node_id", "")
+        states[nid] = (n.get("state")
+                       or ("ALIVE" if n.get("alive") else "DEAD"))
+    return states
 
 
 def _all_bundles(collected: dict) -> List[dict]:
@@ -146,12 +179,15 @@ def _hang_reports(collected: dict) -> List[dict]:
     """Heartbeat-miss-triggered hang detection: any node whose
     ``heartbeat_consecutive_misses`` gauge is nonzero is sampled — its
     live thread stacks (already in the forensics fan-out) say where it
-    is stuck."""
+    is stuck. A DRAINING node missing heartbeats is NOT a hang — it is
+    mid-migration and about to decommission — so those entries are
+    tagged ``expected`` and excluded from the issue count."""
     cluster = collected.get("cluster") or {}
     metrics = cluster.get("metrics") or {}
     snaps = metrics.get("snapshots") or {}
     forensics = cluster.get("forensics") or {}
     nodes = forensics.get("nodes") or {}
+    states = _node_states(collected)
     hangs = []
     for src, families in snaps.items():
         for fam in families or []:
@@ -169,8 +205,16 @@ def _hang_reports(collected: dict) -> List[dict]:
                         stacks = payload.get("stacks") or {}
                         inflight = payload.get("inflight") or {}
                         break
+                node_state = ""
+                for nid, st in states.items():
+                    if nid.startswith(node_tag) or \
+                            node_tag.startswith(nid[:8]):
+                        node_state = st
+                        break
                 hangs.append({"node": node_tag, "source": src,
                               "consecutive_misses": value,
+                              "expected": node_state == "DRAINING",
+                              "node_state": node_state,
                               "inflight_tasks": sorted(
                                   t.get("name", "?")
                                   for t in inflight.values()),
@@ -220,9 +264,13 @@ def diagnose(collected: dict, straggler_factor: float = 3.0) -> dict:
     """Turn a :func:`collect` result into findings. Machine-readable;
     :func:`render_text` prints the same structure for humans."""
     crashes = _crash_reports(_all_bundles(collected))
-    hangs = _hang_reports(collected)
+    all_hangs = _hang_reports(collected)
+    hangs = [h for h in all_hangs if not h.get("expected")]
+    expected_hangs = [h for h in all_hangs if h.get("expected")]
     stragglers = _straggler_reports(collected, factor=straggler_factor)
     cluster = collected.get("cluster") or {}
+    states = _node_states(collected)
+    draining_ids = {nid for nid, st in states.items() if st == "DRAINING"}
     missing: List[dict] = []
     for key in ("forensics", "timeline"):
         for h in ((cluster.get(key) or {}).get("missing_hosts") or []):
@@ -231,8 +279,32 @@ def diagnose(collected: dict, straggler_factor: float = 3.0) -> dict:
     for h in ((cluster.get("metrics") or {}).get("missing_hosts") or []):
         if all(m["node_id"] != h["node_id"] for m in missing):
             missing.append(h)
-    dead_nodes = [n for n in ((cluster.get("nodes") or {}).get("nodes")
-                              or []) if not n.get("alive")]
+    # A DRAINING node that already quiesced its RPC server is expectedly
+    # unreachable — mid-decommission, not an outage.
+    missing = [m for m in missing
+               if m.get("node_id", "") not in draining_ids]
+    all_dead = [n for n in ((cluster.get("nodes") or {}).get("nodes")
+                            or []) if not n.get("alive")]
+    # "drained: <reason>" is the orchestrator's clean-decommission stamp —
+    # the workloads were migrated, so the departure is not an issue.
+    dead_nodes = [n for n in all_dead
+                  if not (n.get("death_reason") or "").startswith("drained")]
+    drained_nodes = [n for n in all_dead
+                     if (n.get("death_reason") or "").startswith("drained")]
+    progress = cluster.get("drain") or {}
+    draining = []
+    for n in ((cluster.get("nodes") or {}).get("nodes") or []):
+        if n.get("state") != "DRAINING":
+            continue
+        nid = n.get("node_id", "")
+        draining.append({"node_id": nid,
+                         "drain_reason": n.get("drain_reason", ""),
+                         "progress": progress.get(nid),
+                         "heartbeat_misses": [
+                             h["consecutive_misses"]
+                             for h in expected_hangs
+                             if nid.startswith(h["node"])
+                             or h["node"].startswith(nid[:8])]})
     local = collected.get("local") or {}
     n_issues = (len(crashes) + len(hangs) + len(stragglers) +
                 len(missing) + len(dead_nodes))
@@ -244,6 +316,10 @@ def diagnose(collected: dict, straggler_factor: float = 3.0) -> dict:
         "hangs": hangs,
         "stragglers": stragglers,
         "unreachable_hosts": missing,
+        "draining_nodes": draining,
+        "drained_nodes": [{"node_id": n.get("node_id", ""),
+                           "death_reason": n.get("death_reason", "")}
+                          for n in drained_nodes],
         "dead_nodes": [{"node_id": n.get("node_id", ""),
                         "death_reason": n.get("death_reason", "")}
                        for n in dead_nodes],
@@ -307,6 +383,33 @@ def render_text(report: dict) -> str:
                 lines.append(f"    in-flight: {name}")
             for tname in sorted(h.get("stacks") or {}):
                 lines.append(f"    stack sampled: thread {tname}")
+    draining = report.get("draining_nodes") or []
+    if draining:
+        lines.append("")
+        lines.append(f"DRAINING ({len(draining)}) — migration in "
+                     "progress, not an issue")
+        for d in draining:
+            lines.append(f"  node {d['node_id'][:8]}: "
+                         f"{d.get('drain_reason') or '(no reason)'}")
+            prog = d.get("progress") or {}
+            if prog:
+                lines.append(
+                    f"    phase: {prog.get('phase', '?')}  "
+                    f"tasks pending: {prog.get('tasks_pending', '?')}  "
+                    f"actors checkpointed: "
+                    f"{prog.get('actors_checkpointed', '?')}  "
+                    f"objects migrated: "
+                    f"{prog.get('objects_migrated', '?')}")
+            for misses in d.get("heartbeat_misses") or []:
+                lines.append(f"    {misses:.0f} heartbeat miss(es): "
+                             "draining (expected)")
+    drained = report.get("drained_nodes") or []
+    if drained:
+        lines.append("")
+        lines.append(f"DRAINED NODES ({len(drained)}) — clean "
+                     "decommission, workloads migrated")
+        for n in drained:
+            lines.append(f"  {n['node_id'][:8]}: {n['death_reason']}")
     stragglers = report.get("stragglers") or []
     if stragglers:
         lines.append("")

@@ -110,6 +110,18 @@ def cmd_doctor(args):
     sys.exit(doctor_main(argv))
 
 
+def cmd_drain(args):
+    from ray_tpu._private.state_client import StateClient
+    client = StateClient(args.address)
+    try:
+        client.drain_node(bytes.fromhex(args.node_id),
+                          reason=args.reason, deadline_s=args.deadline_s)
+    finally:
+        client.close()
+    print(f"node {args.node_id[:16]} -> DRAINING "
+          f"(reason={args.reason!r}, deadline_s={args.deadline_s or 'default'})")
+
+
 def cmd_dashboard(args):
     import time
     from ray_tpu.dashboard import start_dashboard
@@ -153,6 +165,15 @@ def main(argv=None):
     hp.add_argument("--no-seal", action="store_true")
     hp.add_argument("-o", "--output", default=None)
     hp.set_defaults(fn=cmd_doctor)
+    gp = sub.add_parser("drain",
+                        help="gracefully drain a node (workload migration)")
+    gp.add_argument("node_id", help="node id (hex, as shown by `list nodes`)")
+    gp.add_argument("--address", required=True,
+                    help="host:port of the cluster state service")
+    gp.add_argument("--reason", default="operator")
+    gp.add_argument("--deadline-s", type=float, default=0.0,
+                    help="drain budget in seconds (0 = drain_deadline_s)")
+    gp.set_defaults(fn=cmd_drain)
     dp = sub.add_parser("dashboard",
                         help="serve the cluster dashboard UI")
     dp.add_argument("--address", required=True,

@@ -113,3 +113,18 @@ class Replica:
                     return True
             time.sleep(0.01)
         return False
+
+    # A node drain snapshots hosted actors with cloudpickle. The lock is
+    # not picklable and the drain-time flags must not survive migration —
+    # a replica restored on a healthy node serves again immediately.
+    def __getstate__(self):
+        with self._lock:
+            st = self.__dict__.copy()
+        st.pop("_lock", None)
+        st["_draining"] = False
+        st["_ongoing"] = 0
+        return st
+
+    def __setstate__(self, st):
+        self.__dict__.update(st)
+        self._lock = threading.Lock()

@@ -70,6 +70,16 @@ echo "== forensics gate (crash bundles sealed + doctor reads them back) =="
 JAX_PLATFORMS=cpu \
 python -m pytest tests/test_forensics.py -q
 
+echo "== drain drill (preemption notice -> zero-loss workload migration) =="
+# Graceful-drain gate: a chaos node.preempt eviction notice (and the
+# explicit ray_tpu.drain_node path) must migrate everything — zero task
+# loss, actor continuity via checkpoint restore on a survivor, sole-copy
+# objects re-replicated without lineage re-execution. The ProcessCluster
+# drills self-skip where the C++ state service can't build; the unit
+# layer (scheduler exclusion, watcher, doctor triage) runs everywhere.
+JAX_PLATFORMS=cpu \
+python -m pytest tests/test_drain.py -q
+
 echo "== bench regression gate (bench_micro --check vs tracked baseline) =="
 # Throughput must stay within --tolerance of BENCH_MICRO.json; latency
 # (_us) metrics are inverted. Cluster metrics are skipped automatically
