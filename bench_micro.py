@@ -220,6 +220,17 @@ def bench_recorder_overhead(prefix: str, n: int = 800):
     emit(f"{prefix}_recorder_overhead_pct", statistics.median(pcts), "%")
 
 
+def bench_transport():
+    """Startup bandwidth probe: what the transport auto-tuner measured on
+    this host — and therefore which chunk size, stream count and socket
+    buffers every bulk-bytes path (fetch/push/checkpoint/drain) runs
+    with. Tracked so a probe regression (or a kernel/stack change that
+    tanks loopback throughput) is visible round-over-round."""
+    from ray_tpu._private import transport
+    rep = transport.probe_report()
+    emit("transport_probe_gbps", rep.get("probe_gbps", 0.0), "GB/s")
+
+
 def bench_checkpoint(mb: int = 64):
     """Checkpoint-engine data path, no cluster needed: cold save throughput
     (content-hash + framed chunk writes + atomic commit), warm save of an
@@ -234,6 +245,12 @@ def bench_checkpoint(mb: int = 64):
     leaves = mb // 2
     tree = {f"layer{i}": rng.standard_normal((256, 1024))  # 2 MiB each
             for i in range(leaves)}
+    for a in tree.values():
+        # Frozen leaves model immutable device buffers (the training
+        # steady state): warm saves may trust the per-leaf hash cache and
+        # skip the host copy + sha256 entirely. A writeable array never
+        # cache-hits by design.
+        a.setflags(write=False)
     nbytes = sum(a.nbytes for a in tree.values())
 
     root = tempfile.mkdtemp(prefix="ckpt_bench_")
@@ -344,6 +361,7 @@ def run_inproc():
     import ray_tpu
     ray_tpu.shutdown()
     ray_tpu.init(num_cpus=float(os.cpu_count() or 8))
+    bench_transport()
     bench_tasks("inproc")
     bench_actor_calls("inproc")
     bench_put_get("inproc")

@@ -154,17 +154,25 @@ _config.define("inline_dispatch", bool, False,
                "(defeats the dispatcher's batched passes)")
 
 # -- Data plane (bulk object transfer) -------------------------------------------
-_config.define("data_streams_per_peer", int, 4,
-               "extra raw data connections per peer for chunked object "
+_config.define("data_streams_per_peer", int, -1,
+               "extra raw data connections per peer for chunked bulk "
                "transfers; multi-GB fetches stripe across them instead of "
                "head-of-line-blocking the multiplexed control socket. "
-               "0 disables the pool (chunks ride the control connection)")
-_config.define("fetch_chunk_bytes", int, 8 * 1024 * 1024,
-               "chunk size for FETCH_OBJECT/PUSH_OBJECT streaming")
+               ">0 explicit, 0 disables the pool (chunks ride the control "
+               "connection), <0 auto (transport bandwidth probe)")
+_config.define("fetch_chunk_bytes", int, 0,
+               "chunk size for FETCH_OBJECT/PUSH_OBJECT/checkpoint-chunk "
+               "streaming; 0 auto-tunes from the transport bandwidth probe "
+               "(falls back to 8 MiB with the probe disabled)")
 _config.define("data_socket_buffer_bytes", int, 0,
                "SO_SNDBUF/SO_RCVBUF for data-plane sockets; 0 auto-sizes "
-               "to the configured fetch chunk (the kernel caps silently "
-               "at net.core.[rw]mem_max)")
+               "from the transport probe (else to one fetch chunk; the "
+               "kernel caps silently at net.core.[rw]mem_max)")
+_config.define("transport_probe_bytes", int, 8 * 1024 * 1024,
+               "bytes the one-shot loopback bandwidth probe streams per "
+               "candidate chunk size to auto-tune fetch_chunk_bytes, "
+               "stream count and socket buffers; 0 disables the probe "
+               "(static defaults apply)")
 
 # -- Control plane batching ------------------------------------------------------
 _config.define("state_batch_max", int, 64,
@@ -179,6 +187,11 @@ _config.define("checkpoint_queue_depth", int, 2,
                "pending async saves per checkpoint engine before save() "
                "blocks (backpressure instead of unbounded host-copy "
                "buffering)")
+_config.define("checkpoint_io_workers", int, 4,
+               "hash/write worker threads per checkpoint engine: sha256 "
+               "chunking overlaps chunk-file writes per leaf (both release "
+               "the GIL), and restore reads chunks concurrently; <=1 "
+               "degrades to the serial path")
 _config.define("checkpoint_hash_verify", bool, True,
                "re-hash every chunk on restore and fail loudly on mismatch")
 _config.define("checkpoint_shard_wait_s", float, 60.0,

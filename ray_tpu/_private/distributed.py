@@ -43,6 +43,7 @@ from __future__ import annotations
 import hashlib
 import io
 import json
+from concurrent import futures
 import logging
 import os
 import pickle
@@ -73,6 +74,7 @@ from ray_tpu._private.runtime import (ActorState, Node, Runtime,
 from ray_tpu._private.scheduler import Infeasible, NodeState
 from ray_tpu._private.state_client import StateClient
 from ray_tpu._private.task_spec import TaskOptions, TaskSpec
+from ray_tpu._private import transport
 from ray_tpu.protocol import pb
 from ray_tpu.util import metrics as _metrics
 
@@ -81,7 +83,6 @@ from ray_tpu.util import metrics as _metrics
 logger = logging.getLogger("ray_tpu")
 
 INLINE_RESULT_MAX = 256 * 1024  # results below this ride in the reply
-FETCH_CHUNK = 8 * 1024 * 1024  # legacy default; see fetch_chunk_bytes knob
 # First fetch request asks for at most this much: it exists to reveal
 # total_size (and catch small objects in one round trip) — a full chunk
 # here would be copied into the striped destination afterwards.
@@ -93,10 +94,6 @@ NAMED_FN_NS = b"namedfn"  # cross-language named-function registry
 # RTF5 layout); the old local names remain as aliases for callers/tests.
 _dumps_framed = dumps_framed
 _loads_framed = loads_framed
-
-
-def _fetch_chunk() -> int:
-    return _config.get("fetch_chunk_bytes") or FETCH_CHUNK
 
 
 _stripe_hist_m = None
@@ -125,62 +122,6 @@ def _breaker_transitions():
             "circuit-breaker state transitions by peer",
             tag_keys=("peer", "to"))
     return _breaker_counter_m
-
-
-def _data_sock_buf() -> int:
-    """SO_SNDBUF/SO_RCVBUF for bulk-transfer sockets: explicit knob, else
-    sized to one fetch chunk so a whole chunk can be in flight per stream
-    (the kernel silently caps at net.core.[rw]mem_max)."""
-    n = _config.get("data_socket_buffer_bytes")
-    if n > 0:
-        return n
-    return min(max(_fetch_chunk(), 1 << 20), 64 << 20)
-
-
-class _DataStreamPool:
-    """Per-peer pool of raw data connections (``data_streams_per_peer``).
-
-    Chunked object transfers stripe across these instead of serializing
-    behind the multiplexed control socket's single reader/writer — the
-    reference separates object-manager data connections from the raylet
-    control channel for the same reason. Streams are plain authenticated
-    ``RpcClient``s (same FETCH_OBJECT protocol), created lazily per peer
-    and replaced on failure; with the pool disabled (size 0) callers fall
-    back to the control connection."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._streams: Dict[str, List[RpcClient]] = {}
-
-    def clients(self, address: str) -> List[RpcClient]:
-        n = _config.get("data_streams_per_peer")
-        if n <= 0:
-            return []
-        with self._lock:
-            pool = [c for c in self._streams.get(address, ())
-                    if not c.closed]
-            while len(pool) < n:
-                try:
-                    pool.append(RpcClient(
-                        address, sock_buf_bytes=_data_sock_buf()))
-                except (OSError, RpcConnectionError):
-                    break  # peer unreachable: callers use what exists
-            self._streams[address] = pool
-            return list(pool)
-
-    def drop(self, address: str) -> None:
-        with self._lock:
-            pool = self._streams.pop(address, [])
-        for c in pool:
-            c.close()
-
-    def close_all(self) -> None:
-        with self._lock:
-            pools = list(self._streams.values())
-            self._streams.clear()
-        for pool in pools:
-            for c in pool:
-                c.close()
 
 
 def _fn_key(payload: bytes) -> bytes:
@@ -286,11 +227,12 @@ class DistributedRuntime(Runtime):
                             pb.REMOVE_BORROW, pb.RELEASE_PIN, pb.PING,
                             pb.CANCEL_TASK, pb.RESERVE_BUNDLE,
                             pb.FREE_BUNDLE, pb.FREE_OBJECT},
-            sock_buf_bytes=_data_sock_buf())
+            sock_buf_bytes=transport.data_sock_buf())
         self.address = self.server.address
         # Raw data connections for chunk striping (separate from `pool`,
         # whose one connection per peer is the multiplexed control lane).
-        self._data_streams = _DataStreamPool()
+        # The pool — and every bulk-bytes socket — lives in transport.py.
+        self._data_streams = transport._DataStreamPool()
 
         # Cluster view: node_id bytes -> (pb.NodeInfo, NodeResources view).
         self._states_memo = None  # (monotonic_ts, [NodeState]) micro-TTL
@@ -1088,6 +1030,10 @@ class DistributedRuntime(Runtime):
         migrated = 0
         skipped = 0
         oids = list(self.local_node.store.object_ids())
+        # Sole-copy scan stays serial (cheap KV lookups); the pushes
+        # themselves — the bulk-bytes work — run concurrently, each one
+        # striped over the shared transport pool to its target peer.
+        to_push: List[Tuple[ObjectID, str]] = []
         for i, oid in enumerate(oids):
             if time.monotonic() > deadline:
                 skipped = len(oids) - i
@@ -1100,13 +1046,35 @@ class DistributedRuntime(Runtime):
                        for n in locs.node_ids):
                     continue  # another live copy exists: nothing to do
                 _nid, addr = peers[i % len(peers)]
-                if self._drain_push_object(oid, addr):
-                    migrated += 1
-                    self._drain_migrated_gauge.set(migrated)
-                    self._drain_progress["objects_migrated"] = migrated
+                to_push.append((oid, addr))
             except Exception as e:
                 logger.warning("drain migration failed for %s: %s",
                                oid.hex()[:8], e)
+        if to_push:
+            acct_lock = threading.Lock()
+
+            def _push_one(oid: ObjectID, addr: str) -> None:
+                nonlocal migrated
+                try:
+                    if self._drain_push_object(oid, addr):
+                        with acct_lock:
+                            migrated += 1
+                            self._drain_migrated_gauge.set(migrated)
+                            self._drain_progress["objects_migrated"] = \
+                                migrated
+                except Exception as e:
+                    logger.warning("drain migration failed for %s: %s",
+                                   oid.hex()[:8], e)
+
+            with futures.ThreadPoolExecutor(
+                    max_workers=min(8, len(to_push)),
+                    thread_name_prefix="drain-migrate") as ex:
+                fs = [ex.submit(_push_one, oid, addr)
+                      for oid, addr in to_push]
+                not_done = futures.wait(
+                    fs, timeout=max(0.0, deadline - time.monotonic()))[1]
+                if not_done:
+                    skipped += sum(1 for f in not_done if f.cancel())
         if observability.ENABLED:
             observability.instant("drain:objects_migrated", cat="drain",
                                   migrated=migrated, skipped=skipped)
@@ -1116,31 +1084,70 @@ class DistributedRuntime(Runtime):
         return migrated
 
     def _drain_push_object(self, oid: ObjectID, addr: str) -> bool:
-        """Synchronous full-object push (the _PushManager loop without the
-        threshold or the fire-and-forget pool: the orchestrator needs the
-        success signal for its zero-loss accounting)."""
+        """Striped full-object push over the shared transport pool (the
+        receiver accepts chunks in any order and seals once every byte
+        landed): the orchestrator needs the success signal for its
+        zero-loss accounting, so the first chunk goes synchronously — its
+        rejection means the receiver already holds a copy — and every
+        remaining chunk is pushed concurrently across the peer's data
+        streams instead of round-tripping one chunk at a time."""
         payload = self._serialized_for_fetch(oid)
         total = len(payload)
         client = self.pool.get(addr)
-        chunk_sz = _fetch_chunk()
-        offset = 0
-        while offset < total or offset == 0:
+        chunk_sz = transport.fetch_chunk_bytes()
+
+        def _push_req(offset: int) -> bytes:
             end = min(total, offset + chunk_sz)
-            eof = end >= total
-            rep = pb.PushObjectReply()
-            rep.ParseFromString(client.call(
-                pb.PUSH_OBJECT, pb.PushObjectRequest(
-                    object_id=oid.binary(), offset=offset,
-                    total_size=total, eof=eof).SerializeToString(),
-                timeout=120, raw=payload.slices(offset, end)).body)
-            if not rep.accepted:
-                # first-chunk rejection = receiver already holds it (a
-                # copy exists after all); mid-stream = failed transfer
-                return offset == 0
-            offset = end
-            if eof:
-                return True
-        return False
+            return pb.PushObjectRequest(
+                object_id=oid.binary(), offset=offset, total_size=total,
+                eof=end >= total).SerializeToString()
+
+        first_end = min(total, chunk_sz)
+        rep = pb.PushObjectReply()
+        rep.ParseFromString(client.call(
+            pb.PUSH_OBJECT, _push_req(0), timeout=120,
+            raw=payload.slices(0, first_end)).body)
+        if not rep.accepted:
+            return True  # receiver already holds it: a copy exists after all
+        if first_end >= total:
+            return True
+
+        class _Rejected(Exception):
+            pass
+
+        def _submit(stream, off, done_cb):
+            def cb(env, error):
+                if error is None:
+                    try:
+                        crep = pb.PushObjectReply()
+                        crep.ParseFromString(env.body)
+                        if not crep.accepted:
+                            error = _Rejected(f"chunk at {off} rejected")
+                    except Exception as e:  # noqa: BLE001
+                        error = e
+                done_cb(error)
+            stream.call_async(
+                pb.PUSH_OBJECT, _push_req(off), cb,
+                raw=payload.slices(off, min(total, off + chunk_sz)))
+
+        xfer = transport.StripedTransfer(
+            self._data_streams, addr, consumer="drain.migrate",
+            fallback_client=client)
+        try:
+            xfer.run(range(first_end, total, chunk_sz), _submit,
+                     fatal=(_Rejected,))
+        except _Rejected:
+            # A duplicate delivery after a lost reply can land on a buffer
+            # the receiver already sealed: rejection is only a failure when
+            # the object did NOT make it. Ask the receiver directly.
+            wrep = pb.WaitObjectReply()
+            wrep.ParseFromString(client.call(
+                pb.WAIT_OBJECT, pb.WaitObjectRequest(
+                    object_id=oid.binary(),
+                    timeout_ms=1000.0).SerializeToString(),
+                timeout=30).body)
+            return bool(wrep.ready)
+        return True
 
     def _publish_drain_progress(self):
         """Doctor-visible progress record in the state KV."""
@@ -1539,7 +1546,7 @@ class DistributedRuntime(Runtime):
                 raise RpcConnectionError(str(e)) from e
         client = self.pool.get(addr)
         arena_key = self.host_arena_key
-        chunk_sz = _fetch_chunk()
+        chunk_sz = transport.fetch_chunk_bytes()
         first_box: Dict[str, bytearray] = {}
 
         def _first_sink(n):
@@ -1595,79 +1602,51 @@ class DistributedRuntime(Runtime):
         else:
             heap = None
         dest[:len(first)] = first
-        pending = list(range(len(first), total, chunk_sz))
-        backoff = BackoffPolicy(
-            deadline_s=_config.get("backoff_deadline_s")).start()
+        # Striping, failover and the retry backoff live in the shared
+        # transport layer (the same machinery drains pushes and checkpoint
+        # chunk fetches). The probe connection is last-resort only for
+        # heap dests: arena-dest sinks are handed ONLY to streams we own.
+        xfer = transport.StripedTransfer(
+            self._data_streams, addr, consumer="object.fetch",
+            fallback_client=(None if heap is None else client),
+            streams=streams)
+
+        def _submit(stream, off, done_cb):
+            t0 = time.monotonic() if observability.ENABLED else 0.0
+
+            def cb(env, error):
+                if t0:
+                    _stripe_hist().observe(
+                        (time.monotonic() - t0) * 1e3,
+                        tags={"peer": addr})
+                try:
+                    if error is None:
+                        crep = pb.FetchObjectReply()
+                        crep.ParseFromString(env.body)
+                        if not crep.found:
+                            error = RpcRemoteError(
+                                f"object {oid} vanished mid-fetch")
+                        elif crep.data:
+                            # pre-raw-lane peer: bytes in the proto
+                            dest[off:off + len(crep.data)] = crep.data
+                except Exception as e:  # noqa: BLE001
+                    error = e
+                done_cb(error)
+
+            # The raw sink lands each chunk's bytes DIRECTLY in its slot
+            # of the destination from the stream's reader thread — zero
+            # user-space payload copies.
+            stream.call_async(
+                pb.FETCH_OBJECT, pb.FetchObjectRequest(
+                    object_id=oid.binary(), offset=off,
+                    max_bytes=chunk_sz).SerializeToString(),
+                cb, raw_sink=lambda n, _o=off: dest[_o:_o + n])
+
         sealed = False
         try:
-            while True:
-                state = {"errors": {}, "left": len(pending)}
-                state_lock = threading.Lock()  # NOT self.lock: cbs run on
-                done = threading.Event()       # reader threads; keep tiny
-
-                def _chunk_cb(off):
-                    t0 = time.monotonic() if observability.ENABLED else 0.0
-
-                    def cb(env, error):
-                        if t0:
-                            _stripe_hist().observe(
-                                (time.monotonic() - t0) * 1e3,
-                                tags={"peer": addr})
-                        try:
-                            if error is None:
-                                crep = pb.FetchObjectReply()
-                                crep.ParseFromString(env.body)
-                                if not crep.found:
-                                    error = RpcRemoteError(
-                                        f"object {oid} vanished mid-fetch")
-                                elif crep.data:
-                                    # pre-raw-lane peer: bytes in the proto
-                                    dest[off:off + len(crep.data)] = crep.data
-                        except Exception as e:  # noqa: BLE001
-                            error = e
-                        with state_lock:
-                            if error is not None:
-                                state["errors"][off] = error
-                            state["left"] -= 1
-                            if state["left"] == 0:
-                                done.set()
-                    return cb
-
-                for i, off in enumerate(pending):
-                    # The raw sink lands each chunk's bytes DIRECTLY in
-                    # its slot of the destination from the stream's reader
-                    # thread — zero user-space payload copies.
-                    streams[i % len(streams)].call_async(
-                        pb.FETCH_OBJECT, pb.FetchObjectRequest(
-                            object_id=oid.binary(), offset=off,
-                            max_bytes=chunk_sz).SerializeToString(),
-                        _chunk_cb(off),
-                        raw_sink=lambda n, _o=off: dest[_o:_o + n])
-                if not done.wait(timeout=120):
-                    raise TimeoutError(
-                        f"chunked fetch of {oid} from {addr} timed out")
-                errors = state["errors"]
-                if not errors:
-                    break
-                for err in errors.values():
-                    if isinstance(err, RpcRemoteError):
-                        raise err  # source lost the object: no retry helps
-                # Transport failures: retry just the missing chunks on the
-                # surviving streams (clients() replaces dead ones). The
-                # probe connection is last-resort only for heap dests.
-                pending = sorted(errors)
-                if not backoff.sleep():
-                    err = next(iter(errors.values()))
-                    if isinstance(err, (RpcConnectionError, TimeoutError)):
-                        raise err
-                    raise RpcConnectionError(str(err))
-                streams = [c for c in self._data_streams.clients(addr)
-                           if not c.closed]
-                if not streams:
-                    if heap is None:
-                        raise RpcConnectionError(
-                            f"data streams to {addr} lost mid-fetch")
-                    streams = [client]
+            # RpcRemoteError (source lost the object) aborts immediately:
+            # no retry helps.
+            xfer.run(range(len(first), total, chunk_sz), _submit)
             if heap is None:
                 store.seal_recv_buffer(oid)
                 sealed = True
@@ -1680,10 +1659,78 @@ class DistributedRuntime(Runtime):
                 # a late recv_into against a deleted slot would scribble
                 # over whatever the arena reuses that space for.
                 self._data_streams.drop(addr)
-                for c in streams:
+                for c in xfer.streams:
                     if c is not client:
                         c.join_reader(timeout=5.0)
                 store.abort_recv_buffer(oid)
+
+    def fetch_ckpt_chunk(self, addr: str, chunk_id: str) -> Optional[bytes]:
+        """Striped fetch of one content-addressed checkpoint chunk from a
+        peer's serve roots — the ``fetch_from`` hook of
+        ``ray_tpu.checkpoint.load`` for restores whose root is not the
+        saver's filesystem. Same shape as ``_fetch_from``: a probe
+        request reveals total_size, remaining chunks stripe concurrently
+        over the shared pool with failover, bytes recv_into their final
+        slot of one heap buffer, which is returned as-is (bytes-like;
+        the engine hashes and writes it without copying, and framed
+        decode seals it read-only). Returns None when the peer doesn't
+        hold the chunk (the restore fails loudly upstream)."""
+        client = self.pool.get(addr)
+        chunk_sz = transport.fetch_chunk_bytes()
+        key = "ckpt:" + chunk_id
+        first_box: Dict[str, bytearray] = {}
+
+        def _first_sink(n):
+            first_box["buf"] = bytearray(n)
+            return memoryview(first_box["buf"])
+
+        rep = pb.FetchObjectReply()
+        rep.ParseFromString(client.call(
+            pb.FETCH_OBJECT, pb.FetchObjectRequest(
+                offset=0, max_bytes=chunk_sz,
+                arena_key=key).SerializeToString(),
+            timeout=120, raw_sink=_first_sink).body)
+        if not rep.found:
+            return None
+        first = first_box.get("buf") or rep.data or b""
+        total = rep.total_size or len(first)
+        if rep.eof or len(first) >= total:
+            return first
+        heap = bytearray(total)
+        dest = memoryview(heap)
+        dest[:len(first)] = first
+        xfer = transport.StripedTransfer(
+            self._data_streams, addr, consumer="ckpt.restore",
+            fallback_client=client)
+
+        def _submit(stream, off, done_cb):
+            def cb(env, error):
+                try:
+                    if error is None:
+                        crep = pb.FetchObjectReply()
+                        crep.ParseFromString(env.body)
+                        if not crep.found:
+                            error = RpcRemoteError(
+                                f"ckpt chunk {chunk_id[:12]}… vanished "
+                                "mid-fetch")
+                        elif crep.data:
+                            dest[off:off + len(crep.data)] = crep.data
+                except Exception as e:  # noqa: BLE001
+                    error = e
+                done_cb(error)
+            stream.call_async(
+                pb.FETCH_OBJECT, pb.FetchObjectRequest(
+                    offset=off, max_bytes=chunk_sz,
+                    arena_key=key).SerializeToString(),
+                cb, raw_sink=lambda n, _o=off: dest[_o:_o + n])
+
+        xfer.run(range(len(first), total, chunk_sz), _submit)
+        return heap
+
+    def ckpt_fetcher(self, addr: str):
+        """Bind ``fetch_ckpt_chunk`` to one peer: the ``fetch_from``
+        argument for ``ray_tpu.checkpoint.load``."""
+        return lambda chunk_id: self.fetch_ckpt_chunk(addr, chunk_id)
 
     def object_ready(self, oid: ObjectID) -> bool:
         if self.local_node.store.contains(oid):
@@ -3602,42 +3649,39 @@ class DistributedRuntime(Runtime):
             for stale in [o for o, t in self._incoming_push_seen.items()
                           if now - t > 60.0]:
                 _drop_locked(stale)
-            rec = self._incoming_pushes.get(oid)  # [dest_view, filled]
+            # rec = [dest_view, {offset: nbytes}, filled, eof_seen].
+            # Chunks arrive in ANY order (striped senders interleave
+            # streams) and may arrive twice (failover retries a chunk
+            # whose reply was lost) — every chunk carries total_size, so
+            # any chunk can open the buffer, and duplicate offsets are
+            # idempotent overwrites. The buffer seals once an eof chunk
+            # was seen AND every byte is accounted for.
+            rec = self._incoming_pushes.get(oid)
             if rec is None:
-                if req.offset != 0:   # mid-stream chunk of a dead stream
-                    rep.accepted = False
-                    ctx.reply(rep.SerializeToString())
-                    return
                 dest = store.create_recv_buffer(oid, req.total_size)
                 if dest is None:      # sealed locally while we raced
                     rep.accepted = False
                     ctx.reply(rep.SerializeToString())
                     return
-                rec = self._incoming_pushes[oid] = [dest, 0]
+                rec = self._incoming_pushes[oid] = [dest, {}, 0, False]
             self._incoming_push_seen[oid] = now
-            if req.offset != rec[1]:
-                if req.offset == 0:   # sender restarted
-                    rec[1] = 0
-                else:                 # out-of-order: abandon this stream
-                    _drop_locked(oid)
-                    rep.accepted = False
-                    ctx.reply(rep.SerializeToString())
-                    return
             n = len(chunk)
-            if rec[1] + n > len(rec[0]):
+            if (req.total_size != len(rec[0])
+                    or req.offset + n > len(rec[0])):
                 _drop_locked(oid)     # sender lied about total_size
                 rep.accepted = False
                 ctx.reply(rep.SerializeToString())
                 return
             if n:
-                rec[0][rec[1]:rec[1] + n] = chunk
-                rec[1] += n
+                prev = rec[1].get(req.offset)
+                if prev is not None:
+                    rec[2] -= prev    # duplicate delivery: replace, once
+                rec[0][req.offset:req.offset + n] = chunk
+                rec[1][req.offset] = n
+                rec[2] += n
             if req.eof:
-                if rec[1] != len(rec[0]):
-                    _drop_locked(oid)  # truncated stream
-                    rep.accepted = False
-                    ctx.reply(rep.SerializeToString())
-                    return
+                rec[3] = True
+            if rec[3] and rec[2] >= len(rec[0]):
                 self._incoming_pushes.pop(oid, None)
                 self._incoming_push_seen.pop(oid, None)
                 done = True
@@ -3655,6 +3699,12 @@ class DistributedRuntime(Runtime):
     def _handle_fetch_object(self, ctx: RpcContext):
         req = pb.FetchObjectRequest()
         req.ParseFromString(ctx.body)
+        if req.arena_key.startswith("ckpt:"):
+            # Checkpoint restore rides the same FETCH_OBJECT bulk lane
+            # (the pb schema is frozen without protoc): the arena_key
+            # carries the content hash instead of naming a shared arena.
+            self._handle_fetch_ckpt_chunk(ctx, req)
+            return
         oid = ObjectID(req.object_id)
         store = self.local_node.store
         rep = pb.FetchObjectReply()
@@ -3694,13 +3744,41 @@ class DistributedRuntime(Runtime):
                 rep.eof = True
                 ctx.reply(rep.SerializeToString())
                 return
-        end = min(len(payload), req.offset + (req.max_bytes or _fetch_chunk()))
+        end = min(len(payload),
+                  req.offset + (req.max_bytes or transport.fetch_chunk_bytes()))
         rep.eof = end >= len(payload)
         # Bulk lane: the chunk leaves via gather-write (sendmsg) straight
         # from the source buffers of the cached FramedPayload — no slice
         # copy, no frame materialization, no protobuf copy (rep.data stays
         # empty; raw_len announces the bytes).
         ctx.reply(rep.SerializeToString(), raw=payload.slices(req.offset, end))
+
+    def _handle_fetch_ckpt_chunk(self, ctx: RpcContext,
+                                 req: "pb.FetchObjectRequest"):
+        """Serve one content-addressed checkpoint chunk over the bulk
+        lane. ``arena_key="ckpt:<sha256>"`` names the chunk; the engine
+        validates the id (hex-only — no path traversal) and resolves it
+        against its registered serve roots. ``max_bytes == 0`` means the
+        whole chunk (restore stripes whole chunks, not chunk slices).
+        Chunks are immutable once written, so a plain read is safe."""
+        from ray_tpu.checkpoint import engine as ckpt_engine
+        rep = pb.FetchObjectReply()
+        try:
+            data = ckpt_engine.read_served_chunk(req.arena_key[5:])
+        except Exception as e:  # noqa: BLE001 — disk trouble = not found
+            logger.debug("ckpt chunk serve failed: %s", e)
+            data = None
+        if data is None:
+            rep.found = False
+            ctx.reply(rep.SerializeToString())
+            return
+        rep.found = True
+        rep.total_size = len(data)
+        end = (len(data) if not req.max_bytes
+               else min(len(data), req.offset + req.max_bytes))
+        rep.eof = end >= len(data)
+        ctx.reply(rep.SerializeToString(),
+                  raw=[memoryview(data)[req.offset:end]])
 
 
 _FETCH_MISS = object()
@@ -3763,8 +3841,17 @@ class _PushManager:
             total = len(payload)
             if total < threshold:
                 return
-            client = self.rt.pool.get(addr)
-            chunk_sz = _fetch_chunk()
+            # Bulk bytes ride a shared-pool data stream (one per object,
+            # picked deterministically so chunks of the same object stay
+            # ordered on one socket), keeping pushes off the multiplexed
+            # control connection; pool disabled -> control lane fallback.
+            streams = self.rt._data_streams.clients(addr)
+            if streams:
+                pick = int.from_bytes(oid.binary()[:4], "little")
+                client = streams[pick % len(streams)]
+            else:
+                client = self.rt.pool.get(addr)
+            chunk_sz = transport.fetch_chunk_bytes()
             offset = 0
             while offset < total or offset == 0:
                 if chaos.ENABLED and chaos.inject(

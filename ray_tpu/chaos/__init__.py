@@ -36,6 +36,10 @@ node.preempt          node — host daemon preemption watcher, per poll; a
                       stand-in for the metadata-server probe)
 object.push           peer, object — distributed pusher, per chunk
 object.fetch          peer, object — distributed fetch, per source attempt
+transport.stream      peer, consumer (object.fetch|drain.migrate|
+                      ckpt.restore), offset — shared striped transport,
+                      per chunk submission; "drop"/reset fails one stripe
+                      so failover retries it on the surviving streams
 object.store.get      object — local ObjectStore.get
 task.execute          task, name — worker, before user code runs
 checkpoint.write      path, rank — engine writer, before each chunk write

@@ -16,6 +16,22 @@ Save path (per rank)::
 overlaps the next training step. The bounded queue (``checkpoint_queue_depth``)
 applies backpressure instead of buffering unbounded host copies.
 
+Two raw-speed mechanisms sit on the write path:
+
+- **hash/write worker pool** (``checkpoint_io_workers``): sha256 and the
+  chunk-file write of independent leaves overlap instead of running
+  leaf-after-leaf on the writer thread (cold save is hash-bound on one
+  core, I/O-bound on spinning storage — either way the overlap wins).
+  ``<=1`` degrades to the serial path. Chaos choke points keep firing on
+  the writer thread in submission order, so fault schedules stay
+  deterministic regardless of worker interleaving.
+- **content-hash cache**: leaves whose buffers provably can't mutate —
+  jax arrays (immutable by API) and numpy arrays frozen with
+  ``writeable=False`` — memoize their chunk id by buffer identity, so a
+  warm save of an unchanged tree skips the device->host copy, the hash,
+  AND the write, and commits in about a millisecond. Writeable numpy
+  buffers are never cached: they re-hash every save by design.
+
 Commit protocol (rank 0): verify every referenced chunk exists -> write
 manifest (tmp+fsync+rename) -> advance LATEST -> best-effort register in the
 state service -> prune to ``num_to_keep`` + GC. A crash at any point leaves
@@ -44,8 +60,11 @@ import json
 import logging
 import os
 import queue
+import re
 import threading
 import time
+import weakref
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -83,19 +102,27 @@ def _is_array(x: Any) -> bool:
 
 
 def _extract_arrays(value: Any, path: Tuple[str, ...],
-                    out: List[Tuple[str, np.ndarray]]) -> Any:
-    """Replace array leaves with _Slot markers; collect (path, host array).
-    np.asarray is the device->host transfer for jax.Array leaves."""
+                    out: List[Any],
+                    make_leaf: Optional[Callable[[str, Any], Any]] = None
+                    ) -> Any:
+    """Replace array leaves with _Slot markers; collect (path, host array)
+    — or whatever ``make_leaf(path, leaf)`` produces (the engine passes a
+    hash-cache-aware builder). np.asarray is the device->host transfer
+    for jax.Array leaves."""
     if isinstance(value, dict):
-        return {k: _extract_arrays(v, path + (str(k),), out)
+        return {k: _extract_arrays(v, path + (str(k),), out, make_leaf)
                 for k, v in value.items()}
     if isinstance(value, (list, tuple)):
-        seq = [_extract_arrays(v, path + (str(i),), out)
+        seq = [_extract_arrays(v, path + (str(i),), out, make_leaf)
                for i, v in enumerate(value)]
         return tuple(seq) if isinstance(value, tuple) else seq
     if _is_array(value):
         slot = len(out)
-        out.append(("/".join(path), np.ascontiguousarray(np.asarray(value))))
+        if make_leaf is not None:
+            out.append(make_leaf("/".join(path), value))
+        else:
+            out.append(("/".join(path),
+                        np.ascontiguousarray(np.asarray(value))))
         return _Slot(slot)
     return value
 
@@ -117,6 +144,113 @@ def _hash_array(arr: np.ndarray) -> str:
     except (TypeError, ValueError):
         raw = arr.tobytes()
     return mf.hash_bytes(arr.dtype.str, json.dumps(list(arr.shape)), raw)
+
+
+# -- chunk serving (restore-side striped remote fetch) ------------------------
+#
+# A restoring rank whose root is NOT the saver's shared filesystem pulls
+# missing chunks from a peer over the FETCH_OBJECT bulk lane
+# (arena_key="ckpt:<sha256>" — see distributed._handle_fetch_ckpt_chunk).
+# Every engine registers its root here; chunks are content-addressed and
+# immutable, so serving any registered root that holds the id is correct.
+
+_serve_lock = threading.Lock()
+_SERVE_ROOTS: "set[str]" = set()
+_CHUNK_ID_RE = re.compile(r"[0-9a-f]{64}\Z")
+
+
+def register_serve_root(root: str) -> None:
+    with _serve_lock:
+        _SERVE_ROOTS.add(os.path.abspath(root))
+
+
+def read_served_chunk(chunk_id: str) -> Optional[bytes]:
+    """Bytes of a locally-held chunk, or None. The id is validated as a
+    bare content hash before touching the filesystem — the wire value
+    can never become a path traversal."""
+    if not _CHUNK_ID_RE.fullmatch(chunk_id):
+        return None
+    with _serve_lock:
+        roots = list(_SERVE_ROOTS)
+    for root in roots:
+        path = os.path.join(root, mf.chunk_relpath(chunk_id))
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except OSError:
+            continue
+    return None
+
+
+# -- warm-save content-hash cache ---------------------------------------------
+
+def _cacheable(x: Any) -> bool:
+    """Leaves whose bytes provably can't change behind the cache's back:
+    jax arrays (immutable by API) and numpy arrays explicitly frozen with
+    ``writeable=False``. The flag is re-checked at every lookup, so
+    thawing a frozen array drops it from the cache; a writeable buffer is
+    never trusted in the first place."""
+    if isinstance(x, np.ndarray):
+        return not x.flags.writeable
+    return _is_array(x)
+
+
+class _HashCache:
+    """Chunk-id memo keyed on leaf buffer identity (id + liveness).
+
+    A warm save of an unchanged tree must not pay the device->host copy,
+    the sha256, or the chunk write again — for an immutable buffer the
+    content hash is a function of its identity. Each entry carries a
+    weakref: a freed buffer (whose id() the allocator may hand to a new
+    object) evicts its own entry."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[int, tuple] = {}
+
+    def lookup(self, x: Any) -> Optional[tuple]:
+        """(chunk_id, nbytes, dtype_str, shape) or None."""
+        if not _cacheable(x):
+            return None
+        with self._lock:
+            ent = self._entries.get(id(x))
+        if ent is None or ent[0]() is not x:
+            return None
+        return ent[1:]
+
+    def remember(self, x: Any, chunk_id: str, nbytes: int,
+                 dtype: str, shape: List[int]) -> None:
+        if not _cacheable(x):
+            return
+        key = id(x)
+
+        def _evict(_ref, _key=key, _self_ref=weakref.ref(self)):
+            cache = _self_ref()
+            if cache is not None:
+                with cache._lock:
+                    cache._entries.pop(_key, None)
+
+        try:
+            ref = weakref.ref(x, _evict)
+        except TypeError:
+            return  # leaf type doesn't support weakrefs: never cached
+        with self._lock:
+            self._entries[key] = (ref, chunk_id, nbytes, dtype, list(shape))
+
+
+@dataclass
+class _LeafTask:
+    """One array leaf's unit of save work: either ``arr`` holds the host
+    copy to hash+write, or ``chunk_id`` names the already-known chunk (a
+    hash-cache hit — no host copy was ever made)."""
+
+    path: str
+    nbytes: int
+    dtype: str
+    shape: List[int]
+    arr: Optional[np.ndarray] = None
+    chunk_id: Optional[str] = None
+    origin: Any = None   # original leaf, for the cache's remember()
 
 
 @dataclass
@@ -167,7 +301,7 @@ class SaveHandle:
 class _SaveJob:
     handle: SaveHandle
     skeleton_frame: bytes
-    arrays: List[Tuple[str, np.ndarray]]
+    leaves: List[_LeafTask]
     step: int
     rank: int
     world_size: int
@@ -193,6 +327,7 @@ class CheckpointEngine:
         self.namespace = namespace
         self._state_client = state_client
         mf.init_root(self.root)
+        register_serve_root(self.root)
         self._queue: "queue.Queue[Optional[_SaveJob]]" = queue.Queue(
             maxsize=max(1, int(_config.checkpoint_queue_depth)))
         self._writer: Optional[threading.Thread] = None
@@ -201,6 +336,9 @@ class CheckpointEngine:
         self._inflight_chunks: set = set()   # GC must not reap these
         self._closed = False
         self.stats = EngineStats()
+        self._stats_lock = threading.Lock()  # io-pool workers share stats
+        self._hash_cache = _HashCache()
+        self._pool: Optional[ThreadPoolExecutor] = None
 
     # -- save -----------------------------------------------------------------
 
@@ -228,8 +366,8 @@ class CheckpointEngine:
                 "caller declares which leaves are axis-split (fnmatch "
                 "patterns over '/'-joined paths); placement is never "
                 "inferred from data")
-        arrays: List[Tuple[str, np.ndarray]] = []
-        skeleton = _extract_arrays(tree, (), arrays)
+        leaves: List[_LeafTask] = []
+        skeleton = _extract_arrays(tree, (), leaves, self._make_leaf)
         handle = SaveHandle(step, rank)
         trace: Tuple[str, str] = ("", "")
         if observability.ENABLED:
@@ -239,7 +377,7 @@ class CheckpointEngine:
         job = _SaveJob(
             handle=handle,
             skeleton_frame=bytes(dumps_framed(skeleton)),
-            arrays=arrays, step=step, rank=rank, world_size=world_size,
+            leaves=leaves, step=step, rank=rank, world_size=world_size,
             shard_axis=shard_axis,
             shard_paths=(None if shard_paths is None
                          else tuple(str(p) for p in shard_paths)),
@@ -253,6 +391,34 @@ class CheckpointEngine:
         if wait:
             handle.result()
         return handle
+
+    def _make_leaf(self, path: str, value: Any) -> _LeafTask:
+        """Caller-thread leaf builder: a hash-cache hit (plus a stat
+        proving the chunk is still on disk — GC may have reaped it) skips
+        the device->host copy entirely; everything else pays the copy now
+        so the training step can proceed while the writer hashes."""
+        hit = self._hash_cache.lookup(value)
+        if hit is not None:
+            chunk_id, nbytes, dtype, shape = hit
+            if os.path.exists(os.path.join(self.root,
+                                           mf.chunk_relpath(chunk_id))):
+                return _LeafTask(path=path, nbytes=nbytes, dtype=dtype,
+                                 shape=list(shape), chunk_id=chunk_id)
+        arr = np.ascontiguousarray(np.asarray(value))
+        return _LeafTask(path=path, nbytes=arr.nbytes, dtype=arr.dtype.str,
+                         shape=list(arr.shape), arr=arr, origin=value)
+
+    def _io_pool(self) -> Optional[ThreadPoolExecutor]:
+        """Shared hash/write worker pool; None = serial path
+        (``checkpoint_io_workers <= 1``)."""
+        n = int(_config.checkpoint_io_workers)
+        if n <= 1:
+            return None
+        with self._writer_lock:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=n, thread_name_prefix="ckpt-io")
+            return self._pool
 
     def _ensure_writer(self) -> None:
         with self._writer_lock:
@@ -289,8 +455,9 @@ class CheckpointEngine:
     def _write_chunk(self, chunk_id: str, pieces: List, nbytes: int) -> None:
         final = os.path.join(self.root, mf.chunk_relpath(chunk_id))
         if os.path.exists(final):
-            self.stats.chunks_deduped += 1
-            self.stats.bytes_deduped += nbytes
+            with self._stats_lock:
+                self.stats.chunks_deduped += 1
+                self.stats.bytes_deduped += nbytes
             return
         os.makedirs(os.path.dirname(final), exist_ok=True)
         tmp = f"{final}.tmp-{os.getpid()}-{threading.get_ident()}"
@@ -307,8 +474,9 @@ class CheckpointEngine:
             except OSError:
                 pass
             raise
-        self.stats.chunks_written += 1
-        self.stats.chunk_bytes_written += nbytes
+        with self._stats_lock:
+            self.stats.chunks_written += 1
+            self.stats.chunk_bytes_written += nbytes
 
     def _process(self, job: _SaveJob) -> Optional[str]:
         # Writer thread: adopt the context captured at save() so the
@@ -323,36 +491,79 @@ class CheckpointEngine:
             if token is not None:
                 observability.reset(token)
 
+    def _leaf_chunk(self, leaf: _LeafTask, dropped: bool,
+                    protected: List[str]) -> str:
+        """Hash + write one leaf (io-pool worker or inline on the writer
+        thread). Returns the chunk id."""
+        if leaf.chunk_id is not None:
+            # hash-cache hit: the chunk was stat-proven present at save()
+            # time — account the dedup without touching the bytes (no
+            # host copy, no hash, no write)
+            protected.append(leaf.chunk_id)
+            self._inflight_chunks.add(leaf.chunk_id)
+            with self._stats_lock:
+                self.stats.chunks_deduped += 1
+                self.stats.bytes_deduped += leaf.nbytes
+            return leaf.chunk_id
+        with observability.span("checkpoint.hash", cat="checkpoint",
+                                path=leaf.path):
+            chunk_id = _hash_array(leaf.arr)
+        protected.append(chunk_id)
+        self._inflight_chunks.add(chunk_id)
+        if leaf.origin is not None:
+            self._hash_cache.remember(leaf.origin, chunk_id, leaf.nbytes,
+                                      leaf.dtype, leaf.shape)
+        if not dropped:
+            payload = FramedPayload(leaf.arr)
+            with observability.span("checkpoint.write",
+                                    cat="checkpoint", path=leaf.path):
+                self._write_chunk(chunk_id, payload.pieces, leaf.nbytes)
+        return chunk_id
+
     def _process_stages(self, job: _SaveJob) -> Optional[str]:
         self.stats.saves += 1
         protected: List[str] = []
         try:
-            entries: List[ArrayEntry] = []
-            for slot, (path, arr) in enumerate(job.arrays):
-                with observability.span("checkpoint.hash", cat="checkpoint",
-                                        path=path):
-                    chunk_id = _hash_array(arr)
-                protected.append(chunk_id)
-                self._inflight_chunks.add(chunk_id)
+            pool = self._io_pool()
+            # Chaos choke points fire here, on the writer thread in leaf
+            # submission order — a schedule's nth checkpoint.write firing
+            # hits the same leaf with or without the worker pool.
+            results: List[Any] = []
+            for leaf in job.leaves:
                 dropped = False
                 if chaos.ENABLED:
-                    dropped = chaos.inject("checkpoint.write", path=path,
-                                           rank=str(job.rank)) == "drop"
-                if not dropped:
-                    payload = FramedPayload(arr)
-                    with observability.span("checkpoint.write",
-                                            cat="checkpoint", path=path):
-                        self._write_chunk(chunk_id, payload.pieces,
-                                          arr.nbytes)
+                    dropped = chaos.inject(
+                        "checkpoint.write", path=leaf.path,
+                        rank=str(job.rank)) == "drop"
+                if pool is None:
+                    results.append(self._leaf_chunk(leaf, dropped, protected))
+                else:
+                    results.append(pool.submit(
+                        self._leaf_chunk, leaf, dropped, protected))
+            if pool is not None:
+                chunk_ids, errors = [], []
+                for fut in results:
+                    try:
+                        chunk_ids.append(fut.result())
+                    except BaseException as e:  # noqa: BLE001
+                        errors.append(e)
+                        chunk_ids.append(None)
+                if errors:
+                    raise errors[0]
+            else:
+                chunk_ids = results
+            entries = [
+                ArrayEntry(
+                    path=leaf.path, slot=slot, chunk=cid, nbytes=leaf.nbytes,
+                    dtype=leaf.dtype, shape=list(leaf.shape),
+                    sharded=(job.shard_paths is not None and any(
+                        fnmatch.fnmatchcase(leaf.path, pat)
+                        for pat in job.shard_paths)))
                 # a dropped (lost) write still indexes the chunk: the
                 # committer's presence check then fails the save loudly
                 # instead of publishing a manifest missing the array
-                entries.append(ArrayEntry(
-                    path=path, slot=slot, chunk=chunk_id, nbytes=arr.nbytes,
-                    dtype=arr.dtype.str, shape=list(arr.shape),
-                    sharded=(job.shard_paths is not None and any(
-                        fnmatch.fnmatchcase(path, pat)
-                        for pat in job.shard_paths))))
+                for slot, (leaf, cid) in enumerate(zip(job.leaves,
+                                                       chunk_ids))]
             skel_id = mf.hash_bytes("skeleton", job.skeleton_frame)
             protected.append(skel_id)
             self._inflight_chunks.add(skel_id)
@@ -368,15 +579,20 @@ class CheckpointEngine:
                                arrays=entries)
             pend_dir = os.path.join(self.root, mf.PENDING_DIR, job.save_key)
             os.makedirs(pend_dir, exist_ok=True)
+            # fsync=False: the pending index only matters to a commit in
+            # THIS boot — a crash abandons the save either way, and the
+            # manifest/LATEST writes that make it durable still fsync
             mf.atomic_write_bytes(
                 os.path.join(pend_dir, f"shard-{job.rank}.json"),
                 json.dumps({"step": job.step, "world_size": job.world_size,
-                            "shard": shard.to_json()}).encode())
+                            "shard": shard.to_json()}).encode(),
+                fsync=False)
             if job.rank != 0:
                 return None
             return self._commit(job, pend_dir)
         finally:
-            self._inflight_chunks.difference_update(protected)
+            self._inflight_chunks.difference_update(
+                [c for c in protected if c])
 
     def _commit(self, job: _SaveJob, pend_dir: str) -> str:
         with observability.span("checkpoint.gather", cat="checkpoint",
@@ -528,9 +744,10 @@ class CheckpointEngine:
         return mf.resolve_latest(self.root)
 
     def restore(self, manifest_name: Optional[str] = None, *, rank: int = 0,
-                world_size: int = 1) -> Any:
+                world_size: int = 1,
+                fetch_from: Optional["ChunkFetcher"] = None) -> Any:
         return load(self.root, manifest_name, rank=rank,
-                    world_size=world_size)
+                    world_size=world_size, fetch_from=fetch_from)
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -555,24 +772,57 @@ class CheckpointEngine:
         self._closed = True
         with self._writer_lock:
             writer = self._writer
+            pool = self._pool
         if writer is not None and writer.is_alive():
             self._queue.put(None)
             writer.join(timeout=5.0)
+        if pool is not None:
+            pool.shutdown(wait=True)
 
 
 # -- engine-less read path ----------------------------------------------------
 
-def _read_chunk(root: str, chunk_id: str) -> bytes:
+#: ``fetch_from`` contract: ``(chunk_id) -> Optional[bytes]`` — the
+#: distributed runtime's striped remote chunk fetch, or any callable that
+#: can produce a missing chunk's bytes. None return = not found there
+#: either.
+ChunkFetcher = Callable[[str], Optional[bytes]]
+
+
+def _read_chunk(root: str, chunk_id: str,
+                fetch_from: Optional[ChunkFetcher] = None) -> bytes:
     path = os.path.join(root, mf.chunk_relpath(chunk_id))
     try:
         with open(path, "rb") as f:
             return f.read()
     except FileNotFoundError:
-        raise CheckpointCorruption(f"chunk {chunk_id[:12]}… missing at {root}")
+        if fetch_from is None:
+            raise CheckpointCorruption(
+                f"chunk {chunk_id[:12]}… missing at {root}")
+    try:
+        data = fetch_from(chunk_id)
+    except Exception as e:
+        raise CheckpointCorruption(
+            f"chunk {chunk_id[:12]}… missing at {root} and the remote "
+            f"fetch failed: {e}")
+    if data is None:
+        raise CheckpointCorruption(
+            f"chunk {chunk_id[:12]}… missing at {root} and at the remote "
+            "peer")
+    # Write-through: later entries (and later restores) find the chunk
+    # locally. Content-addressed + hash-verified on load, so no fsync —
+    # a torn write is caught and refetched, never trusted.
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        mf.atomic_write_bytes(path, data, fsync=False)
+    except OSError as e:
+        logger.debug("checkpoint: chunk write-through failed: %s", e)
+    return data
 
 
-def _load_array(root: str, e: ArrayEntry, verify: bool) -> np.ndarray:
-    value, _ = loads_framed(_read_chunk(root, e.chunk))
+def _load_array(root: str, e: ArrayEntry, verify: bool,
+                fetch_from: Optional[ChunkFetcher] = None) -> np.ndarray:
+    value, _ = loads_framed(_read_chunk(root, e.chunk, fetch_from))
     arr = np.asarray(value)
     if verify:
         got = _hash_array(np.ascontiguousarray(arr))
@@ -583,9 +833,25 @@ def _load_array(root: str, e: ArrayEntry, verify: bool) -> np.ndarray:
     return arr
 
 
-def _load_shard(root: str, shard: ShardIndex, verify: bool) -> Any:
-    skeleton, _ = loads_framed(_read_chunk(root, shard.skeleton))
-    slots = {e.slot: _load_array(root, e, verify) for e in shard.arrays}
+def _load_slots(root: str, entries: List[ArrayEntry], verify: bool,
+                fetch_from: Optional[ChunkFetcher]) -> Dict[int, np.ndarray]:
+    """Concurrent chunk reads (``checkpoint_io_workers``): restore is
+    read+hash per leaf, which overlaps the same way the save path does."""
+    workers = min(int(_config.checkpoint_io_workers), len(entries))
+    if workers <= 1:
+        return {e.slot: _load_array(root, e, verify, fetch_from)
+                for e in entries}
+    with ThreadPoolExecutor(max_workers=workers,
+                            thread_name_prefix="ckpt-read") as ex:
+        futs = [(e.slot, ex.submit(_load_array, root, e, verify, fetch_from))
+                for e in entries]
+        return {slot: f.result() for slot, f in futs}
+
+
+def _load_shard(root: str, shard: ShardIndex, verify: bool,
+                fetch_from: Optional[ChunkFetcher] = None) -> Any:
+    skeleton, _ = loads_framed(_read_chunk(root, shard.skeleton, fetch_from))
+    slots = _load_slots(root, shard.arrays, verify, fetch_from)
     return _inject_arrays(skeleton, slots)
 
 
@@ -633,21 +899,25 @@ def _finalize_sharding(shards: List[ShardIndex], axis: int) -> None:
 
 
 def _load_resharded(root: str, m: Manifest, rank: int, world_size: int,
-                    verify: bool) -> Any:
+                    verify: bool,
+                    fetch_from: Optional[ChunkFetcher] = None) -> Any:
     """World size changed on an axis-sharded save: rebuild each global
-    array from recorded offsets, then take this rank's equal split."""
+    array from recorded offsets, then take this rank's equal split. One
+    worker per leaf (each assembles its shard parts serially into the
+    global buffer) keeps reads+hashing concurrent without two workers
+    racing on one destination array."""
     axis = m.shard_axis
     assert axis is not None
-    skeleton, _ = loads_framed(_read_chunk(root, m.shards[0].skeleton))
-    slots: Dict[int, np.ndarray] = {}
-    for e0 in m.shards[0].arrays:
+    skeleton, _ = loads_framed(_read_chunk(root, m.shards[0].skeleton,
+                                           fetch_from))
+
+    def _load_leaf(e0: ArrayEntry) -> np.ndarray:
         if e0.global_shape is None:
-            slots[e0.slot] = _load_array(root, e0, verify)
-            continue
+            return _load_array(root, e0, verify, fetch_from)
         glob = np.empty(tuple(e0.global_shape), dtype=np.dtype(e0.dtype))
         for s in m.shards:
             e = next(x for x in s.arrays if x.path == e0.path)
-            part = _load_array(root, e, verify)
+            part = _load_array(root, e, verify, fetch_from)
             sel = [slice(None)] * glob.ndim
             sel[axis] = slice(e.offset[axis], e.offset[axis] + e.shape[axis])
             glob[tuple(sel)] = part.reshape(tuple(e.shape))
@@ -655,14 +925,27 @@ def _load_resharded(root: str, m: Manifest, rank: int, world_size: int,
         lo, hi = rank * dim // world_size, (rank + 1) * dim // world_size
         sel = [slice(None)] * glob.ndim
         sel[axis] = slice(lo, hi)
-        slots[e0.slot] = glob[tuple(sel)]
+        return glob[tuple(sel)]
+
+    entries = m.shards[0].arrays
+    workers = min(int(_config.checkpoint_io_workers), len(entries))
+    if workers <= 1:
+        slots = {e0.slot: _load_leaf(e0) for e0 in entries}
+    else:
+        with ThreadPoolExecutor(max_workers=workers,
+                                thread_name_prefix="ckpt-read") as ex:
+            futs = [(e0.slot, ex.submit(_load_leaf, e0)) for e0 in entries]
+            slots = {slot: f.result() for slot, f in futs}
     return _inject_arrays(skeleton, slots)
 
 
 def load(root: str, manifest_name: Optional[str] = None, *, rank: int = 0,
-         world_size: int = 1) -> Any:
+         world_size: int = 1,
+         fetch_from: Optional[ChunkFetcher] = None) -> Any:
     """Restore one rank's view of a committed checkpoint (thread-free read
-    path; the engine's ``restore`` delegates here)."""
+    path; the engine's ``restore`` delegates here). ``fetch_from`` pulls
+    chunks missing under ``root`` from a remote peer (the distributed
+    runtime's striped transport fetch) and caches them write-through."""
     root = os.path.abspath(root)
     if manifest_name is None:
         manifest_name = mf.resolve_latest(root)
@@ -675,11 +958,12 @@ def load(root: str, manifest_name: Optional[str] = None, *, rank: int = 0,
     verify = bool(_config.checkpoint_hash_verify)
     if m.shard_axis is None:
         # replicated: every shard is a full tree; any one serves any rank
-        return _load_shard(root, m.shards[rank % len(m.shards)], verify)
+        return _load_shard(root, m.shards[rank % len(m.shards)], verify,
+                           fetch_from)
     if world_size == m.world_size:
         by_rank = {s.rank: s for s in m.shards}
-        return _load_shard(root, by_rank[rank], verify)
-    return _load_resharded(root, m, rank, world_size, verify)
+        return _load_shard(root, by_rank[rank], verify, fetch_from)
+    return _load_resharded(root, m, rank, world_size, verify, fetch_from)
 
 
 @dataclass
@@ -690,9 +974,10 @@ class CheckpointRef:
     root: str
     manifest_name: Optional[str] = None   # None = latest at load time
 
-    def load(self, rank: int = 0, world_size: int = 1) -> Any:
+    def load(self, rank: int = 0, world_size: int = 1,
+             fetch_from: Optional[ChunkFetcher] = None) -> Any:
         return load(self.root, self.manifest_name, rank=rank,
-                    world_size=world_size)
+                    world_size=world_size, fetch_from=fetch_from)
 
     def exists(self) -> bool:
         try:

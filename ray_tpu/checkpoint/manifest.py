@@ -58,16 +58,20 @@ class CheckpointNotFound(CheckpointError):
 
 # -- atomic file primitives ---------------------------------------------------
 
-def atomic_write_bytes(path: str, data: bytes) -> None:
+def atomic_write_bytes(path: str, data: bytes, *, fsync: bool = True) -> None:
     """tmp-in-same-dir + fsync + rename: ``path`` is either absent/old or
-    complete — never partial."""
+    complete — never partial. ``fsync=False`` keeps the rename atomicity
+    (no torn file visible to readers) but skips the durability barrier —
+    for files that only matter within this boot (pending shard indexes,
+    write-through chunk caches)."""
     d = os.path.dirname(path) or "."
     fd, tmp = tempfile.mkstemp(prefix=".tmp-", dir=d)
     try:
         with os.fdopen(fd, "wb") as f:
             f.write(data)
             f.flush()
-            os.fsync(f.fileno())
+            if fsync:
+                os.fsync(f.fileno())
         os.replace(tmp, path)
     except BaseException:
         try:

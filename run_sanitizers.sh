@@ -54,10 +54,10 @@ echo "== chaos gate (core suite under a fixed delay-only fault schedule) =="
 # without dropping anything, so correctness tests must still pass. A
 # failure here means a path depends on lucky timing, not on its retries.
 # Seed is fixed so the perturbation is reproducible run-to-run.
-RAY_TPU_CHAOS="20260805:rpc.client.send@3%7=delay(0.02);state.heartbeat@2%3=delay(0.05);object.push@2%5=delay(0.01);checkpoint.write@2%4=delay(0.01)" \
+RAY_TPU_CHAOS="20260805:rpc.client.send@3%7=delay(0.02);state.heartbeat@2%3=delay(0.05);object.push@2%5=delay(0.01);transport.stream@2%6=delay(0.01);checkpoint.write@2%4=delay(0.01)" \
 JAX_PLATFORMS=cpu \
 python -m pytest tests/test_core.py tests/test_actors.py tests/test_data_plane.py \
-    tests/test_checkpoint.py tests/test_tracing.py -q
+    tests/test_checkpoint.py tests/test_tracing.py tests/test_transport.py -q
 
 echo "== forensics gate (crash bundles sealed + doctor reads them back) =="
 # Hard-death drill: the forensics suite kills processes mid-task — via a

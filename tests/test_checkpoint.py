@@ -94,6 +94,64 @@ def test_warm_save_dedups_to_zero_chunk_bytes(tmp_path):
     eng.close()
 
 
+# -- warm-save content-hash cache ---------------------------------------------
+
+def test_warm_save_cache_skips_hashing_for_frozen_leaves(tmp_path):
+    """Frozen (writeable=False) numpy leaves model immutable device
+    buffers: a warm save of an unchanged tree must hit the hash cache —
+    no re-hash, no chunk write, full dedup — and still commit a
+    restorable manifest."""
+    rng = np.random.default_rng(1)
+    tree = {}
+    for i in range(4):
+        a = rng.standard_normal((64, 64))
+        a.setflags(write=False)
+        tree[f"l{i}"] = a
+    eng = CheckpointEngine(str(tmp_path))
+    eng.save(tree, step=1, wait=True)
+    cold_written = eng.stats.chunks_written
+    eng.save(tree, step=2, wait=True)
+    assert eng.stats.chunks_written == cold_written
+    assert eng.stats.chunks_deduped == 5  # 4 cache hits + skeleton
+    assert eng.stats.bytes_deduped >= sum(a.nbytes for a in tree.values())
+    back = load(str(tmp_path))
+    for k, a in tree.items():
+        np.testing.assert_array_equal(back[k], a)
+    eng.close()
+
+
+def test_warm_save_mutation_rehashes_exactly_that_leaf(tmp_path, monkeypatch):
+    """Mutating one leaf in place (thaw + scribble) must void exactly its
+    cache entry: the warm save re-hashes and re-writes that one leaf, the
+    rest stay cache hits, and the dedup accounting stays correct."""
+    from ray_tpu.checkpoint import engine as eng_mod
+    rng = np.random.default_rng(2)
+    tree = {f"l{i}": rng.standard_normal((32, 32 + i)) for i in range(4)}
+    for a in tree.values():
+        a.setflags(write=False)
+    eng = CheckpointEngine(str(tmp_path))
+    eng.save(tree, step=1, wait=True)
+
+    hashed = []
+    real_hash = eng_mod._hash_array
+    monkeypatch.setattr(
+        eng_mod, "_hash_array",
+        lambda a: (hashed.append(a.shape), real_hash(a))[-1])
+    tree["l2"].setflags(write=True)   # thaw: the cache may no longer trust it
+    tree["l2"][0, 0] += 1.0
+    before_written = eng.stats.chunks_written
+    before_dedup = eng.stats.bytes_deduped
+    eng.save(tree, step=2, wait=True)
+    assert hashed == [(32, 34)]       # exactly leaf l2, nothing else
+    assert eng.stats.chunks_written == before_written + 1
+    assert eng.stats.bytes_deduped - before_dedup >= sum(
+        a.nbytes for k, a in tree.items() if k != "l2")
+    back = load(str(tmp_path))
+    np.testing.assert_array_equal(back["l2"], tree["l2"])
+    np.testing.assert_array_equal(back["l0"], tree["l0"])
+    eng.close()
+
+
 # -- crash atomicity under chaos ----------------------------------------------
 
 _CRASH_PROG = """\
@@ -134,6 +192,51 @@ def test_crash_leaves_consistent_checkpoint(tmp_path, spec):
     restored = load(root, name)
     assert restored["epoch"] == m.step
     np.testing.assert_array_equal(restored["w"], np.arange(16.0) * m.step)
+
+
+_POOL_CRASH_PROG = """\
+import sys
+import numpy as np
+from ray_tpu._private.config import _config
+from ray_tpu.checkpoint import CheckpointEngine
+root = sys.argv[1]
+_config.set("checkpoint_io_workers", 4)
+eng = CheckpointEngine(root)
+def tree(step):
+    t = {"epoch": step}
+    for i in range(8):
+        t[f"l{i}"] = np.arange(4096.0) * (step * 10 + i)
+    return t
+eng.save(tree(1), step=1, wait=True)
+eng.save(tree(2), step=2, wait=True)
+print("SURVIVED")
+"""
+
+
+def test_hard_kill_during_pooled_write_leaves_consistent_checkpoint(tmp_path):
+    """The worker-pool variant of the crash drill: each save fires
+    checkpoint.write 9 times (8 leaves + skeleton) on the writer thread,
+    so @12=exit dies during step 2's submission loop while pool workers
+    are still writing step-2 chunks concurrently. Whatever half-written
+    tmp files the kill strands, the store must still resolve to the
+    complete, hash-verified step-1 checkpoint."""
+    root = str(tmp_path / "store")
+    env = dict(os.environ, RAY_TPU_CHAOS="1:checkpoint.write@12=exit",
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", _POOL_CRASH_PROG, root],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=180)
+    assert proc.returncode != 0, proc.stdout + proc.stderr
+    assert "SURVIVED" not in proc.stdout
+    name = resolve_latest(root)
+    assert name is not None
+    m = read_manifest(root, name)
+    assert m.step == 1
+    restored = load(root, name)   # checkpoint_hash_verify re-hashes chunks
+    assert restored["epoch"] == 1
+    for i in range(8):
+        np.testing.assert_array_equal(restored[f"l{i}"],
+                                      np.arange(4096.0) * (10 + i))
 
 
 def test_dropped_write_refuses_torn_manifest(tmp_path):

@@ -235,6 +235,9 @@ def two_runtimes(monkeypatch):
     # stripes into many chunks
     _config.set("arena_enabled", False)
     _config.set("fetch_chunk_bytes", 256 * 1024)
+    # pin the stream count: the default (-1) auto-tunes from the
+    # transport probe, which would make the assertions box-dependent
+    _config.set("data_streams_per_peer", 4)
     _FakeState.registry = {}
     monkeypatch.setattr(dist, "StateClient", _FakeState)
     rts = [dist.DistributedRuntime("fake-state:0", ResourceSet({"CPU": 2.0}),
