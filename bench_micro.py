@@ -220,6 +220,78 @@ def bench_recorder_overhead(prefix: str, n: int = 800):
     emit(f"{prefix}_recorder_overhead_pct", statistics.median(pcts), "%")
 
 
+def bench_perf_overhead(prefix: str, n: int = 300):
+    """Perf-plane cost, two paired A/Bs (recorder-style pairing so slow
+    machine drift cancels inside each pair):
+
+    - ``_perf_overhead_pct``: latency histograms recording vs the
+      module-bool fast path, on the tiny-task round trip (the task path
+      observes execute/e2e/sched inline, so this measures the real
+      observe cost, not an uninstrumented loop);
+    - ``_sampler_overhead_pct``: the periodic stack sampler at its
+      default hz on top of enabled histograms, on the 1KB put/get hot
+      path (the sampler is a background thread — its cost is stolen
+      cycles, not inline work).
+
+    Also emits the task.execute quantiles the whole inproc run
+    accumulated (p50/p99, us) so ``--check`` gates latency
+    *distribution* drift against the recorded baseline, not just
+    throughput means."""
+    import statistics
+
+    import ray_tpu
+    from ray_tpu.observability import perf, sampler
+
+    @ray_tpu.remote
+    def tiny():
+        return None
+
+    def task_us():
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ray_tpu.get(tiny.remote())
+        return (time.perf_counter() - t0) / n * 1e6
+
+    small = np.zeros(128, np.int64)
+
+    def put_get_us():
+        t0 = time.perf_counter()
+        for _ in range(800):
+            ray_tpu.get(ray_tpu.put(small))
+        return (time.perf_counter() - t0) / 800 * 1e6
+
+    was = perf.ENABLED
+    task_us()  # warm
+    pcts = []
+    for _ in range(5):
+        perf.disable()
+        off = task_us()
+        perf.enable()
+        on = task_us()
+        pcts.append(100.0 * (on - off) / off)
+    if not was:
+        perf.disable()
+    emit(f"{prefix}_perf_overhead_pct", statistics.median(pcts), "%")
+
+    put_get_us()  # warm
+    spcts = []
+    for _ in range(5):
+        base_run = put_get_us()
+        sampler.start()
+        try:
+            with_sampler = put_get_us()
+        finally:
+            sampler.stop()
+        spcts.append(100.0 * (with_sampler - base_run) / base_run)
+    emit(f"{prefix}_sampler_overhead_pct", statistics.median(spcts), "%")
+
+    counts, sum_ms = perf.get("task.execute").merged()
+    if sum(counts):
+        s = perf.summarize(counts, sum_ms)
+        emit(f"{prefix}_task_execute_p50_us", s["p50_ms"] * 1e3, "us")
+        emit(f"{prefix}_task_execute_p99_us", s["p99_ms"] * 1e3, "us")
+
+
 def bench_transport():
     """Startup bandwidth probe: what the transport auto-tuner measured on
     this host — and therefore which chunk size, stream count and socket
@@ -367,6 +439,7 @@ def run_inproc():
     bench_put_get("inproc")
     bench_trace_overhead("inproc")
     bench_recorder_overhead("inproc")
+    bench_perf_overhead("inproc")
     ray_tpu.shutdown()
 
 

@@ -273,3 +273,21 @@ _config.define("preempt_lead_s", float, 10.0,
                "(eviction lead time promised by the provider)")
 _config.define("preempt_poll_ms", int, 500,
                "preemption watcher poll period in the host daemon")
+
+# -- Performance plane (streaming histograms + sampling profiler) ---------------
+_config.define("perf_enabled", bool, True,
+               "continuous performance plane: streaming log-scale latency "
+               "histograms on every hot path (rpc/task/fetch/checkpoint/"
+               "serve/drain) plus the periodic stack sampler")
+_config.define("perf_hist_buckets", int, 64,
+               "bucket count per latency histogram; bounds are geometric "
+               "from 1us to 60s, so more buckets = tighter quantile error")
+_config.define("perf_sampler_hz", float, 19.0,
+               "stack-sampler frequency per process; 0 disables the sampler "
+               "while leaving the histograms on")
+_config.define("perf_top_interval_s", float, 2.0,
+               "`ray-tpu top` refresh period between head polls")
+_config.define("serve_ingress_put_threshold_bytes", int, 256 * 1024,
+               "serve ingress bodies at least this large are put() into the "
+               "object plane and handed to the replica as a ref, so the "
+               "bytes ride the striped transport pool instead of pickle")

@@ -58,6 +58,7 @@ import cloudpickle
 
 from ray_tpu import chaos, observability
 from ray_tpu import exceptions as exc
+from ray_tpu.observability import perf
 from ray_tpu._private.backoff import BackoffPolicy, BreakerBoard
 from ray_tpu._private.config import _config
 from ray_tpu._private.framing import (FRAME_MAGIC as _FRAME_MAGIC,
@@ -96,25 +97,12 @@ _dumps_framed = dumps_framed
 _loads_framed = loads_framed
 
 
-_stripe_hist_m = None
 _breaker_counter_m = None
 
 
-def _stripe_hist():
-    # Lazy singletons: metric objects are created at first use, not at
-    # import (the registry may be cleared between tests).
-    global _stripe_hist_m
-    if _stripe_hist_m is None:
-        _stripe_hist_m = _metrics.Histogram(
-            "fetch_stripe_ms",
-            "per-chunk striped-fetch round-trip by peer (ms)",
-            boundaries=(0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0, 500.0,
-                        1000.0, 5000.0),
-            tag_keys=("peer",))
-    return _stripe_hist_m
-
-
 def _breaker_transitions():
+    # Lazy singleton: metric objects are created at first use, not at
+    # import (the registry may be cleared between tests).
     global _breaker_counter_m
     if _breaker_counter_m is None:
         _breaker_counter_m = _metrics.Counter(
@@ -691,6 +679,7 @@ class DistributedRuntime(Runtime):
                 if extra > 0 and self._hb_stop.wait(extra):
                     return
             for peer, code in self.breakers.snapshot().items():
+                # raylint: allow(metrics-cardinality) one series per peer daemon, bounded by cluster size
                 self._breaker_gauge.set(code, tags={"peer": peer})
 
     def _view_loop(self):
@@ -1055,8 +1044,13 @@ class DistributedRuntime(Runtime):
 
             def _push_one(oid: ObjectID, addr: str) -> None:
                 nonlocal migrated
+                t0 = time.monotonic() if perf.ENABLED else 0.0
                 try:
-                    if self._drain_push_object(oid, addr):
+                    pushed = self._drain_push_object(oid, addr)
+                    if t0:
+                        perf.observe("drain.migrate",
+                                     (time.monotonic() - t0) * 1e3)
+                    if pushed:
                         with acct_lock:
                             migrated += 1
                             self._drain_migrated_gauge.set(migrated)
@@ -1523,6 +1517,15 @@ class DistributedRuntime(Runtime):
         return None, False
 
     def _fetch_from(self, addr: str, oid: ObjectID):
+        if not perf.ENABLED:
+            return self._fetch_from_impl(addr, oid)
+        t0 = time.monotonic()
+        try:
+            return self._fetch_from_impl(addr, oid)
+        finally:
+            perf.observe("fetch.object", (time.monotonic() - t0) * 1e3)
+
+    def _fetch_from_impl(self, addr: str, oid: ObjectID):
         """Pull of a pickled object. Same-host owners serve through the
         shared arena (one shm read, zero payload bytes on the wire);
         otherwise chunked TCP: a small probe request reveals total_size,
@@ -1612,13 +1615,12 @@ class DistributedRuntime(Runtime):
             streams=streams)
 
         def _submit(stream, off, done_cb):
-            t0 = time.monotonic() if observability.ENABLED else 0.0
+            t0 = time.monotonic() if perf.ENABLED else 0.0
 
             def cb(env, error):
                 if t0:
-                    _stripe_hist().observe(
-                        (time.monotonic() - t0) * 1e3,
-                        tags={"peer": addr})
+                    perf.observe("fetch.stripe",
+                                 (time.monotonic() - t0) * 1e3)
                 try:
                     if error is None:
                         crep = pb.FetchObjectReply()
@@ -2473,6 +2475,7 @@ class DistributedRuntime(Runtime):
         failures): shed scheduling traffic to it until the half-open probe
         succeeds — the existing suspect-address exclusion is the mechanism."""
         logger.warning("circuit breaker OPEN for peer %s", addr)
+        # raylint: allow(metrics-cardinality) one series per peer daemon, bounded by cluster size
         _breaker_transitions().inc(tags={"peer": addr, "to": "open"})
         if observability.ENABLED:
             observability.instant("breaker:open", cat="breaker", peer=addr)
@@ -3509,6 +3512,13 @@ class DistributedRuntime(Runtime):
             from ray_tpu.observability import recorder as _flight
             payload["stacks"] = _flight.thread_stacks()
             payload["inflight"] = _flight.inflight_snapshot()
+            # Sampling profiler (perf plane): cumulative folded-stack
+            # profile rides the same reply, so /api/profile federates
+            # without a new proto field (windows are diffed head-side).
+            from ray_tpu.observability import sampler as _sampler
+            prof = _sampler.profile_snapshot()
+            if prof is not None:
+                payload["profile"] = prof
         if req.include_bundles:
             # cluster-wide forensics without a shared filesystem: each
             # daemon ships its host's recordings + sealed crash bundles
@@ -3836,11 +3846,14 @@ class _PushManager:
         self._pool.submit(self._run, addr, oid, threshold)
 
     def _run(self, addr: str, oid: ObjectID, threshold: int):
+        t0 = 0.0
         try:
             payload = self.rt._serialized_for_fetch(oid)
             total = len(payload)
             if total < threshold:
                 return
+            if perf.ENABLED:
+                t0 = time.monotonic()
             # Bulk bytes ride a shared-pool data stream (one per object,
             # picked deterministically so chunks of the same object stay
             # ordered on one socket), keeping pushes off the multiplexed
@@ -3896,6 +3909,8 @@ class _PushManager:
             if isinstance(e, (ConnectionError, TimeoutError, OSError)):
                 self.rt.breakers.record_failure(addr)
         finally:
+            if t0:
+                perf.observe("push.object", (time.monotonic() - t0) * 1e3)
             with self._cv:
                 self._active.discard((addr, oid))
 

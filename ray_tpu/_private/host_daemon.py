@@ -196,6 +196,13 @@ def main(argv=None) -> int:
     except Exception:
         logging.warning("node reporter unavailable", exc_info=True)
 
+    # Perf plane: per-process stack sampler (profiles federate through
+    # NODE_DEBUG include_stacks -> dashboard /api/profile).
+    from ray_tpu.observability import perf as _perf
+    from ray_tpu.observability import sampler as _stack_sampler
+    if _perf.ENABLED:
+        _stack_sampler.start()
+
     # Posthumous-sealing sweep: a surviving daemon on the host seals crash
     # bundles for siblings that died without running their hooks (SIGKILL).
     from ray_tpu._private.config import _config
@@ -226,6 +233,7 @@ def main(argv=None) -> int:
                 except Exception:  # noqa: BLE001  # raylint: allow(swallow) sweep is best-effort; next pass retries
                     pass
     finally:
+        _stack_sampler.stop()
         if reporter is not None:
             reporter.stop()
         try:

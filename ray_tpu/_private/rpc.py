@@ -26,6 +26,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 from ray_tpu import chaos, observability
 from ray_tpu._private.config import _config
+from ray_tpu.observability import perf
 from ray_tpu.protocol import pb
 
 # raylint: hot-path  (payload plane: R8 flags hidden payload copies)
@@ -54,23 +55,6 @@ class RpcConnectionError(ConnectionError):
 def _method_name(method: int) -> str:
     return (pb.Method.Name(method) if method in pb.Method.values()
             else str(method))
-
-
-_rpc_hist = None
-
-
-def _rpc_latency_hist():
-    # Lazy singleton: only paid when tracing is enabled, and metrics must
-    # not be a hard import for the wire layer.
-    global _rpc_hist
-    if _rpc_hist is None:
-        from ray_tpu.util.metrics import Histogram
-        _rpc_hist = Histogram(
-            "rpc_client_call_ms", "RPC round-trip latency by method (ms)",
-            boundaries=(0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0,
-                        500.0, 1000.0, 5000.0),
-            tag_keys=("method",))
-    return _rpc_hist
 
 
 class RpcRemoteError(RuntimeError):
@@ -185,6 +169,7 @@ class RpcClient:
         self.address = address
         if connect_timeout is None:
             connect_timeout = _config.get("rpc_connect_timeout_s")
+        _t0 = time.monotonic() if perf.ENABLED else 0.0
         try:
             if chaos.ENABLED:
                 chaos.inject("rpc.client.connect", peer=address)
@@ -193,6 +178,8 @@ class RpcClient:
         except OSError as e:
             raise RpcConnectionError(
                 f"connect to {address} failed: {e}") from e
+        if _t0:
+            perf.observe("rpc.connect", (time.monotonic() - _t0) * 1e3)
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         if sock_buf_bytes > 0:
@@ -263,6 +250,7 @@ class RpcClient:
             tctx = observability.wire_context()
             if tctx:
                 env.trace = tctx
+        if perf.ENABLED:
             t0 = time.monotonic()
         try:
             self._send(env, raw=raw)
@@ -279,9 +267,7 @@ class RpcClient:
         if reply.error:
             raise RpcRemoteError(reply.error)
         if t0:
-            _rpc_latency_hist().observe(
-                (time.monotonic() - t0) * 1e3,
-                tags={"method": _method_name(method)})
+            perf.observe("rpc.call", (time.monotonic() - t0) * 1e3)
         return reply
 
     def call_async(self, method: int, body: bytes,
@@ -296,12 +282,11 @@ class RpcClient:
         tctx = ""
         if observability.ENABLED:
             tctx = observability.wire_context()
+        if perf.ENABLED:
             _t0, _cb = time.monotonic(), callback
 
-            def callback(env, error, _cb=_cb, _t0=_t0, _m=method):
-                _rpc_latency_hist().observe(
-                    (time.monotonic() - _t0) * 1e3,
-                    tags={"method": _method_name(_m)})
+            def callback(env, error, _cb=_cb, _t0=_t0):
+                perf.observe("rpc.call", (time.monotonic() - _t0) * 1e3)
                 _cb(env, error)
 
         pending = _Pending()

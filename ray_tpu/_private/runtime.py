@@ -48,6 +48,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu import chaos, observability
 from ray_tpu import exceptions as exc
+from ray_tpu.observability import perf
 from ray_tpu.observability import recorder as _flight
 from ray_tpu._private.backoff import BackoffPolicy
 from ray_tpu._private.config import _config
@@ -549,6 +550,8 @@ class Runtime:
 
     def submit_task(self, spec: TaskSpec) -> List[ObjectID]:
         self._attach_trace(spec)
+        if perf.ENABLED and not spec.perf_submit_s:
+            spec.perf_submit_s = time.time()
         if not spec.return_ids:
             spec.return_ids = tuple(
                 ObjectID.for_return(spec.task_id, i)
@@ -889,6 +892,13 @@ class Runtime:
             alloc_target.release(request)
             self._unpin_args(spec)
             dur = time.monotonic() - t0
+            if perf.ENABLED:
+                perf.observe("task.execute", dur * 1e3)
+                if spec.perf_submit_s:
+                    e2e = time.time() - spec.perf_submit_s
+                    if e2e >= dur:
+                        perf.observe("task.e2e", e2e * 1e3)
+                        perf.observe("task.sched", (e2e - dur) * 1e3)
             self.emit_event("TASK_DONE", task=spec.function_name,
                             ms=round(dur * 1e3, 3))
             span_args = {"task_id": spec.task_id.hex()}

@@ -53,6 +53,12 @@ class TaskSpec:
     # task's span to the span that submitted it.
     trace_id: str = ""
     parent_span_id: str = ""
+    # Perf plane: wall-clock submit stamp (time.time(), set only when
+    # perf.ENABLED) so the executing side can split scheduling wait from
+    # execution in the task.e2e / task.sched histograms.  Wall clock
+    # because submit and execute may be different processes; negative
+    # cross-host skew is discarded at the observe site.
+    perf_submit_s: float = 0.0
 
     def is_actor_task(self) -> bool:
         return self.actor_id is not None

@@ -39,6 +39,7 @@ import time
 from typing import Callable, Dict, Iterable, List, Optional
 
 from ray_tpu import chaos
+from ray_tpu.observability import perf
 from ray_tpu._private.backoff import BackoffPolicy
 from ray_tpu._private.config import _config
 from ray_tpu._private.rpc import (RpcClient, RpcConnectionError,
@@ -274,6 +275,18 @@ class StripedTransfer:
     def run(self, offsets: Iterable[int],
             submit: Callable[[RpcClient, int, Callable], None],
             fatal: tuple = (RpcRemoteError,)) -> None:
+        if not perf.ENABLED:
+            return self._run(offsets, submit, fatal)
+        t0 = time.monotonic()
+        try:
+            return self._run(offsets, submit, fatal)
+        finally:
+            perf.observe("transport.striped_run",
+                         (time.monotonic() - t0) * 1e3)
+
+    def _run(self, offsets: Iterable[int],
+             submit: Callable[[RpcClient, int, Callable], None],
+             fatal: tuple = (RpcRemoteError,)) -> None:
         pending = list(offsets)
         if not pending:
             return
@@ -295,7 +308,15 @@ class StripedTransfer:
                         done.set()
 
             def _done_cb(off):
-                return lambda error: _settle(off, error)
+                if not perf.ENABLED:
+                    return lambda error: _settle(off, error)
+                t0 = time.monotonic()  # created immediately before submit
+
+                def _cb(error, _t0=t0, _off=off):
+                    perf.observe("transport.chunk",
+                                 (time.monotonic() - _t0) * 1e3)
+                    _settle(_off, error)
+                return _cb
 
             for i, off in enumerate(pending):
                 if chaos.ENABLED:

@@ -93,6 +93,12 @@ def init(num_cpus: Optional[float] = None,
             _flight.install("driver")
         except Exception as e:
             logger.warning("flight recorder unavailable: %s", e)
+        # Perf plane: the driver samples its own stacks too, so /api/profile
+        # covers the submitting side of every workload.
+        from ray_tpu.observability import perf as _perf
+        from ray_tpu.observability import sampler as _stack_sampler
+        if _perf.ENABLED:
+            _stack_sampler.start()
         if auth_token:
             # Process-wide: every RPC connection (state client, daemon
             # peers) opens with this shared secret (rpc.default_auth_token).
@@ -150,6 +156,8 @@ def init(num_cpus: Optional[float] = None,
 
 def shutdown():
     global _global
+    from ray_tpu.observability import sampler as _stack_sampler
+    _stack_sampler.stop()
     with _global_lock:
         hooks, _shutdown_hooks[:] = list(_shutdown_hooks), []
     for hook in hooks:

@@ -71,6 +71,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ray_tpu import chaos, observability
+from ray_tpu.observability import perf
 from ray_tpu._private.config import _config
 from ray_tpu._private.framing import FramedPayload, dumps_framed, loads_framed
 from ray_tpu.checkpoint import manifest as mf
@@ -453,6 +454,16 @@ class CheckpointEngine:
     # -- the write path (writer thread) ---------------------------------------
 
     def _write_chunk(self, chunk_id: str, pieces: List, nbytes: int) -> None:
+        if not perf.ENABLED:
+            return self._write_chunk_impl(chunk_id, pieces, nbytes)
+        t0 = time.monotonic()
+        try:
+            return self._write_chunk_impl(chunk_id, pieces, nbytes)
+        finally:
+            perf.observe("ckpt.write", (time.monotonic() - t0) * 1e3)
+
+    def _write_chunk_impl(self, chunk_id: str, pieces: List,
+                          nbytes: int) -> None:
         final = os.path.join(self.root, mf.chunk_relpath(chunk_id))
         if os.path.exists(final):
             with self._stats_lock:
@@ -483,11 +494,14 @@ class CheckpointEngine:
         # stage spans below land in the submitting trace.
         token = (observability.set_current(*job.trace)
                  if observability.ENABLED and job.trace[0] else None)
+        t0 = time.monotonic() if perf.ENABLED else 0.0
         try:
             with observability.span("checkpoint.save", cat="checkpoint",
                                     step=str(job.step), rank=str(job.rank)):
                 return self._process_stages(job)
         finally:
+            if t0:
+                perf.observe("ckpt.save", (time.monotonic() - t0) * 1e3)
             if token is not None:
                 observability.reset(token)
 
@@ -505,9 +519,12 @@ class CheckpointEngine:
                 self.stats.chunks_deduped += 1
                 self.stats.bytes_deduped += leaf.nbytes
             return leaf.chunk_id
+        t0 = time.monotonic() if perf.ENABLED else 0.0
         with observability.span("checkpoint.hash", cat="checkpoint",
                                 path=leaf.path):
             chunk_id = _hash_array(leaf.arr)
+        if t0:
+            perf.observe("ckpt.hash", (time.monotonic() - t0) * 1e3)
         protected.append(chunk_id)
         self._inflight_chunks.add(chunk_id)
         if leaf.origin is not None:
@@ -609,6 +626,7 @@ class CheckpointEngine:
                 f"step {job.step}: chunk(s) missing at commit time "
                 "(lost or dropped write) — refusing to publish a torn "
                 "manifest")
+        t0 = time.monotonic() if perf.ENABLED else 0.0
         with observability.span("checkpoint.commit", cat="checkpoint",
                                 step=str(job.step)):
             if chaos.ENABLED:
@@ -619,6 +637,8 @@ class CheckpointEngine:
                 chaos.inject("checkpoint.commit", stage="latest",
                              step=str(job.step))
             mf.set_latest(self.root, name)
+        if t0:
+            perf.observe("ckpt.commit", (time.monotonic() - t0) * 1e3)
         self.stats.commits += 1
         self._register(name)
         self._cleanup_pending(pend_dir)

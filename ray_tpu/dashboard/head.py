@@ -34,6 +34,16 @@ Endpoints:
                       Prometheus exposition, each sample labeled with
                       its source node; unreachable hosts surface as
                       ``federation_missing_hosts`` samples
+  /api/perf         — perf-plane latency quantiles: per-node and
+                      cluster-merged count/mean/p50/p95/p99 for every
+                      perf histogram (rpc/task/fetch/ckpt/serve/...),
+                      exact merge of the raw bucket counts riding the
+                      metric federation
+  /api/profile?host=X&seconds=N
+                    — federated sampling-profiler output (collapsed
+                      stacks + pprof-shaped JSON). seconds=0 returns
+                      cumulative profiles; seconds>0 diffs two
+                      snapshots that far apart (the window's samples)
   /api/forensics    — cluster-wide crash forensics: every alive
                       daemon's live thread stacks, in-flight tasks and
                       on-disk flight recordings / sealed crash bundles
@@ -304,6 +314,98 @@ class DashboardHead:
                                 "error": str(e)})
         return snaps, missing
 
+    # -- perf plane ------------------------------------------------------
+    def _perf(self) -> dict:
+        """Cluster latency quantiles: per-node and cluster-merged
+        count/mean/p50/p95/p99 per perf histogram, computed from the raw
+        bucket counts that ride the federated metric snapshots (the
+        ``"perf"`` payload in each ``raytpu_perf_*`` family). The merge
+        is exact — same bucket layout everywhere, counts just add."""
+        from ray_tpu.observability import perf as perf_mod
+        snaps, missing = self._metric_snapshots()
+        nodes = {}
+        agg: dict = {}
+        for node, fams in snaps.items():
+            per = {}
+            for name, p in perf_mod.extract_perf(fams).items():
+                counts = [int(c) for c in p["counts"]]
+                sum_ms = float(p.get("sum_ms", 0.0))
+                bounds = p.get("bounds")
+                per[name] = perf_mod.summarize(counts, sum_ms, bounds)
+                a = agg.setdefault(name, {"counts": [], "sum_ms": 0.0,
+                                          "bounds": bounds})
+                a["counts"] = perf_mod.merge_counts([a["counts"], counts])
+                a["sum_ms"] += sum_ms
+            if per:
+                nodes[node] = per
+        cluster = {name: perf_mod.summarize(a["counts"], a["sum_ms"],
+                                            a["bounds"])
+                   for name, a in agg.items()}
+        return {"ts": time.time(), "nodes": nodes, "cluster": cluster,
+                "missing_hosts": missing}
+
+    def _profile_snapshots(self, host: str = "") -> "tuple[dict, list]":
+        """({host_label: cumulative profile}, missing) — the head's own
+        sampler plus each alive daemon's (NODE_DEBUG include_stacks
+        carries ``payload["profile"]``). ``host`` filters by label
+        prefix ("head", "node:ab12cd34", or a node-id prefix)."""
+        from ray_tpu.protocol import pb
+        from ray_tpu.observability import sampler as _sampler
+        out = {}
+        missing = []
+
+        def _want(label):
+            return (not host or label.startswith(host)
+                    or label.startswith(f"node:{host}"))
+
+        if _want("head"):
+            prof = _sampler.profile_snapshot()
+            if prof is not None:
+                out["head"] = prof
+        for nid, addr in self._alive_addrs():
+            label = f"node:{nid[:8]}"
+            if not _want(label):
+                continue
+            try:
+                rep = pb.NodeDebugReply()
+                rep.ParseFromString(self.pool.get(addr).call(
+                    pb.NODE_DEBUG, pb.NodeDebugRequest(
+                        log_lines=0, include_tasks=False,
+                        include_stacks=True).SerializeToString(),
+                    timeout=15).body)
+                payload = json.loads(bytes(rep.payload_json).decode())
+                prof = payload.get("profile")
+                if prof:
+                    out[label] = prof
+            except Exception as e:
+                logger.debug("dashboard: profile fetch from %s failed: %s",
+                             addr, e)
+                missing.append({"node_id": nid, "address": addr,
+                                "error": str(e)})
+        return out, missing
+
+    def _profile(self, host: str = "", seconds: float = 0.0) -> dict:
+        """Federated sampling profile. ``seconds=0`` returns cumulative
+        profiles (since each sampler started); ``seconds>0`` takes two
+        cumulative snapshots that far apart and returns the window's
+        difference — no wire support needed beyond the cumulative
+        fetch. Response carries collapsed-stack text (flamegraph.pl
+        input) and pprof-shaped JSON of the cross-host merge."""
+        from ray_tpu.observability import sampler as _sampler
+        first, missing = self._profile_snapshots(host)
+        hosts = first
+        if seconds > 0:
+            time.sleep(min(float(seconds), 60.0))
+            second, missing = self._profile_snapshots(host)
+            hosts = {label: _sampler.diff_profiles(p, first.get(label, {}))
+                     for label, p in second.items()}
+        merged = _sampler.merge_profiles(list(hosts.values()))
+        return {"ts": time.time(), "seconds": seconds, "hosts": hosts,
+                "merged": merged,
+                "collapsed": _sampler.collapsed(merged),
+                "pprof": _sampler.pprof_json(merged),
+                "missing_hosts": missing}
+
     def _forensics(self) -> dict:
         """Cluster-wide crash forensics, the doctor's collection wire:
         per-node live thread stacks, in-flight task registry, and the
@@ -394,6 +496,12 @@ class DashboardHead:
                         snaps, missing = head._metric_snapshots()
                         self._json({"snapshots": snaps,
                                     "missing_hosts": missing})
+                    elif route == "/api/perf":
+                        self._json(head._perf())
+                    elif route == "/api/profile":
+                        self._json(head._profile(
+                            q.get("host", [""])[0],
+                            float(q.get("seconds", ["0"])[0])))
                     elif route == "/api/forensics":
                         self._json(head._forensics())
                     elif route == "/metrics":

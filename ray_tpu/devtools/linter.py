@@ -79,6 +79,11 @@ R14  span-leak      ``observability.span(...)`` used outside a ``with``
                     statement (outside the observability package): a
                     span not closed on every exit path leaks its
                     context var and never records
+R15  metrics-cardinality
+                    a metric tag value derived from unbounded runtime
+                    data (object/task/trace ids, raw peer addresses):
+                    every entity mints a new time series, growing the
+                    registry and every scrape without bound
 ==== ============== ====================================================
 
 R10-R12 run on the whole-program call graph built by
@@ -1450,6 +1455,84 @@ def check_span_leak(ctx: FileContext) -> Iterator[Finding]:
             "is not closed on every exit path (leaked context var, span "
             "never recorded) — use 'with observability.span(...):', or "
             "justify with '# raylint: allow(span-leak) <why>'")
+
+
+# R15: metric label cardinality (unbounded tag values)
+
+_METRIC_METHODS = {"inc", "set", "observe", "set_default_tags"}
+_UNBOUNDED_ID_RE = re.compile(
+    r"(?:^|_)(?:task_id|object_id|actor_id|trace_id|span_id|request_id|"
+    r"job_id|node_id|oid|uuid|addr|address|peer)$", re.IGNORECASE)
+
+
+def _unbounded_tag_value(expr: ast.expr) -> bool:
+    """True when a tag-value expression smells like per-entity runtime
+    data (an id hex, a raw address, an f-string embedding one) rather
+    than a small closed set of label values."""
+    if isinstance(expr, ast.Call):
+        fn = expr.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "hex":
+            return True
+        if isinstance(fn, ast.Name) and fn.id in ("str", "repr") and \
+                expr.args:
+            return _unbounded_tag_value(expr.args[0])
+        return False
+    if isinstance(expr, ast.Name):
+        return bool(_UNBOUNDED_ID_RE.search(expr.id))
+    if isinstance(expr, ast.Attribute):
+        return bool(_UNBOUNDED_ID_RE.search(expr.attr))
+    if isinstance(expr, ast.JoinedStr):
+        return any(isinstance(v, ast.FormattedValue)
+                   and _unbounded_tag_value(v.value)
+                   for v in expr.values)
+    if isinstance(expr, ast.BinOp):
+        return _unbounded_tag_value(expr.left) or \
+            _unbounded_tag_value(expr.right)
+    if isinstance(expr, ast.Subscript):
+        return _unbounded_tag_value(expr.value)
+    return False
+
+
+@rule("R15", "metrics-cardinality")
+def check_metrics_cardinality(ctx: FileContext) -> Iterator[Finding]:
+    """A metric tag whose value is per-entity runtime data (object/task/
+    trace ids, raw peer addresses) mints a new time series per entity:
+    the registry, every scrape and the federated export all grow without
+    bound, and the aggregation the label was supposed to enable drowns
+    in one-sample series.  Flags ``inc``/``set``/``observe``/
+    ``set_default_tags`` calls whose ``tags`` dict-literal values look
+    unbounded (``.hex()`` of an id, id-ish names, f-strings embedding
+    either).  Values genuinely bounded by something small (cluster
+    size) are justified in place with
+    ``# raylint: allow(metrics-cardinality) <why>``."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or \
+                not isinstance(node.func, ast.Attribute) or \
+                node.func.attr not in _METRIC_METHODS:
+            continue
+        tags = None
+        for kw in node.keywords:
+            if kw.arg == "tags":
+                tags = kw.value
+        if tags is None and node.func.attr == "set_default_tags" and \
+                node.args:
+            tags = node.args[0]
+        if not isinstance(tags, ast.Dict):
+            continue
+        bad = [k.value for k, v in zip(tags.keys, tags.values)
+               if isinstance(k, ast.Constant) and _unbounded_tag_value(v)]
+        if not bad:
+            continue
+        if ctx.allowed(node.lineno, "R15", "metrics-cardinality"):
+            continue
+        yield Finding(
+            "R15", "metrics-cardinality", ctx.relpath, node.lineno,
+            f"metric tag(s) {', '.join(repr(b) for b in bad)} take "
+            "per-entity runtime values (ids / raw addresses): every "
+            "entity mints a new time series, growing the registry and "
+            "every scrape without bound — tag with a bounded category "
+            "instead, or justify with "
+            "'# raylint: allow(metrics-cardinality) <why>'")
 
 
 # --------------------------------------------------------------------------

@@ -260,7 +260,50 @@ def _straggler_reports(collected: dict,
     return out
 
 
-def diagnose(collected: dict, straggler_factor: float = 3.0) -> dict:
+def _perf_reports(collected: dict,
+                  baseline: Optional[dict] = None) -> dict:
+    """Perf-plane section: cluster-merged latency quantiles per
+    histogram, recovered from the raw bucket counts riding the metric
+    snapshots already collected — no extra wire round trip.
+
+    ``baseline`` (the ``--perf-baseline`` JSON: ``{hist_name:
+    {"p99_ms": budget, ..., "tolerance": 1.5}}``) turns the section
+    into an SLO regression gate: any current quantile above
+    ``budget * tolerance`` is a drift finding (counted as an issue)."""
+    from ray_tpu.observability import perf as perf_mod
+    cluster = collected.get("cluster") or {}
+    snaps = (cluster.get("metrics") or {}).get("snapshots") or {}
+    agg: Dict[str, dict] = {}
+    for families in snaps.values():
+        for name, p in perf_mod.extract_perf(families or []).items():
+            a = agg.setdefault(name, {"counts": [], "sum_ms": 0.0,
+                                      "bounds": p.get("bounds")})
+            a["counts"] = perf_mod.merge_counts(
+                [a["counts"], [int(c) for c in p["counts"]]])
+            a["sum_ms"] += float(p.get("sum_ms", 0.0))
+    summaries = {name: perf_mod.summarize(a["counts"], a["sum_ms"],
+                                          a["bounds"])
+                 for name, a in sorted(agg.items())}
+    drift = []
+    for name, budgets in (baseline or {}).items():
+        current = summaries.get(name)
+        if current is None:
+            continue
+        tolerance = float(budgets.get("tolerance", 1.5))
+        for key, base in budgets.items():
+            if key == "tolerance" or key not in current:
+                continue
+            got = current[key]
+            if got > float(base) * tolerance:
+                drift.append({"hist": name, "metric": key,
+                              "got_ms": round(got, 3),
+                              "baseline_ms": float(base),
+                              "tolerance": tolerance})
+    return {"cluster": summaries, "drift": drift}
+
+
+def diagnose(collected: dict, straggler_factor: float = 3.0,
+             perf_baseline: Optional[dict] = None) -> dict:
     """Turn a :func:`collect` result into findings. Machine-readable;
     :func:`render_text` prints the same structure for humans."""
     crashes = _crash_reports(_all_bundles(collected))
@@ -306,12 +349,15 @@ def diagnose(collected: dict, straggler_factor: float = 3.0) -> dict:
                              if nid.startswith(h["node"])
                              or h["node"].startswith(nid[:8])]})
     local = collected.get("local") or {}
+    perf_section = _perf_reports(collected, baseline=perf_baseline)
     n_issues = (len(crashes) + len(hangs) + len(stragglers) +
-                len(missing) + len(dead_nodes))
+                len(missing) + len(dead_nodes) +
+                len(perf_section["drift"]))
     return {
         "ts": collected.get("ts"),
         "healthy": n_issues == 0,
         "num_issues": n_issues,
+        "perf": perf_section,
         "crashes": crashes,
         "hangs": hangs,
         "stragglers": stragglers,
@@ -420,6 +466,25 @@ def render_text(report: dict) -> str:
                 f"{s['mean_us']}us = {s['slowdown']}x the cluster "
                 f"median ({s['cluster_median_us']}us, "
                 f"{s['samples']} samples)")
+    perf_section = report.get("perf") or {}
+    quantiles = perf_section.get("cluster") or {}
+    if quantiles:
+        lines.append("")
+        lines.append(f"PERF ({len(quantiles)} histogram(s), "
+                     "cluster-merged)")
+        for name, s in quantiles.items():
+            lines.append(
+                f"  {name}: n={s['count']:.0f} p50={s['p50_ms']:.2f}ms "
+                f"p95={s['p95_ms']:.2f}ms p99={s['p99_ms']:.2f}ms")
+    drift = perf_section.get("drift") or []
+    if drift:
+        lines.append("")
+        lines.append(f"PERF DRIFT ({len(drift)}) — quantiles beyond "
+                     "recorded baseline")
+        for d in drift:
+            lines.append(
+                f"  {d['hist']}.{d['metric']}: {d['got_ms']}ms > "
+                f"{d['baseline_ms']}ms x{d['tolerance']}")
     missing = report.get("unreachable_hosts") or []
     if missing:
         lines.append("")
@@ -474,13 +539,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="flag a process whose mean task time "
                              "exceeds this multiple of the cluster "
                              "median (default 3.0)")
+    parser.add_argument("--perf-baseline", default=None,
+                        help="JSON file of per-histogram quantile "
+                             "budgets ({name: {p99_ms: X, tolerance: "
+                             "1.5}}); quantiles beyond budget*tolerance "
+                             "count as issues")
     args = parser.parse_args(argv)
+    perf_baseline = None
+    if args.perf_baseline:
+        with open(args.perf_baseline) as f:
+            perf_baseline = json.load(f)
     try:
         collected = collect(flight_dir=args.flight_dir,
                             address=args.address,
                             seal=not args.no_seal)
         report = diagnose(collected,
-                          straggler_factor=args.straggler_factor)
+                          straggler_factor=args.straggler_factor,
+                          perf_baseline=perf_baseline)
     except Exception as e:  # noqa: BLE001
         print(f"doctor: collection failed: {e!r}", file=sys.stderr)
         return 2

@@ -35,6 +35,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 from ray_tpu._private.config import _config
 from ray_tpu._private.profiling import get_profiler
+from ray_tpu.observability import sampler as _sampler
 
 # Fast-path switch: hot paths check this module bool and nothing else
 # when tracing is off (same pattern as chaos.ENABLED).
@@ -164,7 +165,8 @@ class span:
     ``__enter__``/``__exit__`` return after one bool check.
     """
 
-    __slots__ = ("name", "cat", "args", "pid", "_t0", "_ids", "_token")
+    __slots__ = ("name", "cat", "args", "pid", "_t0", "_ids", "_token",
+                 "_tagged")
 
     def __init__(self, name: str, cat: str = "obs",
                  pid: Optional[str] = None, **args: Any):
@@ -174,6 +176,7 @@ class span:
         self.pid = pid
         self._t0 = None
         self._token = None
+        self._tagged = False
 
     def __enter__(self) -> "span":
         if not ENABLED:
@@ -186,6 +189,11 @@ class span:
         span_id = mint_id()
         self._ids = (trace_id, span_id, parent_span)
         self._token = _ctx_var.set((trace_id, span_id))
+        if _sampler.TAGGING:
+            # stack-sampler attribution: samples landing on this thread
+            # while the span is open are tagged with its trace id
+            _sampler.note_span_enter(trace_id)
+            self._tagged = True
         self._t0 = time.time()
         return self
 
@@ -212,6 +220,9 @@ class span:
                                   pid=self.pid or _pid_label,
                                   start_s=self._t0, dur_s=dur, args=args)
         finally:
+            if self._tagged:
+                _sampler.note_span_exit()
+                self._tagged = False
             _ctx_var.reset(self._token)
             self._t0 = None
 

@@ -15,6 +15,7 @@ Usage:
   python -m ray_tpu.scripts.cli timeline -o /tmp/trace.json
   python -m ray_tpu.scripts.cli events
   python -m ray_tpu.scripts.cli doctor --json
+  python -m ray_tpu.scripts.cli top --address HOST:PORT [--json]
 """
 
 from __future__ import annotations
@@ -107,6 +108,8 @@ def cmd_doctor(args):
         argv += ["--no-seal"]
     if args.output:
         argv += ["--out", args.output]
+    if args.perf_baseline:
+        argv += ["--perf-baseline", args.perf_baseline]
     sys.exit(doctor_main(argv))
 
 
@@ -120,6 +123,86 @@ def cmd_drain(args):
         client.close()
     print(f"node {args.node_id[:16]} -> DRAINING "
           f"(reason={args.reason!r}, deadline_s={args.deadline_s or 'default'})")
+
+
+def _top_rows(payload, subsystems=None):
+    """Flatten an ``/api/perf`` payload into render rows:
+    ``(node, name, summary, straggler)``.  A node is flagged a straggler
+    on a histogram when its p95 is >= 3x the cluster median of the other
+    nodes' p95 for that histogram (the doctor's outlier rule), with the
+    same guards: at least 3 samples on the node and at least 2 reporting
+    nodes."""
+    import statistics
+    nodes = payload.get("nodes", {})
+    rows = []
+    for name in sorted({n for per in nodes.values() for n in per}):
+        subsystem = name.split(".", 1)[0]
+        if subsystems and subsystem not in subsystems:
+            continue
+        p95s = [per[name]["p95_ms"] for per in nodes.values()
+                if name in per]
+        median = statistics.median(p95s) if p95s else 0.0
+        for node in sorted(nodes):
+            summ = nodes[node].get(name)
+            if summ is None:
+                continue
+            straggler = (len(p95s) >= 2 and summ["count"] >= 3
+                         and median > 0
+                         and summ["p95_ms"] >= 3.0 * median)
+            rows.append((node, name, summ, straggler))
+    return rows
+
+
+def _render_top(payload, subsystems=None) -> str:
+    lines = ["%-14s %-22s %9s %9s %9s %9s %9s" % (
+        "NODE", "HISTOGRAM", "COUNT", "MEAN_MS", "P50_MS", "P95_MS",
+        "P99_MS")]
+    for node, name, s, straggler in _top_rows(payload, subsystems):
+        lines.append("%-14s %-22s %9d %9.2f %9.2f %9.2f %9.2f%s" % (
+            node, name, int(s["count"]), s["mean_ms"], s["p50_ms"],
+            s["p95_ms"], s["p99_ms"],
+            "  <-- STRAGGLER (>=3x cluster median p95)"
+            if straggler else ""))
+    missing = payload.get("missing_hosts") or []
+    if missing:
+        lines.append(f"({len(missing)} unreachable host(s) omitted)")
+    return "\n".join(lines)
+
+
+def cmd_top(args):
+    """Live per-node/per-subsystem latency table off the perf plane."""
+    import time
+    from ray_tpu._private.config import _config
+    from ray_tpu.dashboard.head import DashboardHead
+    subsystems = set(args.subsystem) if args.subsystem else None
+    head = DashboardHead(args.address)
+    try:
+        if args.json:
+            payload = head._perf()
+            payload["stragglers"] = [
+                {"node": node, "name": name}
+                for node, name, _s, flag in _top_rows(payload, subsystems)
+                if flag]
+            if subsystems:
+                for per in list(payload["nodes"].values()) + \
+                        [payload["cluster"]]:
+                    for name in [n for n in per
+                                 if n.split(".", 1)[0] not in subsystems]:
+                        del per[name]
+            print(json.dumps(payload, indent=2))
+            return
+        interval = args.interval or float(_config.get("perf_top_interval_s"))
+        while True:
+            payload = head._perf()
+            print("\x1b[2J\x1b[H", end="")
+            print(f"ray-tpu top — cluster {args.address} "
+                  f"(refresh {interval:.1f}s, Ctrl-C to quit)")
+            print(_render_top(payload, subsystems))
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        head.stop()
 
 
 def cmd_dashboard(args):
@@ -164,6 +247,8 @@ def main(argv=None):
     hp.add_argument("--json", action="store_true")
     hp.add_argument("--no-seal", action="store_true")
     hp.add_argument("-o", "--output", default=None)
+    hp.add_argument("--perf-baseline", default=None,
+                    help="JSON quantile budgets; drift counts as issues")
     hp.set_defaults(fn=cmd_doctor)
     gp = sub.add_parser("drain",
                         help="gracefully drain a node (workload migration)")
@@ -174,6 +259,18 @@ def main(argv=None):
     gp.add_argument("--deadline-s", type=float, default=0.0,
                     help="drain budget in seconds (0 = drain_deadline_s)")
     gp.set_defaults(fn=cmd_drain)
+    op = sub.add_parser(
+        "top", help="live per-node latency quantiles from the perf plane")
+    op.add_argument("--address", required=True,
+                    help="host:port of the cluster state service")
+    op.add_argument("--json", action="store_true",
+                    help="print one /api/perf snapshot as JSON and exit")
+    op.add_argument("--interval", type=float, default=0.0,
+                    help="refresh seconds (0 = perf_top_interval_s config)")
+    op.add_argument("--subsystem", action="append", default=None,
+                    help="filter to a subsystem prefix (rpc, task, fetch, "
+                         "ckpt, serve, train, ...); repeatable")
+    op.set_defaults(fn=cmd_top)
     dp = sub.add_parser("dashboard",
                         help="serve the cluster dashboard UI")
     dp.add_argument("--address", required=True,

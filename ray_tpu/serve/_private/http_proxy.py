@@ -32,10 +32,13 @@ import logging
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
 from ray_tpu import observability
+from ray_tpu._private.config import _config
+from ray_tpu.observability import perf
 from ray_tpu.serve._private.long_poll import LongPollClient
 from ray_tpu.serve.controller import ROUTE_TABLE_KEY
 from ray_tpu.serve.handle import DeploymentHandle
@@ -92,6 +95,7 @@ class HTTPProxy:
                 self.wfile.write(b"0\r\n\r\n")
 
             def _dispatch(self, body: Optional[bytes]):
+                t_arrival = time.monotonic() if perf.ENABLED else 0.0
                 path = self.path.split("?")[0].rstrip("/") or "/"
                 if path == "/-/healthz":
                     self._json(503 if proxy._draining else 200,
@@ -129,14 +133,34 @@ class HTTPProxy:
                                 arg = json.loads(body)
                             except json.JSONDecodeError:
                                 arg = body
+                        if isinstance(arg, (bytes, bytearray)):
+                            arg = proxy._maybe_put_ingress(arg)
                         handle = proxy._get_handle(name)
+                        # Perf breakdown: queue_wait (semaphore + routing
+                        # + body handling, the pre-dispatch share) vs
+                        # execute (replica round-trip) vs serialize
+                        # (response encode + write).
+                        t_exec = time.monotonic() if t_arrival else 0.0
+                        if t_arrival:
+                            perf.observe("serve.queue_wait",
+                                         (t_exec - t_arrival) * 1e3)
                         result = handle.remote(arg).result(
                             timeout=proxy._timeout_s)
-                        if (isinstance(result, (list, tuple))
-                                and self.headers.get("X-Serve-Stream")):
-                            self._stream(result)
-                            return
-                        self._send_value(result)
+                        t_ser = time.monotonic() if t_arrival else 0.0
+                        if t_arrival:
+                            perf.observe("serve.execute",
+                                         (t_ser - t_exec) * 1e3)
+                        try:
+                            if (isinstance(result, (list, tuple))
+                                    and self.headers.get("X-Serve-Stream")):
+                                self._stream(result)
+                                return
+                            self._send_value(result)
+                        finally:
+                            if t_arrival:
+                                now = time.monotonic()
+                                perf.observe("serve.serialize",
+                                             (now - t_ser) * 1e3)
                 except Exception as e:  # noqa: BLE001 - surface to caller
                     if getattr(self, "_headers_sent", False):
                         # Mid-stream failure: a second status line would
@@ -151,6 +175,9 @@ class HTTPProxy:
                         self._json(500, {"error": str(e)})
                 finally:
                     proxy._inflight.release()
+                    if t_arrival:
+                        perf.observe("serve.request",
+                                     (time.monotonic() - t_arrival) * 1e3)
 
             def _send_value(self, result):
                 body = json.dumps(result).encode()
@@ -191,6 +218,29 @@ class HTTPProxy:
                 handle.shutdown()
             except Exception as e:
                 logger.debug("handle shutdown failed: %s", e)
+
+    def _maybe_put_ingress(self, body):
+        """Large raw (non-JSON) request bodies go into the object plane
+        and ride to the replica as a ref: the bulk bytes then move over
+        the shared striped transport pool (proactive push / striped
+        fetch) instead of being pickled into the task args — the serve
+        half of ROADMAP item 5's TCP-throughput chase.  The replica sees
+        the original bytes (task args auto-resolve refs)."""
+        threshold = int(_config.get("serve_ingress_put_threshold_bytes"))
+        if threshold <= 0 or len(body) < threshold:
+            return body
+        import ray_tpu
+        t0 = time.monotonic() if perf.ENABLED else 0.0
+        try:
+            ref = ray_tpu.put(bytes(body))
+        except Exception as e:  # noqa: BLE001 — inline args still correct
+            logger.debug("serve ingress put failed (%s); "
+                         "falling back to inline body", e)
+            return body
+        if t0:
+            perf.observe("serve.ingress_put",
+                         (time.monotonic() - t0) * 1e3)
+        return ref
 
     def _match(self, path: str) -> Optional[str]:
         with self._lock:

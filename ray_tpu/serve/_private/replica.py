@@ -19,6 +19,8 @@ import threading
 import time
 from typing import Any, Optional
 
+from ray_tpu.observability import perf
+
 
 def _load_checkpoint(checkpoint: Any) -> Any:
     """Resolve a deployment checkpoint to the restored pytree. Accepts a
@@ -30,6 +32,21 @@ def _load_checkpoint(checkpoint: Any) -> Any:
     if isinstance(checkpoint, CheckpointRef):
         return checkpoint.load()
     return checkpoint
+
+
+def _resolve_arg_refs(args):
+    """Resolve ObjectRef request arguments to their values.  The proxy
+    puts large raw ingress bodies into the object plane and ships a ref
+    (the bytes ride the striped transport pool); ``handle_request``'s
+    own args tuple is nested inside the actor-call args, so the
+    runtime's top-level ref resolution does not reach it — resolve here,
+    on the replica's host, where the fetch is local-or-striped."""
+    from ray_tpu.object_ref import ObjectRef
+    if not any(isinstance(a, ObjectRef) for a in args):
+        return args
+    import ray_tpu
+    return tuple(ray_tpu.get(a) if isinstance(a, ObjectRef) else a
+                 for a in args)
 
 
 class Replica:
@@ -79,13 +96,18 @@ class Replica:
                     f"Replica {self.replica_tag} is draining")
             self._ongoing += 1
             self._total += 1
+        t0 = time.monotonic() if perf.ENABLED else 0.0
         try:
+            args = _resolve_arg_refs(args)
             if self._is_function:
                 return self._callable(*args, **kwargs)
             if method_name == "__call__":
                 return self._callable(*args, **kwargs)
             return getattr(self._callable, method_name)(*args, **kwargs)
         finally:
+            if t0:
+                perf.observe("serve.replica_exec",
+                             (time.monotonic() - t0) * 1e3)
             with self._lock:
                 self._ongoing -= 1
 

@@ -13,7 +13,10 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from typing import Any, Dict, Optional
+
+from ray_tpu.observability import perf
 
 logger = logging.getLogger("ray_tpu")
 
@@ -48,6 +51,10 @@ class _TrainSession:
         # that sort BELOW the stale pre-crash ones, and retention would
         # reap the fresh commits instead of the stale ones.
         self._ckpt_seq = int((checkpoint_spec or {}).get("base_step") or 0)
+        # Perf plane: monotonic stamp of the previous report(), so the
+        # inter-report interval — the user's step wall time — lands in
+        # the train.step histogram.
+        self._last_report_s = 0.0
 
     def _engine(self):
         if self.checkpoint_engine is None and self.checkpoint_spec:
@@ -105,16 +112,32 @@ def _shutdown_session():
 
 
 def report(metrics: Dict[str, Any], checkpoint=None) -> None:
-    """Stream a result row (and optionally a checkpoint) to the driver."""
+    """Stream a result row (and optionally a checkpoint) to the driver.
+
+    Perf-plane breakdown per report: ``train.step`` (wall time since the
+    previous report — the user's step loop), ``train.ckpt_enqueue`` (the
+    synchronous share of the engine save: device->host copy + queueing;
+    hash/write/commit stay on the writer thread), and ``train.report``
+    (this call's own cost)."""
     s = _get_session()
     if s is None:
         raise RuntimeError("session.report() called outside a train worker")
+    t0 = time.monotonic() if perf.ENABLED else 0.0
+    if t0 and s._last_report_s:
+        perf.observe("train.step", (t0 - s._last_report_s) * 1e3)
     if checkpoint is not None:
         s.latest_checkpoint = checkpoint
         if s.checkpoint_spec:
             s._engine_save(checkpoint)
+            if t0:
+                perf.observe("train.ckpt_enqueue",
+                             (time.monotonic() - t0) * 1e3)
     s.results.put({"metrics": dict(metrics), "checkpoint": checkpoint,
                    "rank": s.world_rank})
+    if t0:
+        now = time.monotonic()
+        s._last_report_s = now
+        perf.observe("train.report", (now - t0) * 1e3)
 
 
 def get_checkpoint():

@@ -42,6 +42,30 @@ class _Registry:
 
 _registry = _Registry()
 
+# Extra sample sources: callables returning snapshot()-shaped family
+# dicts, merged into every snapshot/exposition.  The perf plane
+# (observability/perf.py) registers here so its lock-free histograms
+# export without living inside the registry's Metric class hierarchy.
+_extra_sources: List = []
+
+
+def register_sample_source(fn) -> None:
+    """Register a zero-arg callable returning a list of family dicts
+    (``{"name","type","help","samples",...}``) to include in
+    :func:`snapshot` and the Prometheus expositions."""
+    if fn not in _extra_sources:
+        _extra_sources.append(fn)
+
+
+def _extra_families() -> List[dict]:
+    out: List[dict] = []
+    for fn in _extra_sources:
+        try:
+            out.extend(fn())
+        except Exception:  # raylint: allow(swallow) one bad source must not kill the scrape
+            pass
+    return out
+
 
 def _escape_label(value: str) -> str:
     """Prometheus label escaping: backslash, quote, newline — one bad
@@ -175,6 +199,12 @@ def generate_prometheus_text() -> str:
         lines.append(f"# TYPE {m.name} {m.TYPE}")
         for name, tags, value in m.samples():
             lines.append(f"{name}{_fmt_tags(tags)} {value}")
+    for fam in _extra_families():
+        if fam.get("help"):
+            lines.append(f"# HELP {fam['name']} {fam['help']}")
+        lines.append(f"# TYPE {fam['name']} {fam['type']}")
+        for name, tags, value in fam["samples"]:
+            lines.append(f"{name}{_fmt_tags(tuple(map(tuple, tags)))} {value}")
     return "\n".join(lines) + "\n"
 
 
@@ -191,6 +221,7 @@ def snapshot() -> List[dict]:
             "samples": [[name, list(map(list, tags)), value]
                         for name, tags, value in m.samples()],
         })
+    out.extend(_extra_families())
     return out
 
 

@@ -59,6 +59,16 @@ JAX_PLATFORMS=cpu \
 python -m pytest tests/test_core.py tests/test_actors.py tests/test_data_plane.py \
     tests/test_checkpoint.py tests/test_tracing.py tests/test_transport.py -q
 
+echo "== perf gate (histograms + sampler under delay-only chaos) =="
+# The perf plane must stay correct when latency actually moves: a fixed
+# delay-only schedule on the instrumented paths (task execute, RPC send)
+# shifts the distributions the histograms record, and every test_perf
+# assertion — bucket math, shard merge, federation, sampler folding,
+# drift detection — must hold under the perturbed timings.
+RAY_TPU_CHAOS="20260805:task.execute@2%5=delay(0.01);rpc.client.send@3%7=delay(0.005)" \
+JAX_PLATFORMS=cpu \
+python -m pytest tests/test_perf.py -q
+
 echo "== forensics gate (crash bundles sealed + doctor reads them back) =="
 # Hard-death drill: the forensics suite kills processes mid-task — via a
 # deterministic chaos exit schedule (hooks run) and via raw SIGKILL (no

@@ -6,7 +6,7 @@
 #      shipped fixture corpus round-trips expected.json exactly, and the
 #      machine-readable `--rules` listing is cross-checked against this
 #      header and the ARCHITECTURE.md rule table so neither can drift.
-#   1. raylint — the framework-aware AST linter (R1..R14, including the
+#   1. raylint — the framework-aware AST linter (R1..R15, including the
 #      whole-program call-graph rules) over ray_tpu/, bench.py,
 #      bench_micro.py, and tests/; any non-allowlisted finding fails the
 #      gate. tests/ runs under a scoped allow profile (see below).
