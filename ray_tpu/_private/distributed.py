@@ -949,9 +949,14 @@ class DistributedRuntime(Runtime):
                 root = os.path.join(_config.get("drain_checkpoint_root"),
                                     state.actor_id.hex())
                 eng = CheckpointEngine(root)
-                manifest = eng.save(
+                handle = eng.save(
                     {"actor_pickle": np.frombuffer(blob, dtype=np.uint8)},
-                    step=int(state.restart_count), wait=True).result()
+                    step=int(state.restart_count))
+                # the commit gets exactly the budget the drain has left;
+                # a blown deadline restarts this actor from __init__
+                # rather than stalling every actor behind it
+                manifest = handle.result(
+                    timeout=max(0.0, deadline - time.monotonic()))
                 rec = json.dumps({
                     "root": root, "manifest": manifest,
                     "cls": state.cls.__name__, "reason": reason,
@@ -1363,7 +1368,26 @@ class DistributedRuntime(Runtime):
         if owner is not None and owner != getattr(self, "address", None):
             # We were a borrower: tell the owner, drop local cache.
             self._borrow_enqueue("remove", oid, owner)
+        remote_copy = (self._location_hints.get(oid)
+                       if hasattr(self, "_location_hints") else None)
         super()._on_ref_zero(oid)
+        if (owner is None or owner == getattr(self, "address", None)) and \
+                remote_copy and remote_copy != getattr(self, "address", None):
+            # Sender half of the FREE_OBJECT arm: the primary copy of a
+            # non-inline result lives on the executing daemon; the owner
+            # dropping its last ref must reclaim that memory too, or the
+            # executor leaks it for the life of the process.
+            try:
+                self.pool.get(
+                    remote_copy, on_close=self._on_peer_conn_close,
+                ).call_async(
+                    pb.FREE_OBJECT,
+                    pb.FreeObjectRequest(
+                        object_id=oid.binary()).SerializeToString(),
+                    lambda _env, _err: None)
+            except Exception:
+                logger.debug("free propagation to %s failed",
+                             remote_copy, exc_info=True)
         if hasattr(self, "_location_hints"):
             self._location_hints.pop(oid, None)
             self._completed_returns.discard(oid)
@@ -2785,6 +2809,29 @@ class DistributedRuntime(Runtime):
         if state is not None:
             self._sync_actor_info(state)
 
+    def cancel_task(self, task_id: TaskID, force: bool = False):
+        super().cancel_task(task_id, force=force)
+        # Sender half of the CANCEL_TASK arm: the local flag only stops
+        # work this daemon still holds — a spec already pushed to a peer
+        # must be cancelled where it runs, or it executes to completion.
+        targets = set()
+        with self._inflight_lock:
+            for (tid, _attempt), info in self._inflight_remote.items():
+                if tid == task_id:
+                    targets.add(info["addr"])
+        if not targets:
+            return
+        body = pb.CancelTaskRequest(task_id=task_id.binary(),
+                                    force=force).SerializeToString()
+        for addr in targets:
+            try:
+                self.pool.get(
+                    addr, on_close=self._on_peer_conn_close,
+                ).call_async(pb.CANCEL_TASK, body, lambda _env, _err: None)
+            except Exception:
+                logger.debug("cancel propagation to %s failed",
+                             addr, exc_info=True)
+
     def get_named_actor(self, name: str, namespace: str = "default"):
         with self.lock:
             actor_id = self.named_actors.get((namespace, name))
@@ -3048,6 +3095,10 @@ class DistributedRuntime(Runtime):
                         max(0.0, deadline - time.monotonic())))
                 attempt += 1
             ctx.reply(pb.WaitObjectReply(ready=ready).SerializeToString())
+        # DRAIN is kept as an external compat surface: out-of-tree tooling
+        # and older CLIs drain a daemon directly; in-tree drains ride
+        # DRAIN_NODE via the state service.
+        # raylint: allow(protocol) external/legacy direct-drain senders
         elif method == pb.DRAIN:
             # Graceful drain request straight to this daemon. An empty
             # body parses as the default DrainNodeRequest — the legacy

@@ -178,43 +178,49 @@ class RpcClient:
         except OSError as e:
             raise RpcConnectionError(
                 f"connect to {address} failed: {e}") from e
-        if _t0:
-            perf.observe("rpc.connect", (time.monotonic() - _t0) * 1e3)
-        self._sock.settimeout(None)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        if sock_buf_bytes > 0:
-            # Data-plane connections size their kernel buffers to the
-            # transfer chunk so one chunk stays in flight per stream
-            # (defaults keep the first RTTs window-limited). Linux
-            # auto-tunes past the initial SO_RCVBUF only when it is NOT
-            # set explicitly, so this is opt-in per connection.
-            _set_sock_bufs(self._sock, sock_buf_bytes)
-        token = auth_token if auth_token is not None else default_auth_token()
-        if token:
-            # First frame of every connection: prove membership. The server
-            # closes the socket on mismatch; the caller surfaces that as a
-            # connection error on its first real call.
-            try:
-                self._sock.sendall(frame_bytes(pb.Envelope(
-                    seq=0, method=pb.AUTH, body=token)))
-            except OSError as e:
+        try:
+            if _t0:
+                perf.observe("rpc.connect", (time.monotonic() - _t0) * 1e3)
+            self._sock.settimeout(None)
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if sock_buf_bytes > 0:
+                # Data-plane connections size their kernel buffers to the
+                # transfer chunk so one chunk stays in flight per stream
+                # (defaults keep the first RTTs window-limited). Linux
+                # auto-tunes past the initial SO_RCVBUF only when it is NOT
+                # set explicitly, so this is opt-in per connection.
+                _set_sock_bufs(self._sock, sock_buf_bytes)
+            token = (auth_token if auth_token is not None
+                     else default_auth_token())
+            if token:
+                # First frame of every connection: prove membership. The
+                # server closes the socket on mismatch; the caller surfaces
+                # that as a connection error on its first real call.
                 try:
-                    self._sock.close()
-                except OSError:
-                    pass
-                raise RpcConnectionError(
-                    f"auth handshake to {address} failed: {e}") from e
-        self._wlock = threading.Lock()
-        self._plock = threading.Lock()
-        self._pending: Dict[int, _Pending] = {}
-        self._seq = 0
-        self._on_push = on_push
-        self._on_close = on_close
-        self._closed = False
-        self._close_exc: Optional[Exception] = None
-        self._reader = threading.Thread(target=self._read_loop, daemon=True,
-                                        name=f"rpc-client-{address}")
-        self._reader.start()
+                    self._sock.sendall(frame_bytes(pb.Envelope(
+                        seq=0, method=pb.AUTH, body=token)))
+                except OSError as e:
+                    raise RpcConnectionError(
+                        f"auth handshake to {address} failed: {e}") from e
+            self._wlock = threading.Lock()
+            self._plock = threading.Lock()
+            self._pending: Dict[int, _Pending] = {}
+            self._seq = 0
+            self._on_push = on_push
+            self._on_close = on_close
+            self._closed = False
+            self._close_exc: Optional[Exception] = None
+            self._reader = threading.Thread(
+                target=self._read_loop, daemon=True,
+                name=f"rpc-client-{address}")
+            self._reader.start()
+        except Exception:
+            # Constructor aborts after the connect must not strand the fd.
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            raise
 
     # -- public ---------------------------------------------------------------
 
@@ -623,22 +629,34 @@ class RpcServer:
         # worker pool.
         self._inline = inline_methods or set()
         self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._lsock.bind((host, port))
-        self._lsock.listen(128)
-        self.host, self.port = self._lsock.getsockname()
-        self.address = f"{self.host}:{self.port}"
-        self._pool = ThreadPoolExecutor(max_workers=max_workers,
-                                        thread_name_prefix="rpc-srv")
-        self._conns: Dict[int, Tuple[socket.socket, threading.Lock]] = {}
-        self._conn_lock = threading.Lock()
-        self._closed = False
-        self._quiesced = False
-        self._on_disconnect: Optional[Callable[[int], None]] = None
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, daemon=True,
-            name=f"rpc-accept-{self.port}")
-        self._accept_thread.start()
+        self._pool = None
+        try:
+            self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._lsock.bind((host, port))
+            self._lsock.listen(128)
+            self.host, self.port = self._lsock.getsockname()
+            self.address = f"{self.host}:{self.port}"
+            self._pool = ThreadPoolExecutor(max_workers=max_workers,
+                                            thread_name_prefix="rpc-srv")
+            self._conns: Dict[int, Tuple[socket.socket, threading.Lock]] = {}
+            self._conn_lock = threading.Lock()
+            self._closed = False
+            self._quiesced = False
+            self._on_disconnect: Optional[Callable[[int], None]] = None
+            self._accept_thread = threading.Thread(
+                target=self._accept_loop, daemon=True,
+                name=f"rpc-accept-{self.port}")
+            self._accept_thread.start()
+        except Exception:
+            # bind() on a taken port (EADDRINUSE) is the common abort here;
+            # without this the listener fd leaks on every retry.
+            try:
+                self._lsock.close()
+            except OSError:
+                pass
+            if self._pool is not None:
+                self._pool.shutdown(wait=False)
+            raise
 
     def set_on_disconnect(self, cb: Callable[[int], None]):
         self._on_disconnect = cb

@@ -283,15 +283,21 @@ class Runtime:
         # asynchronously and may trail reality by a refresh interval.
         self._infeasible_grace_s = 0.0
         self.autoscaling_enabled = False  # set by StandardAutoscaler
-        self._util_pool = ThreadPoolExecutor(max_workers=32,
-                                             thread_name_prefix="rt-util")
-        self._shutdown = False
-        self._dispatcher = threading.Thread(target=self._dispatch_loop,
-                                            name="rt-dispatcher", daemon=True)
-        self._dispatcher.start()
         self._events: List[dict] = []  # structured event log
         self._event_file = None
         self._event_file_lock = threading.Lock()
+        self._shutdown = False
+        self._util_pool = ThreadPoolExecutor(max_workers=32,
+                                             thread_name_prefix="rt-util")
+        try:
+            self._dispatcher = threading.Thread(target=self._dispatch_loop,
+                                                name="rt-dispatcher",
+                                                daemon=True)
+            self._dispatcher.start()
+        except Exception:
+            # thread-limit failures must not strand the utility pool
+            self._util_pool.shutdown(wait=False)
+            raise
 
     # ------------------------------------------------------------------ nodes
 
@@ -457,6 +463,10 @@ class Runtime:
                             for o in slow]
                     for o, f in futs:
                         try:
+                            # each worker runs get_object(_remaining()):
+                            # the shared deadline is enforced inside the
+                            # call, so this result() is bounded by it
+                            # raylint: allow(deadline-drop) bounded in callee
                             values[o] = f.result()
                         except BaseException as e:  # noqa: BLE001 — replayed
                             errors[o] = e           # in input order below

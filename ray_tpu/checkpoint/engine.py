@@ -349,9 +349,11 @@ class CheckpointEngine:
              mesh: Optional[Dict[str, Any]] = None,
              meta: Optional[Dict[str, Any]] = None,
              save_key: Optional[str] = None,
-             wait: bool = False) -> SaveHandle:
+             wait: bool = False,
+             timeout_s: Optional[float] = None) -> SaveHandle:
         """Snapshot ``tree`` (this rank's shard of it). Returns once the
-        device->host copy is enqueued; ``wait=True`` blocks through commit.
+        device->host copy is enqueued; ``wait=True`` blocks through commit,
+        raising ``TimeoutError`` if the commit outlives ``timeout_s``.
 
         ``shard_paths`` is required with ``shard_axis``: an iterable of
         fnmatch patterns over "/"-joined leaf paths naming exactly which
@@ -390,7 +392,7 @@ class CheckpointEngine:
             self._inflight.append(handle)
         self._queue.put(job)
         if wait:
-            handle.result()
+            handle.result(timeout_s)
         return handle
 
     def _make_leaf(self, path: str, value: Any) -> _LeafTask:

@@ -20,6 +20,6 @@ def hold_b_then_a():
 def spawn_ok():
     # negative: locks are not held across a spawn edge — the new thread
     # starts with an empty hold set, so this creates no A->B edge
-    t = threading.Thread(target=r11_a.hold_a)
+    t = threading.Thread(target=r11_a.hold_a, daemon=True)
     with PEER_LOCK:
         t.start()

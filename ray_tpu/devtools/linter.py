@@ -84,26 +84,48 @@ R15  metrics-cardinality
                     data (object/task/trace ids, raw peer addresses):
                     every entity mints a new time series, growing the
                     registry and every scrape without bound
+R16  resource-leak  an OS-backed resource (socket, file, mmap,
+                    non-daemon thread, executor) acquired on some path
+                    but neither released nor ownership-transferred
+                    before the function exits on that path (incl.
+                    ``__init__`` aborts); dynamic handoffs are asserted
+                    with ``# raylint: transfer(<kind>) <why>``
+R17  deadline-drop  a blocking primitive with no bound (bare
+                    ``.wait()``/``.join()``/``.acquire()``/``.get()``,
+                    ``.result()`` without timeout) reachable over call
+                    edges from a deadline-scoped entry point — the
+                    budget the caller was promised is silently dropped
+R18  protocol       RPC vocabulary + lifecycle conformance: every sent
+                    ``pb.<METHOD>`` has a dispatch arm and vice versa,
+                    handlers reply exactly once per completed path, and
+                    every static ``.state = "<STATE>"`` write is a
+                    transition ``dataflow.NODE_LIFECYCLE`` declares
 ==== ============== ====================================================
 
 R10-R12 run on the whole-program call graph built by
 :mod:`ray_tpu.devtools.callgraph`; unresolvable dynamic calls degrade to
 "unknown" (no edges), so the interprocedural rules can under-report but
-never invent a path.
+never invent a path.  R16-R18 add the path-sensitive layer in
+:mod:`ray_tpu.devtools.dataflow` on top of that graph — same
+under-approximation stance, with witness paths kept for messages.
 """
 
 from __future__ import annotations
 
 import ast
+import hashlib
 import io
 import json
 import os
 import re
+import sys
+import tempfile
 import tokenize
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterable, Iterator, List, Optional, Set, Tuple
 
 from ray_tpu.devtools import callgraph as _cg
+from ray_tpu.devtools import dataflow as _df
 
 __all__ = ["Finding", "LintEngine", "rule", "project_rule", "RULES",
            "PROJECT_RULES", "rule_listing"]
@@ -1536,6 +1558,235 @@ def check_metrics_cardinality(ctx: FileContext) -> Iterator[Finding]:
 
 
 # --------------------------------------------------------------------------
+# R16: resource lifecycle — acquire/release on every path (dataflow layer)
+
+@project_rule("R16", "resource-leak")
+def check_resource_leak(ctxs: List[FileContext],
+                        engine) -> Iterator[Finding]:
+    """An OS-backed resource (socket, file handle, mmap, non-daemon
+    thread, executor pool) acquired on some path but neither released
+    nor ownership-transferred before the function exits on that path.
+    The path-sensitive walk in :mod:`ray_tpu.devtools.dataflow` models
+    explicit control flow — ``return``/``raise``, ``try``/``except``/
+    ``finally`` exception edges, and constructor aborts inside
+    ``__init__`` — and treats anything it cannot prove it understands
+    (stores, container adds, resolved callees that keep their argument,
+    captures) as a transfer, so it under-reports rather than guesses.
+    Dynamic handoffs the walker cannot see are asserted in place with
+    ``# raylint: transfer(<kind>) <why>`` on the acquire line; wrong-rule
+    findings use ``# raylint: allow(resource-leak) <why>``."""
+    idx = engine.index(ctxs)
+    for q in sorted(idx.functions):
+        fn = idx.functions[q]
+        for fact, exit_state in _df.resource_leaks(fn, idx):
+            if fn.ctx.allowed(fact.line, "R16", "resource-leak"):
+                continue
+            where = {"return": "the return at line %d" % exit_state.line,
+                     "fall": "the fall-through exit at line %d"
+                             % exit_state.line,
+                     "raise": "the raise at line %d" % exit_state.line,
+                     "ctor-raise": "__init__ aborting if line %d raises"
+                                   % exit_state.line}[exit_state.kind]
+            steps = " -> ".join(
+                f"{note}@{ln}" for ln, note in exit_state.trail[-6:])
+            yield Finding(
+                "R16", "resource-leak", fn.ctx.relpath, fact.line,
+                f"{fact.kind} '{fact.var or '<anon>'}' acquired here in "
+                f"'{fn.name}' is still open at {where}"
+                + (f" (path: {steps})" if steps else "")
+                + " — release it on every path, hand it to an owner, or "
+                  "mark the handoff with '# raylint: transfer("
+                + fact.kind + ") <why>'")
+
+
+# --------------------------------------------------------------------------
+# R17: deadline propagation — no naked blocking under a time budget
+
+@project_rule("R17", "deadline-drop")
+def check_deadline_drop(ctxs: List[FileContext],
+                        engine) -> Iterator[Finding]:
+    """A blocking primitive with no timeout (``.wait()`` / zero-arg
+    ``.join()`` / ``.result()`` / lock ``.acquire()`` / queue ``.get()``
+    / ``concurrent.futures.wait``) reachable over ``call`` edges from a
+    deadline-scoped entry point — a function that takes a ``deadline``/
+    ``timeout``/``budget`` parameter or arms a ``BackoffPolicy``
+    deadline.  Such a call silently drops the budget the caller was
+    promised: the drain orchestrator, checkpoint engine and RPC layer
+    all size their budgets assuming callees stay bounded.  Pass the
+    remaining budget down (``timeout=deadline - time.monotonic()``), or
+    justify with ``# raylint: allow(deadline-drop) <why>``."""
+    idx = engine.index(ctxs)
+    direct: Dict[str, List[Tuple[int, Tuple[str, int, str]]]] = {}
+    for q, fn in idx.functions.items():
+        if fn.is_async:
+            continue              # event-loop blocking is R1/R10's domain
+        for line, desc in _df.naked_blocking(fn.node, fn.ctx):
+            direct.setdefault(q, []).append((line, (q, line, desc)))
+    closure = idx.transitive_paths(direct, kinds=("call",))
+    seen: Set[Tuple[str, int]] = set()
+    for q in sorted(idx.functions):
+        root = idx.functions[q]
+        if root.is_async:
+            continue
+        params = _df.deadline_params(root.node)
+        scope = (f"'{root.name}({', '.join(params)})'" if params else None)
+        if scope is None:
+            line = _df.arms_backoff_budget(root.node)
+            if line is None:
+                continue
+            scope = f"'{root.name}' (BackoffPolicy deadline at line {line})"
+        for key, path in sorted(closure.get(q, {}).items()):
+            site_q, site_line, desc = key
+            site_fn = idx.functions[site_q]
+            if site_fn.is_async or (site_q, site_line) in seen:
+                continue
+            seen.add((site_q, site_line))
+            if site_fn.ctx.allowed(site_line, "R17", "deadline-drop"):
+                continue
+            chain = " -> ".join(
+                f"{idx.functions[s].name}@{ln}" for s, ln in path)
+            yield Finding(
+                "R17", "deadline-drop", site_fn.ctx.relpath, site_line,
+                f"{desc} blocks with no bound under the deadline scope "
+                f"{scope} (witness: {chain}) — pass the remaining budget "
+                "down, or justify with "
+                "'# raylint: allow(deadline-drop) <why>'")
+
+
+# --------------------------------------------------------------------------
+# R18: protocol conformance — senders, handlers, replies, lifecycle
+
+@project_rule("R18", "protocol")
+def check_protocol_conformance(ctxs: List[FileContext],
+                               engine) -> Iterator[Finding]:
+    """Cross-checks the RPC message vocabulary and the PR 8 node
+    lifecycle, in four parts: (a) every ``pb.<METHOD>`` handed to a send
+    primitive must have a dispatch arm somewhere (python ``.method ==``
+    comparisons or a native ``case raytpu::M:``); (b) every
+    python-side dispatch arm must have a sender somewhere (python or a
+    native ``set_method``); (c) a handler that replies through its
+    ``RpcContext`` must reply exactly once on every non-raising path it
+    completes (the conn loop error-replies for raising paths); (d) every
+    static ``<node>.state = "<STATE>"`` write must be a transition the
+    declared ``dataflow.NODE_LIFECYCLE`` table admits.  Unknowns (a
+    context that escapes, an unguarded write to a reachable state)
+    degrade to silence, never to a guessed finding."""
+    idx = engine.index(ctxs)
+    base = ""
+    for ctx in ctxs:
+        rel = ctx.relpath.replace("\\", "/")
+        if rel.startswith("ray_tpu/") or "/ray_tpu/" in rel:
+            base = ctx.path[:-len(ctx.relpath)] if \
+                ctx.path.endswith(ctx.relpath) else \
+                ctx.path[:ctx.path.rfind(rel.split("/", 1)[0])]
+            break
+    native_handled, native_sent = _df.native_protocol_facts(
+        os.path.join(base, "ray_tpu", "_native")) if base else (set(), set())
+    proto_names = _df.proto_method_names(
+        os.path.join(base, "ray_tpu", "protocol", "raytpu.proto")) \
+        if base else set()
+
+    sends = _df.protocol_sends(ctxs)
+    handlers = _df.protocol_handlers(ctxs)
+    sent_names = {m for m, _c, _l in sends} | native_sent
+    handled_names = {m for m, _c, _l in handlers} | native_handled
+    skip = {"METHOD_UNSPECIFIED"}
+    if proto_names:
+        # names outside the Method enum (other pb constants riding the
+        # same attribute shape) are not protocol methods at all
+        universe = proto_names - skip
+    else:
+        universe = (sent_names | handled_names) - skip
+
+    reported: Set[Tuple[str, str, int]] = set()
+    for m, ctx, line in sorted(sends, key=lambda s: (s[1].relpath, s[2])):
+        if m not in universe or m in handled_names:
+            continue
+        if (m, ctx.relpath, line) in reported:
+            continue
+        reported.add((m, ctx.relpath, line))
+        if ctx.allowed(line, "R18", "protocol"):
+            continue
+        yield Finding(
+            "R18", "protocol", ctx.relpath, line,
+            f"message kind {m} is sent here but no dispatcher handles it "
+            "(checked python '.method ==' arms and the native "
+            "'case raytpu::' switch) — the peer will error-reply every "
+            "call; add the handler or retire the sender")
+    seen_handler: Set[str] = set()
+    for m, ctx, line in sorted(handlers,
+                               key=lambda s: (s[1].relpath, s[2])):
+        if m not in universe or m in sent_names or m in seen_handler:
+            continue
+        seen_handler.add(m)
+        if ctx.allowed(line, "R18", "protocol"):
+            continue
+        yield Finding(
+            "R18", "protocol", ctx.relpath, line,
+            f"dispatch arm for {m} has no sender anywhere (python send "
+            "primitives and native set_method checked) — dead protocol "
+            "surface; retire the arm or wire up the caller")
+
+    for q in sorted(idx.functions):
+        fn = idx.functions[q]
+        if fn.is_async:
+            continue
+        recv = _df.reply_candidates(fn)
+        if recv is None:
+            continue
+        flow = _df.FunctionDataflow(fn.node, fn.ctx, reply_recv=recv)
+        if flow.is_generator:
+            continue
+        exits = flow.run()
+        if flow.reply_recv_escaped:
+            continue              # a helper we can't see may reply
+        for ex in exits:
+            if ex.kind in ("raise", "ctor-raise"):
+                if ex.replies <= 1:
+                    continue      # conn loop error-replies raising paths
+            if ex.replies == 1:
+                continue
+            line = ex.line if ex.replies else fn.node.lineno
+            if fn.ctx.allowed(line, "R18", "protocol"):
+                continue
+            steps = " -> ".join(f"{note}@{ln}" for ln, note in ex.trail[-6:])
+            what = ("never replies" if ex.replies == 0
+                    else f"replies {ex.replies} times")
+            yield Finding(
+                "R18", "protocol", fn.ctx.relpath, line,
+                f"handler '{fn.name}' {what} on the path exiting at line "
+                f"{ex.line}" + (f" (path: {steps})" if steps else "")
+                + f" — every completed path must call {recv}.reply/"
+                  f"{recv}.reply_error exactly once")
+            break                 # one witness path per handler
+
+    legal_targets = {t for _f, t in _df.NODE_LIFECYCLE["transitions"]}
+    for ctx, line, recv, froms, to, guard_line in \
+            _df.lifecycle_writes(ctxs):
+        if ctx.allowed(line, "R18", "protocol"):
+            continue
+        if froms == {"*"}:
+            if to in legal_targets:
+                continue
+            yield Finding(
+                "R18", "protocol", ctx.relpath, line,
+                f"node-lifecycle write '{recv}.state = \"{to}\"' targets "
+                "a state no declared transition reaches "
+                "(dataflow.NODE_LIFECYCLE) — fix the write or extend the "
+                "declared machine")
+            continue
+        bad = sorted(f for f in froms
+                     if (f, to) not in _df.NODE_LIFECYCLE["transitions"])
+        if bad:
+            yield Finding(
+                "R18", "protocol", ctx.relpath, line,
+                f"undeclared node-lifecycle transition "
+                f"{' / '.join(repr(b) for b in bad)} -> {to!r} (guard at "
+                f"line {guard_line}) — dataflow.NODE_LIFECYCLE is the "
+                "declared machine; fix the transition or extend the table")
+
+
+# --------------------------------------------------------------------------
 # engine
 
 class LintEngine:
@@ -1543,7 +1794,8 @@ class LintEngine:
                  only_rules: Optional[Set[str]] = None,
                  proto_pairs: Optional[List[Tuple[str, str, str]]] = None,
                  allow_in: Optional[List[Tuple[str, Set[str]]]] = None,
-                 changed_only: Optional[Set[str]] = None):
+                 changed_only: Optional[Set[str]] = None,
+                 cache: bool = False):
         self.roots = [os.path.abspath(r) for r in roots]
         self.baseline = self._load_baseline(baseline_path)
         self.only_rules = only_rules
@@ -1558,6 +1810,11 @@ class LintEngine:
         # need global context) but only findings in these repo-relative
         # paths are reported
         self.changed_only = changed_only
+        # incremental analysis cache: valid only for full-rule runs (a
+        # partial --rules run would poison the stored finding sets)
+        self.cache_enabled = cache and only_rules is None
+        # (file hits, files total, project-level hit) after run()
+        self.cache_stats: Optional[Tuple[int, int, bool]] = None
         self.errors: List[str] = []
         self._index: Optional[_cg.ProjectIndex] = None
 
@@ -1605,22 +1862,74 @@ class LintEngine:
                         full = os.path.join(dirpath, fname)
                         yield full, os.path.relpath(full, base)
 
+    # -- incremental analysis cache ----------------------------------------
+    #
+    # Derived artifacts (per-file file-rule findings; whole-tree project
+    # findings) are keyed on content hashes, never on mtimes.  Re-parsing
+    # is CHEAPER than unpickling trees on this corpus (measured: ast.parse
+    # 0.66s vs pickle.load 1.0s for 181 files), so the cache deliberately
+    # stores findings, not parse trees: a warm run is hash + emit.
+
+    _salt: Optional[str] = None
+
+    @classmethod
+    def _engine_salt(cls) -> str:
+        """Content hash of the analysis code itself: any edit to the
+        linter, call-graph, or dataflow layers invalidates every entry."""
+        if cls._salt is None:
+            h = hashlib.sha256(sys.version.encode())
+            for mod_file in (__file__, _cg.__file__, _df.__file__):
+                try:
+                    with open(mod_file, "rb") as f:
+                        h.update(f.read())
+                except OSError:
+                    h.update(mod_file.encode())
+            cls._salt = h.hexdigest()
+        return cls._salt
+
+    @staticmethod
+    def _cache_path() -> str:
+        env = os.environ.get("RAYLINT_CACHE")
+        if env:
+            return env
+        uid = getattr(os, "getuid", lambda: 0)()
+        return os.path.join(tempfile.gettempdir(),
+                            f"raylint-cache-{uid}.json")
+
+    def _cache_load(self) -> dict:
+        try:
+            with open(self._cache_path(), encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return {}
+        return data if data.get("salt") == self._engine_salt() else {}
+
+    def _cache_store(self, data: dict) -> None:
+        path = self._cache_path()
+        try:
+            fd, tmp = tempfile.mkstemp(
+                dir=os.path.dirname(path) or ".", prefix=".raylint-cache-")
+        except OSError:
+            return
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(data, f)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
     def run(self) -> List[Finding]:
-        ctxs: List[FileContext] = []
+        sources: List[Tuple[str, str, str]] = []
         for path, rel in self._iter_files():
             try:
                 with open(path, encoding="utf-8") as f:
-                    ctxs.append(FileContext(path, rel, f.read()))
-            except (SyntaxError, UnicodeDecodeError) as e:
-                self.errors.append(f"{rel}: unparseable: {e}")
-        findings: List[Finding] = []
-        for ctx in ctxs:
-            for rule_id, tag, fn in RULES:
-                if self._want(rule_id, tag):
-                    findings.extend(fn(ctx))
-        for rule_id, tag, fn in PROJECT_RULES:
-            if self._want(rule_id, tag):
-                findings.extend(fn(ctxs, self))
+                    sources.append((path, rel, f.read()))
+            except (OSError, UnicodeDecodeError) as e:
+                self.errors.append(f"{rel}: unreadable: {e}")
+        findings = self._execute(sources)
         findings = [f for f in findings
                     if (f.rule, f.path) not in self.baseline]
         if self.allow_in:
@@ -1638,6 +1947,72 @@ class LintEngine:
                           key=lambda f: (f.path, f.line, f.rule))
         return findings
 
+    def _execute(self, sources: List[Tuple[str, str, str]]) -> List[Finding]:
+        """Parse + run rules, consulting the incremental cache when on.
+        Returns raw (pre-baseline, pre-allow-in) findings."""
+        cache = self._cache_load() if self.cache_enabled else None
+        hashes = {rel: hashlib.sha256(src.encode()).hexdigest()
+                  for _p, rel, src in sources}
+        tree_key = None
+        if cache is not None:
+            tree_key = hashlib.sha256(
+                json.dumps(sorted(hashes.items())).encode()).hexdigest()
+            proj = cache.get("project") or {}
+            if proj.get("tree_key") == tree_key:
+                # whole-tree hit: nothing changed since the stored run, so
+                # the project-rule findings (and everything else) replay
+                # without a single ast.parse
+                self.cache_stats = (len(sources), len(sources), True)
+                self.errors.extend(proj.get("errors") or [])
+                return [Finding(**d) for d in proj.get("findings") or []]
+        ctxs: List[FileContext] = []
+        file_findings: List[Finding] = []
+        per_file: Dict[str, List[dict]] = {}
+        cached_files = (cache.get("files") if cache is not None else {}) or {}
+        hits = 0
+        for path, rel, src in sources:
+            try:
+                ctx = FileContext(path, rel, src)
+            except SyntaxError as e:
+                self.errors.append(f"{rel}: unparseable: {e}")
+                continue
+            ctxs.append(ctx)
+            ent = cached_files.get(rel)
+            if cache is not None and ent and ent.get("hash") == hashes[rel]:
+                hits += 1
+                per_file[rel] = ent.get("findings") or []
+                file_findings.extend(Finding(**d) for d in per_file[rel])
+                continue
+            mine: List[Finding] = []
+            for rule_id, tag, fn in RULES:
+                if self._want(rule_id, tag):
+                    mine.extend(fn(ctx))
+            file_findings.extend(mine)
+            per_file[rel] = [f.to_json() for f in mine]
+        proj_findings: List[Finding] = []
+        for rule_id, tag, fn in PROJECT_RULES:
+            if self._want(rule_id, tag):
+                proj_findings.extend(fn(ctxs, self))
+        if cache is not None:
+            self.cache_stats = (hits, len(sources), False)
+            # merge, don't replace: entries for files outside this run's
+            # roots (another checkout, another root set) stay valid —
+            # their content hashes still guard them
+            merged = dict(cached_files)
+            merged.update({rel: {"hash": hashes[rel],
+                                 "findings": per_file[rel]}
+                           for rel in per_file})
+            self._cache_store({
+                "salt": self._engine_salt(),
+                "files": merged,
+                "project": {
+                    "tree_key": tree_key,
+                    "findings": [f.to_json()
+                                 for f in file_findings + proj_findings],
+                    "errors": list(self.errors)},
+            })
+        return file_findings + proj_findings
+
 
 def rule_listing() -> List[dict]:
     """Machine-readable registry listing (``--rules`` with no value).
@@ -1653,6 +2028,44 @@ def rule_listing() -> List[dict]:
                         "summary": doc.split(". ")[0][:240]})
     out.sort(key=lambda r: int(r["id"][1:]))
     return out
+
+
+def sarif_log(findings: List[Finding]) -> dict:
+    """Findings as a SARIF 2.1.0 log object (one run, one driver).  The
+    rule metadata comes straight from :func:`rule_listing`, so the SARIF
+    ``rules`` array can never drift from the registry."""
+    rules = [{
+        "id": r["id"],
+        "name": r["tag"],
+        "shortDescription": {"text": r["summary"]},
+    } for r in rule_listing()]
+    index = {r["id"]: i for i, r in enumerate(rules)}
+    results = [{
+        "ruleId": f.rule,
+        "ruleIndex": index.get(f.rule, -1),
+        "level": "warning",
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {
+                    "uri": f.path.replace("\\", "/"),
+                    "uriBaseId": "SRCROOT"},
+                "region": {"startLine": f.line},
+            },
+        }],
+    } for f in findings]
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "raylint",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }
 
 
 def _changed_files(ref: str) -> Optional[Set[str]]:
@@ -1729,6 +2142,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--self-check", action="store_true",
                         help="lint the shipped fixture corpus and verify "
                              "it round-trips expected.json exactly")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="disable the content-hash incremental cache "
+                             "(default location: raylint-cache-<uid>.json "
+                             "in the system temp dir, override with "
+                             "$RAYLINT_CACHE)")
+    parser.add_argument("--sarif", default=None, metavar="OUT.json",
+                        help="additionally write findings as a SARIF 2.1.0 "
+                             "log to OUT.json (machine-consumable for "
+                             "code-scanning UIs)")
     parser.add_argument("--write-baseline", default=None, metavar="FILE",
                         help="write current findings as a baseline and exit 0")
     args = parser.parse_args(argv)
@@ -1755,8 +2177,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                   if not args.json else "[]")
             return 0
     engine = LintEngine(args.roots or ["ray_tpu"], args.baseline, only,
-                        allow_in=allow_in, changed_only=changed_only)
+                        allow_in=allow_in, changed_only=changed_only,
+                        cache=not args.no_cache)
     findings = engine.run()
+    if engine.cache_stats is not None:
+        hits, total, warm = engine.cache_stats
+        print(f"raylint-cache: {hits}/{total} file hits, "
+              f"project {'hit' if warm else 'miss'}", file=sys.stderr)
 
     if args.write_baseline:
         with open(args.write_baseline, "w", encoding="utf-8") as f:
@@ -1766,6 +2193,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"wrote {args.write_baseline} "
               f"({len(findings)} findings baselined)")
         return 0
+
+    if args.sarif:
+        with open(args.sarif, "w", encoding="utf-8") as f:
+            json.dump(sarif_log(findings), f, indent=2)
+        print(f"raylint: sarif log written to {args.sarif}",
+              file=sys.stderr)
 
     if args.json:
         print(json.dumps([f.to_json() for f in findings], indent=2))

@@ -237,6 +237,7 @@ class FlightRecorder:
 
     def _open_lastwords(self) -> None:
         path = os.path.join(self.dir, LASTWORDS_NAME)
+        f = None
         try:
             import mmap
             f = open(path, "w+b")
@@ -246,6 +247,12 @@ class FlightRecorder:
         except (OSError, ValueError, ImportError):
             # plain-file fallback: pwrite the same length-prefixed payload
             self._lw_map = None
+            if f is not None:
+                # the mmap attempt left the first handle open
+                try:
+                    f.close()
+                except OSError:
+                    pass
             try:
                 self._lw_file = open(path, "w+b")
                 self._lw_file.truncate(_LASTWORDS_SIZE)

@@ -140,8 +140,18 @@ class TrialRunner:
         handle = self._ckpt_engine.save(
             payload, step=self._ckpt_seq,
             meta={"trial_id": trial.trial_id},
-            save_key=f"{trial.trial_id}-{self._ckpt_seq:08d}", wait=True)
-        return CheckpointRef(self._ckpt_engine.root, handle.result())
+            save_key=f"{trial.trial_id}-{self._ckpt_seq:08d}")
+        return CheckpointRef(self._ckpt_engine.root,
+                             handle.result(timeout=self._budget_left()))
+
+    def _budget_left(self) -> Optional[float]:
+        """Remaining experiment time budget, with a one-minute grace floor:
+        an in-flight checkpoint commit may finish past the budget (a ref
+        must never circulate uncommitted) but not hang forever."""
+        if self.time_budget_s is None or not self._start_time:
+            return None
+        return max(60.0,
+                   self.time_budget_s - (time.time() - self._start_time))
 
     @staticmethod
     def _resolve_checkpoint(checkpoint):

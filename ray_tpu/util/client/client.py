@@ -106,17 +106,26 @@ class ClientAPI:
         host, _, port = address.partition(":")
         self._sock = socket.create_connection((host, int(port)),
                                               timeout=timeout)
-        self._sock.settimeout(None)
-        self._send_lock = threading.Lock()
-        self._plock = threading.Lock()
-        self._pending: Dict[int, list] = {}  # seq -> [Event, resp|None]
-        self._seq = 0
-        self._closed: Optional[Exception] = None
-        self._reader = threading.Thread(target=self._read_loop,
-                                        daemon=True, name="client-reader")
-        self._reader.start()
-        assert self._call({"op": "ping"})["initialized"], \
-            "server head is not initialized"
+        try:
+            self._sock.settimeout(None)
+            self._send_lock = threading.Lock()
+            self._plock = threading.Lock()
+            self._pending: Dict[int, list] = {}  # seq -> [Event, resp|None]
+            self._seq = 0
+            self._closed: Optional[Exception] = None
+            self._reader = threading.Thread(target=self._read_loop,
+                                            daemon=True, name="client-reader")
+            self._reader.start()
+            assert self._call({"op": "ping"},
+                              timeout=timeout)["initialized"], \
+                "server head is not initialized"
+        except Exception:
+            # a failed handshake (wrong server, dead head) must close the fd
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            raise
 
     def _read_loop(self):
         try:
@@ -138,7 +147,7 @@ class ClientAPI:
             for slot in pending.values():
                 slot[0].set()
 
-    def _call(self, req: dict):
+    def _call(self, req: dict, timeout: Optional[float] = None):
         slot = [threading.Event(), None]
         with self._plock:
             if self._closed is not None:
@@ -150,7 +159,9 @@ class ClientAPI:
         try:
             with self._send_lock:
                 send_msg(self._sock, dict(req, seq=seq))
-            slot[0].wait()
+            if not slot[0].wait(timeout):
+                raise TimeoutError(
+                    f"no reply to {req.get('op')!r} within {timeout}s")
         finally:
             with self._plock:
                 self._pending.pop(seq, None)

@@ -6,10 +6,14 @@
 #      shipped fixture corpus round-trips expected.json exactly, and the
 #      machine-readable `--rules` listing is cross-checked against this
 #      header and the ARCHITECTURE.md rule table so neither can drift.
-#   1. raylint — the framework-aware AST linter (R1..R15, including the
-#      whole-program call-graph rules) over ray_tpu/, bench.py,
-#      bench_micro.py, and tests/; any non-allowlisted finding fails the
-#      gate. tests/ runs under a scoped allow profile (see below).
+#   1. raylint — the framework-aware AST linter (R1..R18, including the
+#      whole-program call-graph rules and the path-sensitive dataflow
+#      rules) over ray_tpu/, bench.py, bench_micro.py, and tests/; any
+#      non-allowlisted finding fails the gate. tests/ runs under a
+#      scoped allow profile (see below). Emits a SARIF 2.1.0 artifact
+#      next to the JSON summary, reports the incremental-cache hit rate
+#      in the timing summary, and warns when the stage outruns its
+#      recorded cold-cache baseline by >50%.
 #   2. lockwatch — the tier-1 test suite once under RAY_TPU_LOCKWATCH=1;
 #      every process summary line must report zero lock-order cycles.
 #      Static R11 findings and these runtime reports share one cycle
@@ -77,8 +81,13 @@ st=OK
 # directories (R9) and simulates rank-divergent schedules on purpose
 # (R12); scoped here so production code can never ride on it.
 LINT_JSON="$(mktemp /tmp/raytpu_lint.XXXXXX.json)"
+LINT_ERR="$(mktemp /tmp/raytpu_lint.XXXXXX.err)"
+# CI artifact: SARIF 2.1.0 log of every finding (empty `results` on a
+# clean tree), for editor/code-scanning ingestion
+LINT_SARIF="${RAYLINT_SARIF_OUT:-/tmp/raytpu_lint.sarif.json}"
 if python -m ray_tpu.devtools.lint ray_tpu bench.py bench_micro.py tests \
-     --allow-in "tests/:R9,R12" --json > "$LINT_JSON"; then
+     --allow-in "tests/:R9,R12" --json --sarif "$LINT_SARIF" \
+     > "$LINT_JSON" 2> "$LINT_ERR"; then
   python - "$LINT_JSON" <<'EOF'
 import json, sys
 rows = json.load(open(sys.argv[1]))
@@ -97,8 +106,21 @@ for r in rows:
           f"{r['message']}", file=sys.stderr)
 EOF
 fi
-rm -f "$LINT_JSON"
+cat "$LINT_ERR" >&2
+CACHE_LINE="$(grep -o 'raylint-cache: .*' "$LINT_ERR" | tail -1)"
+rm -f "$LINT_JSON" "$LINT_ERR"
 stage_done "stage 1 (raylint)" "$t0" "$st"
+STAGE_TIMES+=("stage 1 cache: ${CACHE_LINE#raylint-cache: }")
+# Budget check against the recorded cold-cache baseline (full R1..R18
+# run over the widened file set, 2026-08): a >50% overshoot means a
+# rule regressed into super-linear work or the cache stopped landing.
+STAGE1_BASELINE_S="${RAYLINT_STAGE1_BASELINE_S:-20}"
+st1_el=$(( SECONDS - t0 ))
+if [ "$st1_el" -gt $(( STAGE1_BASELINE_S * 3 / 2 )) ]; then
+  echo "WARNING: stage 1 took ${st1_el}s, >50% over its recorded" \
+       "baseline of ${STAGE1_BASELINE_S}s — check rule cost or cache" >&2
+  STAGE_TIMES+=("stage 1 budget: OVER (${st1_el}s vs ${STAGE1_BASELINE_S}s baseline)")
+fi
 
 echo "== [stage 2] lockwatch (tier-1 under RAY_TPU_LOCKWATCH=1) =="
 t0=$SECONDS

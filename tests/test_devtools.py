@@ -970,3 +970,266 @@ def test_cli_allow_in_scopes_suppression_by_prefix(tmp_path):
 def test_cli_self_check_round_trips_fixture_corpus():
     from ray_tpu.devtools.linter import main
     assert main(["--self-check"]) == 0
+
+
+# -- dataflow layer: R16/R17/R18 acceptance -----------------------------------
+
+def test_r16_catches_seeded_socket_leak_with_witness_path(tmp_path):
+    findings = run_tree(tmp_path, "R16", {"net.py": """\
+        import socket
+
+        def fetch(addr, key):
+            sock = socket.create_connection(addr)
+            if key is None:
+                return None
+            data = sock.recv(64)
+            sock.close()
+            return data
+        """})
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "R16" and f.line == 4
+    assert "socket 'sock'" in f.message and "'fetch'" in f.message
+    # the witness path names the branch taken to the leaking exit
+    assert "the return at line 6" in f.message
+    assert "path: then@5" in f.message
+
+
+def test_r16_quiet_on_release_transfer_and_annotation(tmp_path):
+    findings = run_tree(tmp_path, "R16", {"net.py": """\
+        import socket
+
+        def closed_on_every_path(addr):
+            sock = socket.create_connection(addr)
+            try:
+                return sock.recv(64)
+            finally:
+                sock.close()
+
+        def ownership_returned(addr):
+            return socket.create_connection(addr)
+
+        def annotated(addr, reg):
+            sock = socket.create_connection(addr)  # raylint: transfer(socket) reg owns it
+            reg.adopt(sock)
+        """})
+    assert findings == []
+
+
+def test_r17_catches_naked_wait_under_deadline_with_witness(tmp_path):
+    findings = run_tree(tmp_path, "R17", {"drain.py": """\
+        import threading
+
+        DONE = threading.Event()
+
+        def drain(deadline):
+            _flush()
+
+        def _flush():
+            DONE.wait()
+        """})
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "R17" and f.line == 9
+    assert "DONE.wait() without timeout" in f.message
+    assert "'drain(deadline)'" in f.message
+    # witness chain: root -> call site -> blocking site
+    assert "witness: drain@6 -> _flush@9" in f.message
+
+
+def test_r17_quiet_when_budget_flows_down(tmp_path):
+    findings = run_tree(tmp_path, "R17", {"drain.py": """\
+        import threading
+
+        DONE = threading.Event()
+
+        def drain(deadline):
+            DONE.wait(deadline)
+
+        def unscoped():
+            DONE.wait()
+        """})
+    assert findings == []
+
+
+def test_r18_catches_seeded_send_without_handler(tmp_path):
+    findings = run_tree(tmp_path, "R18", {"proto.py": """\
+        def push(client, pb):
+            client.call_async(pb.LOST_CALL, b"")
+
+        def dispatch(env, ctx, pb):
+            if env.method == pb.PING:
+                ctx.reply(b"")
+            else:
+                ctx.reply_error("unknown")
+
+        def ping(client, pb):
+            client.call(pb.PING, b"")
+        """})
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "R18" and f.line == 2
+    assert "LOST_CALL" in f.message and "no dispatcher handles it" in f.message
+
+
+def test_r18_reply_discipline_and_lifecycle_table(tmp_path):
+    findings = run_tree(tmp_path, "R18", {"srv.py": """\
+        def handler(env, ctx, pb):
+            if env.method == pb.ECHO:
+                ctx.reply(b"")
+
+        def send(client, pb):
+            client.call(pb.ECHO, b"")
+
+        def promote(node):
+            if node.state == "DRAINED":
+                node.state = "ALIVE"
+        """})
+    msgs = sorted(f.message for f in findings)
+    assert len(findings) == 2
+    assert any("never replies" in m for m in msgs)
+    assert any("'DRAINED' -> 'ALIVE'" in m for m in msgs)
+
+
+# -- regression guards for the defects R16/R17 found in the real tree ---------
+
+def _lint_repo(rule_id, *relpaths):
+    eng = LintEngine([os.path.join(REPO, p) for p in relpaths],
+                     only_rules={rule_id})
+    findings = eng.run()
+    assert not eng.errors, eng.errors
+    return findings
+
+
+def test_r16_regression_rpc_and_runtime_ctors_stay_leak_free():
+    # RpcClient/RpcServer/Runtime/ClientAPI constructor aborts and the
+    # recorder fallback used to strand sockets, pools and file handles
+    assert _lint_repo("R16",
+                      "ray_tpu/_private/rpc.py",
+                      "ray_tpu/_private/runtime.py",
+                      "ray_tpu/observability/recorder.py",
+                      "ray_tpu/util/client/client.py") == []
+
+
+def test_r17_regression_drain_and_checkpoint_stay_bounded():
+    # drain/checkpoint/tune/client paths used to block with no bound
+    # under their deadline scopes (engine.save wait, client _call wait)
+    assert _lint_repo("R17",
+                      "ray_tpu/_private/distributed.py",
+                      "ray_tpu/checkpoint/engine.py",
+                      "ray_tpu/tune/execution.py",
+                      "ray_tpu/util/client/client.py") == []
+
+
+def test_rpc_server_ctor_abort_closes_listener(monkeypatch):
+    import socket as socket_mod
+    from ray_tpu._private import rpc as rpc_mod
+    blocker = socket_mod.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    port = blocker.getsockname()[1]
+    created = []
+    real_socket = socket_mod.socket
+
+    def spy(*a, **k):
+        s = real_socket(*a, **k)
+        created.append(s)
+        return s
+
+    monkeypatch.setattr(rpc_mod.socket, "socket", spy)
+    with pytest.raises(OSError):
+        rpc_mod.RpcServer(lambda *a: None, host="127.0.0.1", port=port)
+    assert created, "server never made its listener socket"
+    assert all(s.fileno() == -1 for s in created), "listener fd leaked"
+    blocker.close()
+
+
+def test_rpc_client_ctor_abort_closes_socket(monkeypatch):
+    import socket as socket_mod
+    from ray_tpu._private import rpc as rpc_mod
+    blocker = socket_mod.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    addr = "127.0.0.1:%d" % blocker.getsockname()[1]
+    created = []
+    real_cc = socket_mod.create_connection
+
+    def spy(*a, **k):
+        s = real_cc(*a, **k)
+        created.append(s)
+        return s
+
+    class Boom(Exception):
+        pass
+
+    def boom(*a, **k):
+        raise Boom("post-connect ctor failure")
+
+    monkeypatch.setattr(rpc_mod.socket, "create_connection", spy)
+    monkeypatch.setattr(rpc_mod.threading, "Thread", boom)
+    with pytest.raises(Boom):
+        rpc_mod.RpcClient(addr, connect_timeout=5)
+    assert created, "client never connected"
+    assert created[0].fileno() == -1, "connected fd leaked on ctor abort"
+    blocker.close()
+
+
+def test_checkpoint_save_and_client_call_take_timeouts():
+    import inspect
+    from ray_tpu.checkpoint.engine import CheckpointEngine
+    from ray_tpu.util.client.client import ClientAPI
+    assert "timeout_s" in inspect.signature(CheckpointEngine.save).parameters
+    assert "timeout" in inspect.signature(ClientAPI._call).parameters
+
+
+# -- incremental cache + SARIF ------------------------------------------------
+
+def test_incremental_cache_replays_findings(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAYLINT_CACHE", str(tmp_path / "cache.json"))
+    root = tmp_path / "proj"
+    root.mkdir()
+    swallow = "try:\n    pass\nexcept Exception:\n    pass\n"
+    (root / "a.py").write_text(swallow)
+
+    eng_cold = LintEngine([str(root)], cache=True)
+    cold = eng_cold.run()
+    assert len(cold) == 1 and cold[0].rule == "R4"
+    assert eng_cold.cache_stats == (0, 1, False)
+
+    eng_warm = LintEngine([str(root)], cache=True)
+    warm = eng_warm.run()
+    assert eng_warm.cache_stats == (1, 1, True)
+    assert warm == cold
+
+    (root / "a.py").write_text("x = 1\n" + swallow)
+    eng_dirty = LintEngine([str(root)], cache=True)
+    dirty = eng_dirty.run()
+    assert eng_dirty.cache_stats == (0, 1, False)
+    assert len(dirty) == 1 and dirty[0].line == cold[0].line + 1
+
+
+def test_cache_bypassed_under_rule_restriction(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAYLINT_CACHE", str(tmp_path / "cache.json"))
+    root = tmp_path / "proj"
+    root.mkdir()
+    (root / "a.py").write_text("x = 1\n")
+    eng = LintEngine([str(root)], only_rules={"R4"}, cache=True)
+    eng.run()
+    assert not eng.cache_enabled
+    assert eng.cache_stats is None
+    assert not (tmp_path / "cache.json").exists()
+
+
+def test_sarif_log_covers_all_rules_and_anchors_findings():
+    from ray_tpu.devtools.linter import Finding, sarif_log
+    log = sarif_log([Finding("R4", "swallow", "pkg/a.py", 3, "msg here")])
+    assert log["version"] == "2.1.0"
+    run = log["runs"][0]
+    rules = run["tool"]["driver"]["rules"]
+    assert {r["id"] for r in rules} == {f"R{i}" for i in range(1, 19)}
+    res = run["results"][0]
+    assert res["ruleId"] == "R4"
+    assert rules[res["ruleIndex"]]["id"] == "R4"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "pkg/a.py"
+    assert loc["region"]["startLine"] == 3

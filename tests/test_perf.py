@@ -185,7 +185,7 @@ def test_sampler_folds_stacks():
     try:
         t = threading.Thread(target=_spin,
                              args=(time.monotonic() + 0.6,),
-                             name="spinner")
+                             name="spinner", daemon=True)
         t.start()
         t.join()
     finally:
