@@ -291,3 +291,25 @@ _config.define("serve_ingress_put_threshold_bytes", int, 256 * 1024,
                "serve ingress bodies at least this large are put() into the "
                "object plane and handed to the replica as a ref, so the "
                "bytes ride the striped transport pool instead of pickle")
+
+# -- Interactive serving (continuous batching / routing / SLO autoscaling) -------
+_config.define("serve_target_latency_ms", float, 100.0,
+               "default per-request latency budget for a deployment when "
+               "DeploymentConfig.target_latency_ms is 0: the replica "
+               "micro-batcher sizes batches to fit it, the router sheds "
+               "(503) when every replica's queue estimate exceeds it, and "
+               "the SLO autoscaler holds the federated p95 under it")
+_config.define("serve_queue_deadline_ms", float, 2000.0,
+               "max age of a request in a replica's admission queue (and "
+               "the router's default replica-wait) before it is shed with "
+               "ServeOverloadedError instead of serving a stale response; "
+               "<= 0 disables shedding (requests wait indefinitely)")
+_config.define("serve_batch_retry_singletons", bool, True,
+               "when a serve batch function raises, re-run each member as "
+               "a singleton once so one poisoned request fails alone "
+               "instead of taking its batchmates down; off = every member "
+               "gets the batch-level BatchExecutionError")
+_config.define("serve_autoscale_ewma_alpha", float, 0.3,
+               "EWMA weight for the SLO autoscaler's federated queue-wait "
+               "p95 sensor: higher reacts faster to latency spikes, lower "
+               "rides through transients without scaling")

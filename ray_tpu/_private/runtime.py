@@ -1160,11 +1160,14 @@ class Runtime:
                 with self.lock:
                     self.task_states[spec.task_id] = "FINISHED"
             except BaseException as e:  # noqa: BLE001
-                if isinstance(e, (exc.TaskCancelledError,)):
-                    wrapped: BaseException = e
-                else:
-                    wrapped = exc.TaskError(
-                        f"{state.cls.__name__}.{spec.method_name}", e)
+                # Runtime errors (incl. TaskCancelledError and serve's
+                # overload/shed signals) re-raise RAW at get(), same as
+                # the plain-task and async-actor paths — callers
+                # discriminate on the type; user errors get the TaskError
+                # wrapper naming the method.
+                wrapped = (e if isinstance(e, exc.RayTpuError)
+                           else exc.TaskError(
+                               f"{state.cls.__name__}.{spec.method_name}", e))
                 for rid in spec.return_ids:
                     self.seal_error(rid, wrapped, node)
                 with self.lock:

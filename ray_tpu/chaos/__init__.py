@@ -46,6 +46,12 @@ checkpoint.write      path, rank — engine writer, before each chunk write
 checkpoint.commit     stage (manifest|latest), step — rank-0 committer,
                       before the manifest rename / LATEST update
 checkpoint.restore    manifest, rank — before chunks are read back
+serve.replica.execute deployment, replica — serve replica, before the user
+                      callable runs (both the direct path and the
+                      micro-batcher's per-batch execution); "delay" makes
+                      one replica serve slow — the latency-aware router
+                      routes around it and the SLO autoscaler sees its
+                      p95 — and "error" fails its requests
 ====================  =====================================================
 """
 

@@ -83,3 +83,46 @@ class RuntimeEnvSetupError(RayTpuError):
 
 class PlacementGroupSchedulingError(RayTpuError):
     pass
+
+
+class ServeOverloadedError(RayTpuError):
+    """Serve shed this request: every replica's queue exceeds its latency
+    budget (router-side) or the request aged out of a replica's admission
+    queue (replica-side).  The HTTP proxy maps it to 503 + Retry-After;
+    programmatic callers should back off and retry.  Subclasses
+    ``RayTpuError`` so it re-raises raw at ``get()`` instead of being
+    wrapped in ``TaskError`` — the router and proxy discriminate on it.
+    """
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        self.retry_after_s = retry_after_s
+        super().__init__(message)
+
+    def __reduce__(self):
+        return (type(self), (self.args[0] if self.args else "",
+                             self.retry_after_s))
+
+
+class BatchExecutionError(RayTpuError):
+    """A serve batch function failed for a whole batch.  Distinguishes
+    "I was collateral damage in someone else's batch" from "my request
+    was bad": carries the batch size and the originating request ids so
+    callers can tell which.  When singleton retry is enabled
+    (``serve_batch_retry_singletons``), members are re-run alone and
+    receive their *own* errors instead of this batch-level tag.
+    """
+
+    def __init__(self, function_name: str, batch_size: int,
+                 request_ids, cause: BaseException):
+        self.function_name = function_name
+        self.batch_size = batch_size
+        self.request_ids = tuple(request_ids)
+        self.cause = cause
+        super().__init__(
+            f"batched function {function_name} failed for a batch of "
+            f"{batch_size} (request ids {list(self.request_ids)}): "
+            f"{type(cause).__name__}: {cause}")
+
+    def __reduce__(self):
+        return (type(self), (self.function_name, self.batch_size,
+                             self.request_ids, self.cause))

@@ -1,5 +1,7 @@
 """ray_tpu.serve — model serving on actors (reference: python/ray/serve/)."""
 
+from ray_tpu.exceptions import (BatchExecutionError,  # noqa: F401
+                                ServeOverloadedError)
 from ray_tpu.serve.api import (Application, Deployment,  # noqa: F401
                                delete, deployment, get_deployment_handle,
                                run, shutdown, start, start_http_proxy,
@@ -13,4 +15,5 @@ from ray_tpu.serve.handle import (DeploymentHandle,  # noqa: F401
 __all__ = ["deployment", "run", "start", "shutdown", "delete", "status",
            "batch", "start_http_proxy", "get_deployment_handle",
            "Application", "Deployment", "DeploymentHandle",
-           "DeploymentResponse", "DeploymentConfig", "AutoscalingConfig"]
+           "DeploymentResponse", "DeploymentConfig", "AutoscalingConfig",
+           "ServeOverloadedError", "BatchExecutionError"]

@@ -14,6 +14,7 @@ import logging
 from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
+from ray_tpu.observability import perf
 from ray_tpu.serve._private.replica import Replica
 from ray_tpu.serve.config import DeploymentConfig
 
@@ -43,6 +44,10 @@ class DeploymentState:
         self.replicas: List[ReplicaInfo] = []
         self.deleting = False
         self._last_health_check = 0.0
+        # Last-seen cumulative perf counts per replica tag: the controller
+        # federates WINDOWED (per-tick delta) histograms, so each tick's
+        # p95 reflects recent traffic, not all history.
+        self._prev_perf: Dict[str, Dict[str, List[int]]] = {}
 
     # -- target mutations -------------------------------------------------
 
@@ -87,10 +92,18 @@ class DeploymentState:
         opts = dict(self.config.ray_actor_options)
         opts.setdefault("max_concurrency",
                         max(2, self.config.max_concurrent_queries))
+        batch_cfg = None
+        if getattr(self.config, "max_batch_size", 1) > 1:
+            batch_cfg = {
+                "max_batch_size": self.config.max_batch_size,
+                "batch_wait_timeout_s": self.config.batch_wait_timeout_s,
+                "pad_batch_to": self.config.pad_batch_to,
+                "target_latency_ms": self.config.target_latency_ms,
+            }
         handle = ray_tpu.remote(Replica).options(**opts).remote(
             self.name, tag, self.func_or_class, self.init_args,
             self.init_kwargs, self.config.user_config,
-            self.config.checkpoint)
+            self.config.checkpoint, batch_cfg)
         return ReplicaInfo(tag, handle, self.target_version)
 
     def _stop_replica(self, info: ReplicaInfo) -> None:
@@ -194,6 +207,81 @@ class DeploymentState:
             except Exception as e:
                 logger.debug("replica metrics fetch failed: %s", e)
         return total
+
+    @staticmethod
+    def _window(cur: Optional[List[int]],
+                prev: Optional[List[int]]) -> Optional[List[int]]:
+        """Per-bucket delta of cumulative counts since the last tick.
+        A restarted replica's counts reset below the previous snapshot —
+        clamp at 0 instead of producing negative buckets."""
+        if not cur:
+            return None
+        if not prev or len(prev) != len(cur):
+            return list(cur)
+        return [max(0, c - p) for c, p in zip(cur, prev)]
+
+    def collect_metrics(self) -> dict:
+        """One federated sensor sweep: fetch every replica's local
+        histograms, window them against the previous tick, and compute
+
+        - per-replica windowed ``execute`` p95 (published to routers for
+          power-of-two-choices scoring) and ``queue_est_ms`` backpressure,
+        - the deployment-wide windowed ``queue_wait`` + ``execute`` p95
+          (summed: the time a newly admitted request should expect) that
+          drives the SLO autoscaler,
+        - total ongoing requests (the legacy queue-depth signal), all
+          from a single ``get_metrics`` round-trip per replica.
+        """
+        probes = []
+        for info in self.replicas:
+            try:
+                probes.append((info, info.handle.get_metrics.remote()))
+            except Exception as e:
+                logger.debug("replica metrics submit failed: %s", e)
+        total_ongoing = 0.0
+        per_replica: Dict[str, dict] = {}
+        qw_windows: List[List[int]] = []
+        ex_windows: List[List[int]] = []
+        bounds = None
+        new_prev: Dict[str, Dict[str, List[int]]] = {}
+        for info, ref in probes:
+            try:
+                m = ray_tpu.get(ref, timeout=5)
+            except Exception as e:
+                logger.debug("replica metrics fetch failed: %s", e)
+                continue
+            total_ongoing += m.get("num_ongoing_requests", 0)
+            p = m.get("perf") or {}
+            bounds = p.get("bounds") or bounds
+            qw = (p.get("queue_wait") or {}).get("counts")
+            ex = (p.get("execute") or {}).get("counts")
+            prev = self._prev_perf.get(info.tag, {})
+            d_qw = self._window(qw, prev.get("queue_wait"))
+            d_ex = self._window(ex, prev.get("execute"))
+            new_prev[info.tag] = {"queue_wait": list(qw or []),
+                                  "execute": list(ex or [])}
+            exec_p95 = (perf.quantile(d_ex, 0.95, bounds)
+                        if d_ex and sum(d_ex) else 0.0)
+            per_replica[info.tag] = {
+                "p95_ms": exec_p95,
+                "queue_est_ms": float(m.get("queue_est_ms", 0.0)),
+                "ongoing": int(m.get("num_ongoing_requests", 0)),
+            }
+            if d_qw:
+                qw_windows.append(d_qw)
+            if d_ex:
+                ex_windows.append(d_ex)
+        self._prev_perf = new_prev
+        p95 = 0.0
+        merged_qw = perf.merge_counts(qw_windows)
+        if merged_qw and sum(merged_qw):
+            p95 += perf.quantile(merged_qw, 0.95, bounds)
+        merged_ex = perf.merge_counts(ex_windows)
+        if merged_ex and sum(merged_ex):
+            p95 += perf.quantile(merged_ex, 0.95, bounds)
+        return {"total_ongoing": total_ongoing,
+                "replicas": per_replica,
+                "p95_ms": p95}
 
     def status(self) -> dict:
         return {

@@ -38,6 +38,7 @@ from typing import Dict, Optional
 
 from ray_tpu import observability
 from ray_tpu._private.config import _config
+from ray_tpu.exceptions import ServeOverloadedError
 from ray_tpu.observability import perf
 from ray_tpu.serve._private.long_poll import LongPollClient
 from ray_tpu.serve.controller import ROUTE_TABLE_KEY
@@ -71,13 +72,16 @@ class HTTPProxy:
             def log_message(self, *a):  # quiet
                 pass
 
-            def _json(self, code: int, payload: dict):
+            def _json(self, code: int, payload: dict,
+                      retry_after_s: float = 1.0):
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 if code == 503:
-                    self.send_header("Retry-After", "1")
+                    self.send_header(
+                        "Retry-After",
+                        str(max(1, int(round(retry_after_s)))))
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -171,6 +175,16 @@ class HTTPProxy:
                             self.wfile.flush()
                         except OSError:
                             pass
+                    elif isinstance(e, ServeOverloadedError):
+                        # Serve shed the request (router: every replica
+                        # over budget; replica: queue-deadline ageout).
+                        self._json(503, {"error": str(e)},
+                                   retry_after_s=e.retry_after_s)
+                    elif isinstance(e, TimeoutError):
+                        # Includes the router's bounded pick (no replica
+                        # freed a slot within serve_queue_deadline_ms):
+                        # overload presents as a fast 503, never a hang.
+                        self._json(503, {"error": str(e)})
                     else:
                         self._json(500, {"error": str(e)})
                 finally:

@@ -32,6 +32,20 @@ class LongPollHost:
             self._snapshot_ids[key] = self._snapshot_ids.get(key, 0) + 1
             self._cond.notify_all()
 
+    def notify_if_changed(self, key: str, obj: Any) -> bool:
+        """``notify_changed`` that dedups: skip the snapshot bump (and the
+        listener wakeups) when ``obj`` equals the currently published
+        value.  The control loop publishes per-replica latency stats every
+        tick; without this every idle tick would fan a no-op update out to
+        every router.  Returns True when a notification was published."""
+        with self._cond:
+            if key in self._objects and self._objects[key] == obj:
+                return False
+            self._objects[key] = obj
+            self._snapshot_ids[key] = self._snapshot_ids.get(key, 0) + 1
+            self._cond.notify_all()
+            return True
+
     def listen_for_change(
             self, keys_to_snapshot_ids: Dict[str, int],
             timeout_s: float = 30.0) -> Dict[str, Tuple[int, Any]]:

@@ -117,13 +117,27 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                route_prefix: Optional[str] = None,
                health_check_period_s: float = 10.0,
                graceful_shutdown_timeout_s: float = 20.0,
-               checkpoint: Any = None):
+               checkpoint: Any = None,
+               max_batch_size: int = 1,
+               batch_wait_timeout_s: float = 0.005,
+               pad_batch_to: Optional[Any] = None,
+               target_latency_ms: float = 0.0):
     """Decorator declaring a class or function as a Serve deployment.
 
     ``checkpoint`` accepts a ``ray_tpu.checkpoint.CheckpointRef`` (e.g.
     ``trainer_result.checkpoint.manifest_ref``): class replicas then
     cold-start with the restored pytree injected as a ``checkpoint=``
     init kwarg, loaded from the engine store on the replica itself.
+
+    ``max_batch_size > 1`` turns each replica into an adaptive
+    micro-batcher: ``__call__`` (or the deployed function) must accept a
+    LIST of requests and return a list of equal length; ``pad_batch_to``
+    (sorted bucket sizes) pads batches so a jitted forward never
+    recompiles per batch size; ``target_latency_ms`` is the per-request
+    latency budget the batcher sizes against, the router sheds over, and
+    — with ``AutoscalingConfig.target_latency_ms`` — the SLO the
+    autoscaler holds (0 falls back to the ``serve_target_latency_ms``
+    knob).
     """
 
     def wrap(func_or_class):
@@ -144,7 +158,11 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             ray_actor_options=ray_actor_options or {},
             health_check_period_s=health_check_period_s,
             graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
-            checkpoint=checkpoint)
+            checkpoint=checkpoint,
+            max_batch_size=max_batch_size,
+            batch_wait_timeout_s=batch_wait_timeout_s,
+            pad_batch_to=tuple(pad_batch_to) if pad_batch_to else None,
+            target_latency_ms=target_latency_ms)
         return Deployment(func_or_class,
                           name or func_or_class.__name__, cfg, route_prefix)
 

@@ -16,18 +16,51 @@ are dropped before delivery.
 from __future__ import annotations
 
 import functools
+import itertools
 import threading
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
+from ray_tpu.exceptions import BatchExecutionError
+
+_request_counter = itertools.count()
+
+
+def next_request_id() -> int:
+    """Process-unique id stamped on each batched request so batch-level
+    failures (``BatchExecutionError``) can name their members.  Shared
+    with the replica-side micro-batcher."""
+    return next(_request_counter)
+
+
+def next_bucket(n: int, buckets: Optional[Tuple[int, ...]]) -> int:
+    """Smallest bucket >= n (the largest bucket when n overflows them);
+    n itself when no buckets are configured."""
+    if not buckets:
+        return n
+    return next((b for b in buckets if b >= n), buckets[-1])
+
+
+def pad_items(items: List[Any], buckets: Optional[Tuple[int, ...]]
+              ) -> List[Any]:
+    """Pad ``items`` (repeating the last element) up to the next bucket so
+    a jitted forward only ever sees ``len(buckets)`` static batch shapes.
+    Shared by the ``@serve.batch`` decorator and the replica-side
+    micro-batcher — one owner of the pad-to-bucket rule."""
+    target = next_bucket(len(items), buckets)
+    if target > len(items):
+        return items + [items[-1]] * (target - len(items))
+    return items
+
 
 class _Slot:
-    __slots__ = ("item", "event", "value", "error")
+    __slots__ = ("item", "event", "value", "error", "request_id")
 
     def __init__(self, item):
         self.item = item
         self.event = threading.Event()
         self.value = None
         self.error: Optional[BaseException] = None
+        self.request_id = next_request_id()
 
 
 class _BatchQueue:
@@ -86,31 +119,55 @@ class _BatchQueue:
             if batch:
                 self._execute(instance, batch)
 
-    def _execute(self, instance, batch: List[_Slot]) -> None:
-        items = [s.item for s in batch]
+    def _call(self, instance, items: List[Any]) -> List[Any]:
         n = len(items)
-        if self._buckets:
-            target = next((b for b in self._buckets if b >= n),
-                          self._buckets[-1])
-            if target > n:
-                items = items + [items[-1]] * (target - n)
+        items = pad_items(items, self._buckets)
+        if instance is not None:
+            results = self._fn(instance, items)
+        else:
+            results = self._fn(items)
+        results = list(results)[:n]
+        if len(results) != n:
+            raise ValueError(
+                f"batched function returned {len(results)} results "
+                f"for {n} inputs")
+        return results
+
+    def _execute(self, instance, batch: List[_Slot]) -> None:
         try:
-            if instance is not None:
-                results = self._fn(instance, items)
-            else:
-                results = self._fn(items)
-            results = list(results)[:n]
-            if len(results) != n:
-                raise ValueError(
-                    f"batched function returned {len(results)} results "
-                    f"for {n} inputs")
+            results = self._call(instance, [s.item for s in batch])
             for slot, value in zip(batch, results):
                 slot.value = value
                 slot.event.set()
+            return
         except BaseException as e:
+            error = e
+        # Batch-level failure.  A singleton batch gets its own error raw —
+        # there is no ambiguity about whose request poisoned it.  For
+        # multi-item batches, optionally re-run each member alone once so
+        # poisoned requests fail alone and innocent batchmates still get
+        # answers; otherwise stamp a batch-level tag carrying the batch
+        # size and request ids so callers can tell "my request was bad"
+        # from "I was collateral".
+        if len(batch) == 1:
+            batch[0].error = error
+            batch[0].event.set()
+            return
+        from ray_tpu._private.config import _config
+        if _config.get("serve_batch_retry_singletons"):
             for slot in batch:
-                slot.error = e
+                try:
+                    slot.value = self._call(instance, [slot.item])[0]
+                except BaseException as single_err:
+                    slot.error = single_err
                 slot.event.set()
+            return
+        tagged = BatchExecutionError(
+            self._fn.__name__, len(batch),
+            [s.request_id for s in batch], error)
+        for slot in batch:
+            slot.error = tagged
+            slot.event.set()
 
 
 def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,

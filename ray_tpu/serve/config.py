@@ -9,13 +9,16 @@ user_config pushed to live replicas.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 
 @dataclasses.dataclass
 class AutoscalingConfig:
     """Queue-depth-driven autoscaling (reference:
-    ``serve/_private/autoscaling_policy.py``)."""
+    ``serve/_private/autoscaling_policy.py``), plus the latency-SLO mode:
+    with ``target_latency_ms > 0`` the controller scales on the
+    EWMA-smoothed federated ``serve.queue_wait`` + execute p95 from the
+    perf plane instead of instantaneous queue depth."""
 
     min_replicas: int = 1
     max_replicas: int = 1
@@ -23,6 +26,9 @@ class AutoscalingConfig:
     upscale_delay_s: float = 0.0
     downscale_delay_s: float = 30.0
     smoothing_factor: float = 1.0
+    # Latency SLO (ms) the deployment should hold at p95; 0 keeps the
+    # queue-depth policy above.
+    target_latency_ms: float = 0.0
 
     def desired_replicas(self, total_ongoing: float, current: int) -> int:
         if current == 0:
@@ -30,6 +36,19 @@ class AutoscalingConfig:
         per_replica = total_ongoing / current
         error = per_replica / max(
             self.target_num_ongoing_requests_per_replica, 1e-9)
+        desired = current * (1.0 + self.smoothing_factor * (error - 1.0))
+        import math
+        desired = math.ceil(desired - 1e-9)
+        return max(self.min_replicas, min(self.max_replicas, desired))
+
+    def desired_replicas_for_latency(self, p95_ms: float,
+                                     current: int) -> int:
+        """SLO mode: same multiplicative controller as the queue policy,
+        but the error signal is observed-p95 / SLO.  p95 == 0 (no recent
+        traffic) drives toward ``min_replicas``."""
+        if current == 0:
+            return max(1, self.min_replicas)
+        error = p95_ms / max(self.target_latency_ms, 1e-9)
         desired = current * (1.0 + self.smoothing_factor * (error - 1.0))
         import math
         desired = math.ceil(desired - 1e-9)
@@ -51,6 +70,26 @@ class DeploymentConfig:
     # weights come from the content-addressed store, never through the
     # controller. Changing it is a version change (rolling update).
     checkpoint: Optional[Any] = None
+    # Replica-side continuous batching: > 1 turns the replica into an
+    # adaptive micro-batcher — __call__ (and function deployments) must
+    # then accept a LIST of requests and return a list of equal length.
+    max_batch_size: int = 1
+    # Max linger the oldest queued request waits for its batch to fill.
+    batch_wait_timeout_s: float = 0.005
+    # Pad-to-bucket shapes: batches are padded (repeating the last item)
+    # up to the next bucket so a jitted forward sees only these static
+    # batch sizes and never recompiles per batch size.
+    pad_batch_to: Optional[Tuple[int, ...]] = None
+    # Per-request latency budget (ms) the batcher sizes batches against
+    # and the router sheds over; 0 falls back to the global
+    # serve_target_latency_ms knob.
+    target_latency_ms: float = 0.0
+
+    def effective_target_latency_ms(self) -> float:
+        if self.target_latency_ms > 0:
+            return float(self.target_latency_ms)
+        from ray_tpu._private.config import _config
+        return float(_config.get("serve_target_latency_ms"))
 
     def version_hash(self, func_or_class, init_args, init_kwargs) -> str:
         """Code/config version: changing it triggers a rolling update;

@@ -15,6 +15,7 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from ray_tpu._private.config import _config
 from ray_tpu.serve._private.deployment_state import DeploymentState
 from ray_tpu.serve._private.long_poll import LongPollHost
 from ray_tpu.serve.config import DeploymentConfig
@@ -72,15 +73,39 @@ class ServeController:
             state.reconcile()
             self._notify_replicas(state)
             del self._deployments[name]
+            for suffix in (":up", ":down", ":ewma"):
+                self._autoscale_state.pop(f"{name}{suffix}", None)
             self._routes = {p: d for p, d in self._routes.items()
                             if d != name}
             self._long_poll.notify_changed(ROUTE_TABLE_KEY, dict(self._routes))
 
-    def _notify_replicas(self, state: DeploymentState) -> None:
-        self._long_poll.notify_changed(
-            _replica_key(state.name),
-            {"handles": state.running_replica_handles(),
-             "max_concurrent_queries": state.config.max_concurrent_queries})
+    def _membership_info(self, state: DeploymentState,
+                         metrics: Optional[dict] = None) -> dict:
+        """Long-poll payload for one deployment: replica handles plus the
+        router's scoring inputs — per-replica windowed execute p95 and
+        queue_est_ms (rounded to whole ms so jitter doesn't fan no-op
+        updates out to every router) and the shed budget."""
+        info: Dict[str, Any] = {
+            "handles": state.running_replica_handles(),
+            "tags": [r.tag for r in state.replicas],
+            "max_concurrent_queries": state.config.max_concurrent_queries,
+            "target_latency_ms": state.config.effective_target_latency_ms(),
+            "p95_ms": {},
+            "queue_est_ms": {},
+        }
+        if metrics:
+            live = {r.tag for r in state.replicas}
+            for tag, m in metrics.get("replicas", {}).items():
+                if tag not in live:
+                    continue
+                info["p95_ms"][tag] = round(m.get("p95_ms", 0.0))
+                info["queue_est_ms"][tag] = round(m.get("queue_est_ms", 0.0))
+        return info
+
+    def _notify_replicas(self, state: DeploymentState,
+                         metrics: Optional[dict] = None) -> None:
+        self._long_poll.notify_if_changed(
+            _replica_key(state.name), self._membership_info(state, metrics))
 
     # -- queries -----------------------------------------------------------
 
@@ -89,8 +114,7 @@ class ServeController:
             state = self._deployments.get(name)
             if state is None:
                 raise KeyError(f"No deployment named {name!r}")
-            return {"handles": state.running_replica_handles(),
-                    "max_concurrent_queries": state.config.max_concurrent_queries}
+            return self._membership_info(state)
 
     def get_route_table(self) -> Dict[str, str]:
         with self._lock:
@@ -119,22 +143,50 @@ class ServeController:
         with self._lock:
             states = list(self._deployments.values())
         for state in states:
-            self._autoscale(state)
+            # One sensor sweep per deployment per tick: feeds the
+            # autoscaler AND the router-facing membership publication.
+            metrics = state.collect_metrics()
+            self._autoscale(state, metrics)
             with self._lock:
                 # A concurrent delete may have removed this deployment
                 # between the snapshot and here; reconciling the stale
                 # state would resurrect (and leak) replicas.
                 if self._deployments.get(state.name) is not state:
                     continue
-                if state.reconcile():
-                    self._notify_replicas(state)
+                state.reconcile()
+                # notify_if_changed dedups, so publishing every tick only
+                # fans out when membership or the rounded stats moved.
+                self._notify_replicas(state, metrics)
 
-    def _autoscale(self, state: DeploymentState) -> None:
+    def _autoscale(self, state: DeploymentState,
+                   metrics: Optional[dict] = None) -> None:
         cfg = state.config.autoscaling_config
         if cfg is None or state.deleting:
             return
-        ongoing = state.total_ongoing_requests()
-        desired = cfg.desired_replicas(ongoing, max(1, len(state.replicas)))
+        if metrics is None:
+            metrics = state.collect_metrics()
+        # Scale from the TARGET count, not the live count: while a
+        # scale-up is still starting replicas the live count lags, and
+        # computing desired from it over-requests again every tick
+        # (overshoot/oscillation).  The target already owns the in-flight
+        # decision; new demand should be judged against it.
+        current = max(1, state.target_replicas)
+        if cfg.target_latency_ms > 0:
+            # SLO mode: hold the federated windowed queue_wait+execute
+            # p95 at the configured latency target.  EWMA smoothing keeps
+            # one noisy tick (a single slow batch, an empty window) from
+            # whipsawing the replica count.
+            alpha = float(_config.get("serve_autoscale_ewma_alpha"))
+            ewma_key = f"{state.name}:ewma"
+            prev = self._autoscale_state.get(ewma_key)
+            p95 = float(metrics.get("p95_ms", 0.0))
+            smoothed = (p95 if prev is None
+                        else prev + alpha * (p95 - prev))
+            self._autoscale_state[ewma_key] = smoothed
+            desired = cfg.desired_replicas_for_latency(smoothed, current)
+        else:
+            desired = cfg.desired_replicas(
+                float(metrics.get("total_ongoing", 0.0)), current)
         now = time.monotonic()
         key = state.name
         if desired > state.target_replicas:

@@ -69,6 +69,17 @@ RAY_TPU_CHAOS="20260805:task.execute@2%5=delay(0.01);rpc.client.send@3%7=delay(0
 JAX_PLATFORMS=cpu \
 python -m pytest tests/test_perf.py -q
 
+echo "== serve gate (interactive serving under delay-only chaos) =="
+# The serving plane must hold its contracts when replica latency actually
+# moves: a fixed delay-only schedule on replica execution (plus RPC sends)
+# perturbs every batch window, router score, and autoscaler sensor, and the
+# test_serve_scale assertions — pad-to-bucket compile counts, per-item
+# batch error isolation, queue-deadline shedding (503, never a hang), the
+# rerouting and SLO-autoscale drills — must all still pass.
+RAY_TPU_CHAOS="20260805:serve.replica.execute@4%9=delay(0.004);rpc.client.send@3%7=delay(0.005)" \
+JAX_PLATFORMS=cpu \
+python -m pytest tests/test_serve_scale.py -q
+
 echo "== forensics gate (crash bundles sealed + doctor reads them back) =="
 # Hard-death drill: the forensics suite kills processes mid-task — via a
 # deterministic chaos exit schedule (hooks run) and via raw SIGKILL (no
