@@ -48,7 +48,25 @@ kind         meaning
              ``loop.run_in_executor``, ``call_soon_threadsafe`` — runs
              on another thread: neither blocking nor held locks
              propagate across it
+``rpc``      a protocol send site (``client.call(pb.M, ...)``) stitched
+             to the dispatch arm that handles ``M`` on the peer — the
+             callee runs in ANOTHER PROCESS: locks do not propagate
+             (each process has its own instances), but a synchronous
+             send blocks this thread until the remote handler replies
 ===========  ==========================================================
+
+**Cross-process stitching** (:meth:`ProjectIndex._stitch_rpc`): the R18
+send/handler extraction already names, for every ``pb.<METHOD>``, the
+send sites and the ``elif method == pb.<METHOD>:`` dispatch arms.  The
+stitch pass synthesizes one FunctionInfo per dispatch arm (qname
+``mod:Class._handle_rpc[METHOD]``, body = the arm's statements, analyzed
+like any function so ``self._helper`` calls resolve) and adds an
+``rpc``-kind CallSite from every send site to every arm handling that
+method.  ``transitive_paths`` can then witness paths that cross daemon
+boundaries.  The same under-approximation stance applies: a dispatcher
+is only recognized when the dispatched expression provably comes from an
+RpcContext-style parameter (``ctx.method`` or a local assigned from it),
+and an unmatched method contributes no edges.
 """
 
 from __future__ import annotations
@@ -113,7 +131,7 @@ class CallSite:
     line: int
     raw: str                      # dotted text as written ("self.flush")
     target: Optional[str]         # resolved function qname, or None
-    kind: str = "call"            # call | loop | spawn
+    kind: str = "call"            # call | loop | spawn | rpc
     locks_held: Tuple[str, ...] = ()
 
 
@@ -126,6 +144,10 @@ class FunctionInfo:
     node: ast.AST
     ctx: object                   # linter.FileContext
     is_async: bool = False
+    # "rpc-arm" for per-dispatch-arm functions synthesized by the stitch
+    # pass; rules that enumerate ALL functions for direct facts skip these
+    # (their statements belong to the real dispatcher too)
+    synthetic: Optional[str] = None
     call_sites: List[CallSite] = field(default_factory=list)
     # call AST node id -> CallSite, for rules that re-walk statements
     site_by_node: Dict[int, CallSite] = field(default_factory=dict)
@@ -161,7 +183,8 @@ class ModuleInfo:
 class ProjectIndex:
     """Symbol table + resolved call graph over a set of FileContexts."""
 
-    def __init__(self, ctxs: Iterable[object]):
+    def __init__(self, ctxs: Iterable[object],
+                 stitch_facts: Optional[Dict[str, dict]] = None):
         self.modules: Dict[str, ModuleInfo] = {}
         self.functions: Dict[str, FunctionInfo] = {}
         self.classes: Dict[str, ClassInfo] = {}
@@ -174,6 +197,16 @@ class ProjectIndex:
             self._infer_attr_types(cls)
         for fn in self.functions.values():
             self._analyze(fn)
+        # cross-process edges: method -> arm qnames, plus one record per
+        # send site (qname, line, method, sync, locks_held, arm targets).
+        # ``stitch_facts`` replays per-file send/dispatcher discovery from
+        # the incremental cache (entries are hash-validated by the caller).
+        self.rpc_arms: Dict[str, List[str]] = {}
+        self.rpc_sites: List[Tuple[str, int, str, bool,
+                                   Tuple[str, ...], Tuple[str, ...]]] = []
+        self.stitch_facts: Dict[str, dict] = {}
+        self.stitch_hits = 0
+        self._stitch_rpc(stitch_facts or {})
 
     # -- construction ------------------------------------------------------
 
@@ -528,6 +561,174 @@ class ProjectIndex:
 
         for stmt in fn.node.body:
             visit(stmt)
+
+    # -- cross-process stitching (rpc edges) -------------------------------
+
+    @staticmethod
+    def _param_names(fn_node: ast.AST) -> List[str]:
+        a = fn_node.args
+        return [p.arg for p in
+                list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+
+    def _send_method(self, node: ast.Call, ctx) -> Optional[Tuple[str, bool]]:
+        """``(method, sync)`` when *node* is a protocol send carrying a
+        ``pb.<METHOD>`` constant (same vocabulary as R18's extraction).
+        ``sync`` is True only for the blocking request/reply primitive
+        (final attribute literally ``call`` — ``RpcClient.call`` blocks on
+        its reply); fire-and-forget / callback sends never wait."""
+        from ray_tpu.devtools import dataflow as _df
+        dotted = _dotted(node.func) or ""
+        leaf = dotted.rsplit(".", 1)[-1]
+        is_send = (isinstance(node.func, ast.Attribute)
+                   and node.func.attr in _df.SEND_ATTRS) or \
+            bool(_df._SENDISH_RE.search(leaf))
+        has_method_kw = any(kw.arg == "method" for kw in node.keywords)
+        if not (is_send or has_method_kw):
+            return None
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for sub in ast.walk(arg):
+                m = _df._pb_method(sub, ctx)
+                if m is not None:
+                    return m, leaf == "call"
+        return None
+
+    def _dispatch_arms(self, fn: FunctionInfo
+                       ) -> List[Tuple[str, ast.If]]:
+        """``(method, If-node)`` per dispatch arm when *fn* is an
+        RpcContext dispatcher.  Recognized only when the compared
+        expression provably originates from a context-ish parameter
+        (``ctx.method`` / ``env.method`` or a local assigned once from
+        it) — a sender helper that merely branches on its own ``method``
+        argument is NOT a dispatcher (under-approximation)."""
+        from ray_tpu.devtools import dataflow as _df
+        ctx_params = {p for p in self._param_names(fn.node)
+                      if p in ("ctx", "env") or p.endswith("_ctx")}
+        if not ctx_params:
+            return []
+
+        def from_ctx(e: ast.AST) -> bool:
+            return (isinstance(e, ast.Attribute) and e.attr == "method"
+                    and isinstance(e.value, ast.Name)
+                    and e.value.id in ctx_params)
+
+        meth_locals: Set[str] = set()
+        for node in _df.FunctionDataflow._walk_pruned(fn.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and from_ctx(node.value):
+                meth_locals.add(node.targets[0].id)
+
+        def is_method_expr(e: ast.AST) -> bool:
+            return from_ctx(e) or (isinstance(e, ast.Name)
+                                   and e.id in meth_locals)
+
+        arms: List[Tuple[str, ast.If]] = []
+        for node in _df.FunctionDataflow._walk_pruned(fn.node):
+            if not isinstance(node, ast.If) or \
+                    not isinstance(node.test, ast.Compare) or \
+                    len(node.test.ops) != 1 or \
+                    not is_method_expr(node.test.left):
+                continue
+            comp = node.test.comparators[0]
+            if isinstance(node.test.ops[0], ast.Eq):
+                m = _df._pb_method(comp, fn.ctx)
+                if m is not None:
+                    arms.append((m, node))
+            elif isinstance(node.test.ops[0], ast.In) and \
+                    isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+                for elt in comp.elts:
+                    m = _df._pb_method(elt, fn.ctx)
+                    if m is not None:
+                        arms.append((m, node))
+        return arms
+
+    def _file_stitch_facts(self, rel: str) -> dict:
+        """JSON-able per-file stitch facts, a pure function of that one
+        file's source (cacheable under its content hash): every protocol
+        send site and every dispatcher function."""
+        from ray_tpu.devtools import dataflow as _df
+        sends: List[list] = []
+        dispatchers: List[str] = []
+        ctx = self.ctx_of[rel]
+        for q, fn in self.functions.items():
+            if fn.ctx is not ctx or fn.synthetic:
+                continue
+            for node in _df.FunctionDataflow._walk_pruned(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                ms = self._send_method(node, ctx)
+                if ms is None:
+                    continue
+                site = fn.site_by_node.get(id(node))
+                held = list(site.locks_held) if site is not None else []
+                sends.append([q, node.lineno, ms[0], ms[1], held])
+            if self._dispatch_arms(fn):
+                dispatchers.append(q)
+        return {"sends": sends, "dispatchers": sorted(dispatchers)}
+
+    def _synthesize_arm(self, fn: FunctionInfo, method: str,
+                        if_node: ast.If) -> Optional[str]:
+        name = f"{fn.name}[{method}]"
+        owner = f"{fn.cls}." if fn.cls else ""
+        qname = f"{fn.module}:{owner}{name}"
+        if qname in self.functions:
+            return None              # duplicate arm for the same method
+        node = ast.FunctionDef(name=name, args=fn.node.args,
+                               body=list(if_node.body), decorator_list=[],
+                               returns=None, type_comment=None)
+        node.lineno = if_node.lineno
+        node.col_offset = if_node.col_offset
+        info = FunctionInfo(qname=qname, module=fn.module, cls=fn.cls,
+                            name=name, node=node, ctx=fn.ctx,
+                            synthetic="rpc-arm")
+        self.functions[qname] = info
+        self._analyze(info)
+        self.rpc_arms.setdefault(method, []).append(qname)
+        lo = min((s.lineno for s in if_node.body), default=if_node.lineno)
+        hi = max((getattr(s, "end_lineno", s.lineno) for s in if_node.body),
+                 default=if_node.lineno)
+        self._arm_spans[qname] = (fn.qname, lo, hi)
+        return qname
+
+    def _stitch_rpc(self, cached: Dict[str, dict]) -> None:
+        self._arm_spans: Dict[str, Tuple[str, int, int]] = {}
+        for rel in sorted(self.ctx_of):
+            facts = cached.get(rel)
+            if facts is not None:
+                self.stitch_hits += 1
+            else:
+                facts = self._file_stitch_facts(rel)
+            self.stitch_facts[rel] = facts
+        # pass 1: synthesize every dispatch arm (senders may live in
+        # files sorted before their dispatcher)
+        for rel in sorted(self.stitch_facts):
+            for dq in self.stitch_facts[rel]["dispatchers"]:
+                fn = self.functions.get(dq)
+                if fn is None or fn.synthetic:
+                    continue
+                for method, if_node in self._dispatch_arms(fn):
+                    self._synthesize_arm(fn, method, if_node)
+        # pass 2: every send site becomes an rpc edge to each arm that
+        # handles its method; a send written lexically inside an arm is
+        # attributed to that arm too, so per-method closures see it
+        for rel in sorted(self.stitch_facts):
+            for q, line, method, sync, held in \
+                    self.stitch_facts[rel]["sends"]:
+                fn = self.functions.get(q)
+                if fn is None:
+                    continue
+                targets = tuple(sorted(self.rpc_arms.get(method, ())))
+                holders = [(q, fn)]
+                for armq, (dispq, lo, hi) in self._arm_spans.items():
+                    if dispq == q and lo <= line <= hi:
+                        holders.append((armq, self.functions[armq]))
+                for hq, hfn in holders:
+                    for aq in targets:
+                        hfn.call_sites.append(CallSite(
+                            line=line, raw=f"rpc:{method}", target=aq,
+                            kind="rpc", locks_held=tuple(held)))
+                    self.rpc_sites.append(
+                        (hq, line, method, bool(sync), tuple(held), targets))
 
     # -- fixpoint helpers for the interprocedural rules --------------------
 

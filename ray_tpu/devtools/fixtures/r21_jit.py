@@ -1,0 +1,80 @@
+"""R21 fixture: jit compile-cache stability.
+
+Positive cases: ``loop_jit`` constructs inside a loop, ``per_call`` /
+``immediate`` construct-and-invoke per call, ``bad_static`` /
+``bad_shape`` / ``bad_decorated_call`` feed unhashable or
+shape-varying values to ``static_argnums`` positions, ``bad_donate``
+reads a donated buffer after the call, and ``bad_scalar`` routes a raw
+``len(...)`` into a jitted call.  Clean twins: the module-level
+``_CACHED`` construct, ``Model.__init__``'s attribute store,
+``Model.good``'s rebinding of the donated arg, and ``padded_scalar``
+bucketing through ``pad_items`` first.
+"""
+
+import functools
+
+import jax
+
+
+def pad_items(items, buckets):
+    return items
+
+
+def _impl(state, k):
+    return state
+
+
+_CACHED = jax.jit(_impl, static_argnums=(1,))
+
+
+def loop_jit(xs):
+    out = []
+    for x in xs:
+        f = jax.jit(_impl, static_argnums=(1,))
+        out.append(f(x, 1))
+    return out
+
+
+def per_call(x):
+    f = jax.jit(_impl, static_argnums=(1,))
+    return f(x, 1)
+
+
+def immediate(x):
+    return jax.jit(_impl, static_argnums=(1,))(x, 1)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def decorated_step(state, k):
+    return state
+
+
+def bad_decorated_call(state):
+    return decorated_step(state, {"a": 1})
+
+
+class Model:
+    def __init__(self):
+        self._step = jax.jit(_impl, static_argnums=(1,),
+                             donate_argnums=(0,))
+
+    def good(self, state):
+        state = self._step(state, 4)
+        return state
+
+    def bad_static(self, state):
+        return self._step(state, [1, 2])
+
+    def bad_shape(self, state, x):
+        return self._step(state, x.shape)
+
+    def bad_donate(self, state):
+        out = self._step(state, 4)
+        return out, state
+
+    def bad_scalar(self, state, items):
+        return self._step(state, len(items))
+
+    def padded_scalar(self, state, items):
+        items = pad_items(items, (8, 16))
+        return self._step(state, len(items))

@@ -120,6 +120,7 @@ import os
 import re
 import sys
 import tempfile
+import time
 import tokenize
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterable, Iterator, List, Optional, Set, Tuple
@@ -271,7 +272,7 @@ def _funcs_with_class(tree: ast.Module):
     def walk(node, cls):
         for child in ast.iter_child_nodes(node):
             if isinstance(child, ast.ClassDef):
-                walk(child, child.name)
+                yield from walk(child, child.name)
             elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 yield cls, child
                 yield from walk(child, cls)
@@ -978,6 +979,8 @@ def check_async_transitive(ctxs: List[FileContext],
     idx = engine.index(ctxs)
     direct: Dict[str, List[Tuple[int, Tuple[str, int, str]]]] = {}
     for q, fn in idx.functions.items():
+        if fn.synthetic:
+            continue              # arm statements belong to the dispatcher
         for line, desc in fn.blocking:
             direct.setdefault(q, []).append((line, (q, line, desc)))
     closure = idx.transitive_paths(direct, kinds=("call", "loop"))
@@ -1028,6 +1031,8 @@ def check_lock_order_graph(ctxs: List[FileContext],
     idx = engine.index(ctxs)
     direct: Dict[str, List[Tuple[int, str]]] = {}
     for q, fn in idx.functions.items():
+        if fn.synthetic:
+            continue              # arm statements belong to the dispatcher
         for lid, line, _held in fn.acquires:
             direct.setdefault(q, []).append((line, lid))
     closure = idx.transitive_paths(direct, kinds=("call",))
@@ -1036,6 +1041,8 @@ def check_lock_order_graph(ctxs: List[FileContext],
                                        bool]] = {}
     for q in sorted(idx.functions):
         fn = idx.functions[q]
+        if fn.synthetic:
+            continue
         for lid, line, held in fn.acquires:
             for h in held:
                 if h != lid:
@@ -1254,6 +1261,8 @@ def check_collective_divergence(ctxs: List[FileContext],
 
     for q in sorted(idx.functions):
         fn = idx.functions[q]
+        if fn.synthetic:
+            continue              # arm statements belong to the dispatcher
         walk_stmts(fn, list(fn.node.body), None)
     for key in sorted(findings):
         yield findings[key]
@@ -1578,6 +1587,8 @@ def check_resource_leak(ctxs: List[FileContext],
     idx = engine.index(ctxs)
     for q in sorted(idx.functions):
         fn = idx.functions[q]
+        if fn.synthetic:
+            continue              # arm statements belong to the dispatcher
         for fact, exit_state in _df.resource_leaks(fn, idx):
             if fn.ctx.allowed(fact.line, "R16", "resource-leak"):
                 continue
@@ -1618,7 +1629,7 @@ def check_deadline_drop(ctxs: List[FileContext],
     idx = engine.index(ctxs)
     direct: Dict[str, List[Tuple[int, Tuple[str, int, str]]]] = {}
     for q, fn in idx.functions.items():
-        if fn.is_async:
+        if fn.is_async or fn.synthetic:
             continue              # event-loop blocking is R1/R10's domain
         for line, desc in _df.naked_blocking(fn.node, fn.ctx):
             direct.setdefault(q, []).append((line, (q, line, desc)))
@@ -1626,7 +1637,7 @@ def check_deadline_drop(ctxs: List[FileContext],
     seen: Set[Tuple[str, int]] = set()
     for q in sorted(idx.functions):
         root = idx.functions[q]
-        if root.is_async:
+        if root.is_async or root.synthetic:
             continue
         params = _df.deadline_params(root.node)
         scope = (f"'{root.name}({', '.join(params)})'" if params else None)
@@ -1729,7 +1740,7 @@ def check_protocol_conformance(ctxs: List[FileContext],
 
     for q in sorted(idx.functions):
         fn = idx.functions[q]
-        if fn.is_async:
+        if fn.is_async or fn.synthetic:
             continue
         recv = _df.reply_candidates(fn)
         if recv is None:
@@ -1787,6 +1798,425 @@ def check_protocol_conformance(ctxs: List[FileContext],
 
 
 # --------------------------------------------------------------------------
+# R19: distributed deadlock — blocking-wait cycles over the stitched graph
+
+@project_rule("R19", "distributed-deadlock")
+def check_distributed_deadlock(ctxs: List[FileContext],
+                               engine) -> Iterator[Finding]:
+    """Deadlocks that only exist once the process boundary is crossed,
+    found on the cross-process edges the stitch pass adds (rpc ``kind``
+    call sites into synthesized dispatch arms).  Two arms: (a) a
+    *wait cycle* — handling method M can issue a synchronous RPC whose
+    handler (transitively) issues a synchronous RPC back into M; with
+    the request/reply slots saturated in both directions, two daemons
+    wait on each other forever; (b) *lock held across RPC* — a thread
+    holds lock L while blocking on a synchronous send of M, and M's
+    handler can re-acquire the same lock node L: two symmetric daemons
+    doing this to each other is AB/BA across the wire.  Both arms
+    report in lockwatch's ``CYCLE (site-order)`` format over
+    ``rpc:<METHOD>`` / lock sites, so a static finding and a runtime
+    lockwatch report of the same shape correlate.  Fire-and-forget
+    sends (``call_async``/``send_oneway``/``push``) never wait and are
+    never part of a cycle here."""
+    from ray_tpu.devtools import lockwatch
+    idx = engine.index(ctxs)
+    # facts: synchronous sends, keyed for the method-level closure
+    direct: Dict[str, List[Tuple[int, Tuple[str, str, int]]]] = {}
+    for q, line, m, sync, _held, _targets in idx.rpc_sites:
+        if sync:
+            direct.setdefault(q, []).append((line, (m, q, line)))
+    closure = idx.transitive_paths(direct, kinds=("call",))
+
+    # (a) method graph: rpc:M -> rpc:M2 when an arm handling M can reach
+    # a synchronous send of M2 over ordinary call edges
+    out_sends: Dict[str, List[Tuple[str, Tuple[str, int],
+                                    List[Tuple[str, int]]]]] = {}
+    succ: Dict[str, List[str]] = {}
+    for m in sorted(idx.rpc_arms):
+        node = f"rpc:{m}"
+        outs: Set[str] = set()
+        for aq in idx.rpc_arms[m]:
+            for key, path in sorted(closure.get(aq, {}).items()):
+                m2, sq, sline = key
+                outs.add(f"rpc:{m2}")
+                out_sends.setdefault(node, []).append(
+                    (f"rpc:{m2}", (sq, sline), path))
+        succ[node] = sorted(outs)
+    for comp in lockwatch._sccs(sorted(succ), succ):
+        if len(comp) < 2 and comp[0] not in succ.get(comp[0], ()):
+            continue
+        in_comp = set(comp)
+        anchor = None
+        for node in sorted(in_comp):
+            for to, (sq, sline), path in sorted(out_sends.get(node, [])):
+                if to not in in_comp:
+                    continue
+                site_fn = idx.functions[sq]
+                if site_fn.ctx.allowed(sline, "R19", "distributed-deadlock"):
+                    continue
+                anchor = (node, to, site_fn, sline, path)
+                break
+            if anchor:
+                break
+        if anchor is None:
+            continue              # every edge carries a justification
+        node, to, site_fn, sline, path = anchor
+        chain = " -> ".join(
+            f"{idx.functions[s].name}@{ln}" for s, ln in path)
+        yield Finding(
+            "R19", "distributed-deadlock", site_fn.ctx.relpath, sline,
+            f"static {lockwatch.format_cycle('site-order', sorted(in_comp))}"
+            f"; handling {node[4:]} can synchronously send {to[4:]} here "
+            f"(witness: {chain}) — with request slots saturated both ways "
+            "the two daemons wait on each other forever; make one hop "
+            "asynchronous or justify with "
+            "'# raylint: allow(distributed-deadlock) <why>'")
+
+    # (b) lock held across a synchronous send whose handler can
+    # re-acquire the same lock node
+    acq: Dict[str, List[Tuple[int, str]]] = {}
+    for q, fn in idx.functions.items():
+        for lid, line, _held in fn.acquires:
+            acq.setdefault(q, []).append((line, lid))
+    acq_closure = idx.transitive_paths(acq, kinds=("call",))
+    seen: Set[Tuple[str, int, str, str]] = set()
+    for q, line, m, sync, held, targets in sorted(idx.rpc_sites):
+        if not sync or not held:
+            continue
+        fn = idx.functions[q]
+        for aq in targets:
+            reacquired = set(acq_closure.get(aq, {}))
+            for lid in sorted(set(held) & reacquired):
+                key = (fn.ctx.relpath, line, lid, m)
+                if key in seen:
+                    continue
+                seen.add(key)
+                if fn.ctx.allowed(line, "R19", "distributed-deadlock"):
+                    continue
+                lpath = acq_closure[aq][lid]
+                chain = " -> ".join(
+                    f"{idx.functions[s].name}@{ln}" for s, ln in lpath)
+                yield Finding(
+                    "R19", "distributed-deadlock", fn.ctx.relpath, line,
+                    f"static "
+                    f"{lockwatch.format_cycle('site-order', sorted([lid, 'rpc:' + m]))}"
+                    f"; '{fn.name}' holds {lid} while synchronously "
+                    f"sending {m}, and the {m} handler can re-acquire "
+                    f"{lid} ({chain}) — two peers doing this to each "
+                    "other is AB/BA across the wire (lockwatch reports "
+                    "the same cycle at runtime under RAY_TPU_LOCKWATCH); "
+                    "release the lock before the call or justify with "
+                    "'# raylint: allow(distributed-deadlock) <why>'")
+
+
+# --------------------------------------------------------------------------
+# R20: handler stall — unbounded blocking reachable from an RPC handler
+
+@project_rule("R20", "handler-stall")
+def check_handler_stall(ctxs: List[FileContext],
+                        engine) -> Iterator[Finding]:
+    """R17's naked-blocking catalog (bare ``.wait()`` / ``.join()`` /
+    ``.result()`` / lock ``.acquire()`` / queue ``.get()``), rooted not
+    at deadline scopes but at RPC dispatch arms: a handler that blocks
+    without a bound stalls a dispatch-pool thread — and with the pool
+    saturated, frame dispatch for *every* caller of that server.  A
+    witness function on the path that takes a ``deadline``/``timeout``
+    parameter or arms a ``BackoffPolicy`` budget bounds the wait (and
+    puts it in R17's jurisdiction), so those paths are suppressed
+    here."""
+    idx = engine.index(ctxs)
+    direct: Dict[str, List[Tuple[int, Tuple[str, int, str]]]] = {}
+    for q, fn in idx.functions.items():
+        # synthetic arms keep their facts: a bare wait written lexically
+        # inside a dispatch arm must anchor under that arm's qname
+        if fn.is_async:
+            continue
+        for line, desc in _df.naked_blocking(fn.node, fn.ctx):
+            direct.setdefault(q, []).append((line, (q, line, desc)))
+    closure = idx.transitive_paths(direct, kinds=("call",))
+    seen: Set[Tuple[str, int]] = set()
+    for m in sorted(idx.rpc_arms):
+        for aq in idx.rpc_arms[m]:
+            for key, path in sorted(closure.get(aq, {}).items()):
+                site_q, site_line, desc = key
+                site_fn = idx.functions[site_q]
+                if (site_fn.ctx.relpath, site_line) in seen:
+                    continue
+                seen.add((site_fn.ctx.relpath, site_line))
+                if any(_df.deadline_params(idx.functions[s].node)
+                       or _df.arms_backoff_budget(idx.functions[s].node)
+                       is not None for s, _ln in path):
+                    continue      # budget-scoped: bounded, and R17's job
+                if site_fn.ctx.allowed(site_line, "R20", "handler-stall"):
+                    continue
+                chain = " -> ".join(
+                    f"{idx.functions[s].name}@{ln}" for s, ln in path)
+                yield Finding(
+                    "R20", "handler-stall", site_fn.ctx.relpath, site_line,
+                    f"{desc} blocks with no bound and is reachable from "
+                    f"the {m} dispatch arm (witness: {chain}) — a stalled "
+                    "handler pins a dispatch thread and, pool exhausted, "
+                    "stalls every caller of this server; bound the wait "
+                    "or justify with '# raylint: allow(handler-stall) "
+                    "<why>'")
+
+
+# --------------------------------------------------------------------------
+# R21: jit stability — recompile hazards at jit/pjit/shard_map sites
+
+_R21_CTORS = {"jit", "pjit", "shard_map"}
+_R21_CACHED_DECOS = {"functools.lru_cache", "lru_cache",
+                     "functools.cache", "cache"}
+
+
+def _jit_ctor_name(node: ast.Call, ctx: FileContext) -> Optional[str]:
+    """The ctor leaf ("jit"/"pjit"/"shard_map") when *node* constructs a
+    compiled callable, else None.  Requires a jax-rooted dotted name or
+    an import provably from jax, so a local helper named ``jit`` does
+    not trip the rule."""
+    dn = _dotted(node.func) or ""
+    leaf = dn.rsplit(".", 1)[-1]
+    if leaf not in _R21_CTORS:
+        return None
+    head = dn.split(".", 1)[0]
+    origin = ctx.import_origin.get(head, "")
+    # jax proper or a jax shim module (jax_compat re-exports shard_map)
+    if dn.startswith("jax.") or "jax" in origin:
+        return leaf
+    return None
+
+
+def _jit_argnum_positions(call: ast.Call, kwname: str) -> Tuple[int, ...]:
+    """Literal int / tuple-of-int ``static_argnums=``/``donate_argnums=``
+    positions on a jit construction, else () (dynamic specs are not
+    audited)."""
+    for kw in call.keywords:
+        if kw.arg != kwname:
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for e in v.elts:
+                if not (isinstance(e, ast.Constant)
+                        and isinstance(e.value, int)):
+                    return ()
+                out.append(e.value)
+            return tuple(out)
+    return ()
+
+
+def _jit_registry(ctx: FileContext) -> Dict[str, Tuple[Tuple[int, ...],
+                                                       Tuple[int, ...], int]]:
+    """Callable text -> (static_argnums, donate_argnums, def line) for
+    jit-wrapped callables this file constructs and later calls by name:
+    ``X = jax.jit(f, ...)`` assignments (Name or ``self.X`` targets) and
+    ``@jax.jit`` / ``@functools.partial(jax.jit, ...)`` decorated defs."""
+    reg: Dict[str, Tuple[Tuple[int, ...], Tuple[int, ...], int]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.value, ast.Call) and \
+                _jit_ctor_name(node.value, ctx):
+            tgt = _dotted(node.targets[0])
+            if tgt:
+                reg[tgt] = (_jit_argnum_positions(node.value,
+                                                  "static_argnums"),
+                            _jit_argnum_positions(node.value,
+                                                  "donate_argnums"),
+                            node.lineno)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                dn = _dotted(target)
+                if dn in _JIT_NAMES and isinstance(dec, ast.Call):
+                    reg[node.name] = (
+                        _jit_argnum_positions(dec, "static_argnums"),
+                        _jit_argnum_positions(dec, "donate_argnums"),
+                        node.lineno)
+                elif dn in ("functools.partial", "partial") and \
+                        isinstance(dec, ast.Call) and dec.args and \
+                        _dotted(dec.args[0]) in _JIT_NAMES:
+                    reg[node.name] = (
+                        _jit_argnum_positions(dec, "static_argnums"),
+                        _jit_argnum_positions(dec, "donate_argnums"),
+                        node.lineno)
+    return reg
+
+
+def _r21_msg(what: str) -> str:
+    return (what + " — every distinct trace recompiles ("
+            "compile time is a first-order cost at scale); "
+            "justify with '# raylint: allow(jit-stability) <why>'")
+
+
+@rule("R21", "jit-stability")
+def check_jit_stability(ctx: FileContext) -> Iterator[Finding]:
+    """Recompile and stale-buffer hazards at ``jax.jit`` / ``pjit`` /
+    ``shard_map`` sites: (a) constructing a compiled callable inside a
+    loop, or (b) per call — built and invoked within one function
+    without being stored on an object, returned to a caching caller, or
+    memoized — throws away the compile cache every iteration/call; (c)
+    a Python-scalar ``len(...)`` fed straight into a jitted call varies
+    the trace with batch size unless the caller routes shapes through
+    ``pad_items`` (the blessed pad-to-bucket allowlist); (d) a
+    ``static_argnums`` position fed a list/dict/set (unhashable → a
+    ``TypeError`` at call time) or a raw ``.shape`` (a new trace per
+    shape); (e) a buffer passed at a ``donate_argnums`` position is
+    dead after the call — reading it later without rebinding returns
+    garbage or raises.  Dynamic constructs the checks cannot prove
+    degrade to silence."""
+    registry = _jit_registry(ctx)
+
+    def flag(line: int, what: str) -> Optional[Finding]:
+        if ctx.allowed(line, "R21", "jit-stability"):
+            return None
+        return Finding("R21", "jit-stability", ctx.relpath, line,
+                       _r21_msg(what))
+
+    seen: Set[Tuple[int, str]] = set()
+
+    def emit(line: int, check: str, what: str) -> Iterator[Finding]:
+        if (line, check) in seen:
+            return
+        seen.add((line, check))
+        f = flag(line, what)
+        if f:
+            yield f
+
+    # (a) jit construction inside a loop — any scope, module level too
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.For, ast.While)):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                leaf = _jit_ctor_name(sub, ctx)
+                if leaf:
+                    yield from emit(
+                        sub.lineno, "loop",
+                        f"'{leaf}' constructed inside a loop (line "
+                        f"{node.lineno}): a fresh callable per iteration "
+                        "never hits the compile cache; hoist it out")
+
+    for cls, fn in _funcs_with_class(ctx.tree):
+        cached = any(_dotted(d.func if isinstance(d, ast.Call) else d)
+                     in _R21_CACHED_DECOS for d in fn.decorator_list)
+        calls_pad = any(isinstance(n, ast.Call)
+                        and (_dotted(n.func) or "").rsplit(".", 1)[-1]
+                        == "pad_items" for n in _walk_pruned(fn))
+
+        # (b) constructed and invoked per call of this function
+        if fn.name != "__init__" and not cached:
+            local_ctors: Dict[str, Tuple[int, str]] = {}
+            returned: Set[str] = set()
+            called: Set[str] = set()
+            for n in _walk_pruned(fn):
+                if isinstance(n, ast.Call):
+                    inner = n.func
+                    if isinstance(inner, ast.Call):
+                        ileaf = _jit_ctor_name(inner, ctx)
+                        if ileaf:
+                            yield from emit(
+                                inner.lineno, "per-call",
+                                f"'{ileaf}(...)' built and invoked in one "
+                                f"expression inside '{fn.name}': every "
+                                "call re-traces; build once and reuse")
+                    if isinstance(n.func, ast.Name):
+                        called.add(n.func.id)
+                elif isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                        and isinstance(n.targets[0], ast.Name) \
+                        and isinstance(n.value, ast.Call):
+                    leaf = _jit_ctor_name(n.value, ctx)
+                    if leaf:
+                        local_ctors[n.targets[0].id] = (n.value.lineno, leaf)
+                elif isinstance(n, ast.Return) and n.value is not None:
+                    if isinstance(n.value, ast.Name):
+                        returned.add(n.value.id)
+            for name, (line, leaf) in sorted(local_ctors.items()):
+                if name in called and name not in returned:
+                    yield from emit(
+                        line, "per-call",
+                        f"'{leaf}' result bound to local '{name}' and "
+                        f"called inside '{fn.name}': the compiled "
+                        "callable dies with the frame, so every call "
+                        "re-traces; cache it (module level, an "
+                        "attribute, or functools.lru_cache)")
+
+        # (c)-(e) at call sites of registry entries
+        for n in _walk_pruned(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            tgt = _dotted(n.func)
+            if tgt is None or tgt not in registry:
+                continue
+            static, donate, _dline = registry[tgt]
+            if not calls_pad:
+                for arg in n.args:
+                    hit = next(
+                        (s for s in ast.walk(arg)
+                         if isinstance(s, ast.Call)
+                         and _dotted(s.func) == "len"), None)
+                    if hit is not None:
+                        yield from emit(
+                            n.lineno, "scalar",
+                            f"Python scalar 'len(...)' flows into jitted "
+                            f"'{tgt}': the trace re-specializes per batch "
+                            "size; bucket shapes with pad_items first")
+                        break
+            for pos in static:
+                if pos >= len(n.args):
+                    continue
+                arg = n.args[pos]
+                if isinstance(arg, (ast.List, ast.Dict, ast.Set,
+                                    ast.ListComp, ast.DictComp,
+                                    ast.SetComp)):
+                    yield from emit(
+                        n.lineno, "static",
+                        f"static_argnums position {pos} of '{tgt}' is fed "
+                        "an unhashable literal: jit static args must hash "
+                        "(TypeError at call time); pass a tuple or mark "
+                        "the arg dynamic")
+                elif isinstance(arg, ast.Attribute) and arg.attr == "shape":
+                    yield from emit(
+                        n.lineno, "static",
+                        f"static_argnums position {pos} of '{tgt}' is fed "
+                        "a raw '.shape': a new trace per shape defeats "
+                        "the cache; bucket the shape or pass it dynamic")
+            for pos in donate:
+                if pos >= len(n.args):
+                    continue
+                dtxt = _dotted(n.args[pos])
+                if dtxt is None:
+                    continue
+                # the assignment consuming the call's result is the
+                # canonical rebind (`params = update(params, ...)`), so
+                # Stores count from the call line itself; Loads only
+                # after the call expression ends
+                call_end = getattr(n, "end_lineno", n.lineno)
+                rebound = False
+                used_line = None
+                for m in _walk_pruned(fn):
+                    mline = getattr(m, "lineno", 0)
+                    if mline < n.lineno or \
+                            not isinstance(m, (ast.Name, ast.Attribute)) \
+                            or _dotted(m) != dtxt:
+                        continue
+                    if isinstance(m.ctx, ast.Store):
+                        rebound = True
+                    elif isinstance(m.ctx, ast.Load) and \
+                            mline > call_end and used_line is None:
+                        used_line = mline
+                if used_line is not None and not rebound:
+                    yield from emit(
+                        used_line, "donate",
+                        f"'{dtxt}' was donated to '{tgt}' at line "
+                        f"{n.lineno} (donate_argnums position {pos}) and "
+                        "is read here without being rebound: the buffer "
+                        "was surrendered to XLA and may alias the "
+                        "output; use the returned value instead")
+
+
+# --------------------------------------------------------------------------
 # engine
 
 class LintEngine:
@@ -1815,14 +2245,24 @@ class LintEngine:
         self.cache_enabled = cache and only_rules is None
         # (file hits, files total, project-level hit) after run()
         self.cache_stats: Optional[Tuple[int, int, bool]] = None
+        # (stitch-fact replay hits, files stitched) after an index build —
+        # None when no project rule forced the graph
+        self.stitch_stats: Optional[Tuple[int, int]] = None
+        # wall time per project rule id (plus "graph" for the index build)
+        self.rule_times: Dict[str, float] = {}
         self.errors: List[str] = []
         self._index: Optional[_cg.ProjectIndex] = None
+        # hash-validated per-file stitch facts replayed from the cache
+        self._stitch_cache: Dict[str, dict] = {}
 
     def index(self, ctxs: List[FileContext]) -> _cg.ProjectIndex:
         """Whole-program symbol table / call graph, built once per run and
-        shared by every interprocedural rule (R10-R12)."""
+        shared by every interprocedural rule (R10-R12, R19-R20)."""
         if self._index is None:
-            self._index = _cg.ProjectIndex(ctxs)
+            self._index = _cg.ProjectIndex(
+                ctxs, stitch_facts=self._stitch_cache)
+            self.stitch_stats = (self._index.stitch_hits,
+                                 len(self._index.stitch_facts))
         return self._index
 
     @staticmethod
@@ -1989,10 +2429,25 @@ class LintEngine:
                     mine.extend(fn(ctx))
             file_findings.extend(mine)
             per_file[rel] = [f.to_json() for f in mine]
+        # replay cross-process stitch facts for unchanged files: the graph
+        # is still rebuilt (ast node identity can't be cached), but the
+        # per-file send/dispatcher scans — the expensive half — are not
+        cached_stitch = (cache.get("stitch") if cache is not None else
+                         None) or {}
+        self._stitch_cache = {
+            rel: ent.get("facts") or {"sends": [], "dispatchers": []}
+            for rel, ent in cached_stitch.items()
+            if rel in hashes and ent.get("hash") == hashes[rel]}
         proj_findings: List[Finding] = []
+        if self.only_rules is None:
+            t0 = time.perf_counter()
+            self.index(ctxs)
+            self.rule_times["graph"] = time.perf_counter() - t0
         for rule_id, tag, fn in PROJECT_RULES:
             if self._want(rule_id, tag):
+                t0 = time.perf_counter()
                 proj_findings.extend(fn(ctxs, self))
+                self.rule_times[rule_id] = time.perf_counter() - t0
         if cache is not None:
             self.cache_stats = (hits, len(sources), False)
             # merge, don't replace: entries for files outside this run's
@@ -2002,9 +2457,16 @@ class LintEngine:
             merged.update({rel: {"hash": hashes[rel],
                                  "findings": per_file[rel]}
                            for rel in per_file})
+            stitch = dict(cached_stitch)
+            if self._index is not None:
+                stitch.update({rel: {"hash": hashes[rel], "facts": facts}
+                               for rel, facts in
+                               self._index.stitch_facts.items()
+                               if rel in hashes})
             self._cache_store({
                 "salt": self._engine_salt(),
                 "files": merged,
+                "stitch": stitch,
                 "project": {
                     "tree_key": tree_key,
                     "findings": [f.to_json()
@@ -2025,7 +2487,8 @@ def rule_listing() -> List[dict]:
         for rule_id, tag, fn in reg:
             doc = " ".join((fn.__doc__ or "").strip().split())
             out.append({"id": rule_id, "tag": tag, "kind": kind,
-                        "summary": doc.split(". ")[0][:240]})
+                        "summary": doc.split(". ")[0][:240],
+                        "doc": doc})
     out.sort(key=lambda r: int(r["id"][1:]))
     return out
 
@@ -2038,6 +2501,10 @@ def sarif_log(findings: List[Finding]) -> dict:
         "id": r["id"],
         "name": r["tag"],
         "shortDescription": {"text": r["summary"]},
+        "fullDescription": {"text": r["doc"]},
+        # rule table anchor in the repo docs — consumers resolve it
+        # against the checkout the log was produced from
+        "helpUri": f"ARCHITECTURE.md#{r['id'].lower()}-{r['tag']}",
     } for r in rule_listing()]
     index = {r["id"]: i for i, r in enumerate(rules)}
     results = [{
@@ -2182,8 +2649,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     findings = engine.run()
     if engine.cache_stats is not None:
         hits, total, warm = engine.cache_stats
+        if warm:
+            stitch = "stitch replayed"
+        elif engine.stitch_stats is not None:
+            stitch = "stitch {}/{}".format(*engine.stitch_stats)
+        else:
+            stitch = "stitch skipped"
         print(f"raylint-cache: {hits}/{total} file hits, "
-              f"project {'hit' if warm else 'miss'}", file=sys.stderr)
+              f"project {'hit' if warm else 'miss'}, {stitch}",
+              file=sys.stderr)
+    if engine.rule_times:
+        total_t = sum(engine.rule_times.values())
+        parts = " ".join(f"{k} {v:.2f}s" for k, v in
+                         sorted(engine.rule_times.items(),
+                                key=lambda kv: -kv[1]))
+        print(f"raylint-times: total {total_t:.2f}s {parts}",
+              file=sys.stderr)
+        if total_t > 1.0:
+            for k, v in sorted(engine.rule_times.items()):
+                if k != "graph" and v > 0.3 * total_t:
+                    print(f"raylint-times: WARNING {k} took "
+                          f"{v:.2f}s ({v / total_t:.0%} of project-rule "
+                          "time)", file=sys.stderr)
 
     if args.write_baseline:
         with open(args.write_baseline, "w", encoding="utf-8") as f:

@@ -275,6 +275,44 @@ def uninstall() -> None:
     atexit.unregister(_exit_report)
 
 
+# -- RPC boundary pseudo-sites ---------------------------------------------
+#
+# Runtime counterpart to raylint R19's lock-held-across-RPC arm.  A
+# synchronous RPC wait and a handler execution are modeled as pseudo-lock
+# sites named ``rpc:<METHOD>``: a wrapped lock held across a blocking
+# ``call()`` records the order edge ``lock-site -> rpc:M``, and a lock
+# the M handler takes while running records ``rpc:M -> lock-site`` — two
+# peers doing both close a ``CYCLE (site-order)`` over exactly the sites
+# R19 names statically, so one fix/allow covers both reports.
+
+def rpc_client_wait(site: str) -> None:
+    """This thread is about to block on a synchronous RPC (*site* is
+    ``rpc:<METHOD>``); order every currently-held wrapped lock before it."""
+    held = _held_stack()
+    if not held:
+        return
+    with _graph_lock:
+        for other, _, _ in held:
+            if other._site != site:
+                key = (other._site, site)
+                _edges[key] = _edges.get(key, 0) + 1
+                _edge_threads.setdefault(
+                    key, threading.current_thread().name)
+
+
+def rpc_handler_enter(site: str) -> "_LockProxy":
+    """A handler for *site* (``rpc:<METHOD>``) starts on this thread:
+    push a pseudo-lock so locks it acquires order after the method.
+    Returns a token for :func:`rpc_handler_exit`."""
+    proxy = _LockProxy((_orig_lock or _thread.allocate_lock)(), site)
+    _note_acquire(proxy)
+    return proxy
+
+
+def rpc_handler_exit(token: "_LockProxy") -> None:
+    _note_release(token, full=True)
+
+
 def reset() -> None:
     """Clear all recorded observations (keeps installation state)."""
     global _wrapped_count

@@ -9,6 +9,7 @@ residual path.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable
 
 import jax
@@ -17,18 +18,12 @@ from ray_tpu._private.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def moe_apply(x: jax.Array, router_weights: jax.Array, expert_params: Any,
-              expert_fn: Callable, mesh: Mesh, axis: str = "expert",
-              capacity_factor: float = 1.25) -> jax.Array:
-    """x: [tokens, d_model] (replicated over ``axis``); router_weights:
-    [d_model, n_experts]; expert_params leaves have leading dim n_experts
-    (sharded over ``axis``). Returns [tokens, d_model]."""
-    n_exp_total = router_weights.shape[-1]
-    n_shards = mesh.shape[axis]
-    if n_exp_total % n_shards != 0:
-        raise ValueError(f"{n_exp_total} experts not divisible over "
-                         f"{n_shards} expert shards")
-    exp_per_shard = n_exp_total // n_shards
+@functools.lru_cache(maxsize=128)
+def _moe_sharded(expert_fn: Callable, mesh: Mesh, axis: str,
+                 n_exp_total: int, n_shards: int, exp_per_shard: int,
+                 capacity_factor: float) -> Callable:
+    """shard_map'd MoE dispatch, memoized on its statics so repeat calls
+    with the same mesh/routing config reuse one compiled callable."""
 
     def per_device(x_loc, rw, params):
         tokens, d = x_loc.shape
@@ -69,7 +64,22 @@ def moe_apply(x: jax.Array, router_weights: jax.Array, expert_params: Any,
         y = jnp.where(keep[:, None], y, 0.0)
         return x_loc + gate_val[:, None] * y  # residual + gated expert out
 
-    fn = shard_map(per_device, mesh=mesh,
-                   in_specs=(P(), P(), P(axis)),
-                   out_specs=P(), check_vma=False)
+    return shard_map(per_device, mesh=mesh,
+                     in_specs=(P(), P(), P(axis)),
+                     out_specs=P(), check_vma=False)
+
+
+def moe_apply(x: jax.Array, router_weights: jax.Array, expert_params: Any,
+              expert_fn: Callable, mesh: Mesh, axis: str = "expert",
+              capacity_factor: float = 1.25) -> jax.Array:
+    """x: [tokens, d_model] (replicated over ``axis``); router_weights:
+    [d_model, n_experts]; expert_params leaves have leading dim n_experts
+    (sharded over ``axis``). Returns [tokens, d_model]."""
+    n_exp_total = router_weights.shape[-1]
+    n_shards = mesh.shape[axis]
+    if n_exp_total % n_shards != 0:
+        raise ValueError(f"{n_exp_total} experts not divisible over "
+                         f"{n_shards} expert shards")
+    fn = _moe_sharded(expert_fn, mesh, axis, n_exp_total, n_shards,
+                      n_exp_total // n_shards, capacity_factor)
     return fn(x, router_weights, expert_params)

@@ -15,6 +15,7 @@ Capability net-new vs the reference (SURVEY §2.5: no PP anywhere).
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Optional
 
 import jax
@@ -23,24 +24,12 @@ from ray_tpu._private.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jax.Array,
-                   mesh: Mesh, num_microbatches: int,
-                   axis: str = "pipe",
-                   data_axis: Optional[str] = "data") -> jax.Array:
-    """Run ``stage_fn`` as a pipeline over mesh axis ``axis``.
-
-    stage_fn(params_for_one_stage, activation[mb, ...]) -> activation
-    stage_params: pytree whose leaves have leading dim = n_stages (sharded
-        over ``axis``).
-    x: [batch, ...] input (batch optionally sharded over ``data_axis``).
-    Returns [batch, ...] output with the same sharding as the input batch.
-    """
-    n_stages = mesh.shape[axis]
-    if n_stages == 1:
-        return stage_fn(jax.tree.map(lambda p: p[0], stage_params), x)
-
-    use_dp = (data_axis is not None and data_axis in mesh.axis_names
-              and mesh.shape[data_axis] > 1)
+@functools.lru_cache(maxsize=128)
+def _pipeline_sharded(stage_fn: Callable, mesh: Mesh, axis: str,
+                      n_stages: int, num_microbatches: int,
+                      batch_part: Optional[str]) -> Callable:
+    """shard_map'd GPipe schedule, memoized on its statics so repeat calls
+    with the same mesh/stage config reuse one compiled callable."""
 
     def per_device(params, x_local):
         params = jax.tree.map(lambda p: p[0], params)  # this stage's slice
@@ -86,9 +75,31 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jax.Array,
             axis)
         return out.reshape((local_batch,) + x_local.shape[1:])
 
-    x_spec = P(data_axis) if use_dp else P()
-    fn = shard_map(per_device, mesh=mesh, in_specs=(P(axis), x_spec),
-                   out_specs=x_spec, check_vma=False)
+    x_spec = P(batch_part) if batch_part else P()
+    return shard_map(per_device, mesh=mesh, in_specs=(P(axis), x_spec),
+                     out_specs=x_spec, check_vma=False)
+
+
+def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jax.Array,
+                   mesh: Mesh, num_microbatches: int,
+                   axis: str = "pipe",
+                   data_axis: Optional[str] = "data") -> jax.Array:
+    """Run ``stage_fn`` as a pipeline over mesh axis ``axis``.
+
+    stage_fn(params_for_one_stage, activation[mb, ...]) -> activation
+    stage_params: pytree whose leaves have leading dim = n_stages (sharded
+        over ``axis``).
+    x: [batch, ...] input (batch optionally sharded over ``data_axis``).
+    Returns [batch, ...] output with the same sharding as the input batch.
+    """
+    n_stages = mesh.shape[axis]
+    if n_stages == 1:
+        return stage_fn(jax.tree.map(lambda p: p[0], stage_params), x)
+
+    use_dp = (data_axis is not None and data_axis in mesh.axis_names
+              and mesh.shape[data_axis] > 1)
+    fn = _pipeline_sharded(stage_fn, mesh, axis, n_stages,
+                           num_microbatches, data_axis if use_dp else None)
     return fn(stage_params, x)
 
 

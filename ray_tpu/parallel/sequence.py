@@ -11,6 +11,7 @@ pluggable — the jnp einsum path compiles everywhere; the Pallas flash kernel
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Optional
 
 import jax
@@ -40,33 +41,11 @@ def _block_attn_update(q, k, v, m, l, acc, mask, scale):
     return m_new, l_new, acc_new
 
 
-def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
-                   axis: str = "seq", causal: bool = True,
-                   scale: Optional[float] = None,
-                   data_axis: Optional[str] = "data") -> jax.Array:
-    """Attention over sequence sharded on ``axis``.
-
-    q, k, v: [batch, seqlen, heads, head_dim], seqlen sharded over ``axis``
-    (and batch optionally over ``data_axis``). Returns same-sharded output.
-    """
-    scale = scale if scale is not None else q.shape[-1] ** -0.5
-    n_shards = mesh.shape[axis]
-    use_dp = (data_axis is not None and data_axis in mesh.axis_names
-              and mesh.shape[data_axis] > 1)
-    batch_part = data_axis if use_dp else None
-
-    if n_shards == 1:
-        L = q.shape[1]
-        mask = (jnp.tril(jnp.ones((L, L), bool)) if causal else None)
-        m = jnp.full(q.shape[:1] + (q.shape[2], q.shape[1]), _NEG_INF,
-                     dtype=jnp.float32)
-        l = jnp.zeros_like(m)
-        acc = jnp.zeros(q.shape, jnp.float32)
-        m, l, acc = _block_attn_update(
-            q.astype(jnp.float32), k.astype(jnp.float32),
-            v.astype(jnp.float32), m, l, acc, mask, scale)
-        out = acc / jnp.maximum(l, 1e-20)[..., None].transpose(0, 2, 1, 3)
-        return out.astype(q.dtype)
+@functools.lru_cache(maxsize=128)
+def _ring_sharded(mesh: Mesh, axis: str, n_shards: int, causal: bool,
+                  scale: float, batch_part: Optional[str]) -> Callable:
+    """shard_map'd ring-attention step, memoized on its statics so repeat
+    calls with the same mesh/config reuse one compiled callable."""
 
     def per_device(q_loc, k_loc, v_loc):
         my = jax.lax.axis_index(axis)
@@ -104,6 +83,38 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
         return out.astype(q_loc.dtype)
 
     spec = P(batch_part, axis, None, None)
-    fn = shard_map(per_device, mesh=mesh, in_specs=(spec, spec, spec),
-                   out_specs=spec, check_vma=False)
+    return shard_map(per_device, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+                   axis: str = "seq", causal: bool = True,
+                   scale: Optional[float] = None,
+                   data_axis: Optional[str] = "data") -> jax.Array:
+    """Attention over sequence sharded on ``axis``.
+
+    q, k, v: [batch, seqlen, heads, head_dim], seqlen sharded over ``axis``
+    (and batch optionally over ``data_axis``). Returns same-sharded output.
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    n_shards = mesh.shape[axis]
+    use_dp = (data_axis is not None and data_axis in mesh.axis_names
+              and mesh.shape[data_axis] > 1)
+    batch_part = data_axis if use_dp else None
+
+    if n_shards == 1:
+        L = q.shape[1]
+        mask = (jnp.tril(jnp.ones((L, L), bool)) if causal else None)
+        m = jnp.full(q.shape[:1] + (q.shape[2], q.shape[1]), _NEG_INF,
+                     dtype=jnp.float32)
+        l = jnp.zeros_like(m)
+        acc = jnp.zeros(q.shape, jnp.float32)
+        m, l, acc = _block_attn_update(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            v.astype(jnp.float32), m, l, acc, mask, scale)
+        out = acc / jnp.maximum(l, 1e-20)[..., None].transpose(0, 2, 1, 3)
+        return out.astype(q.dtype)
+
+    fn = _ring_sharded(mesh, axis, n_shards, causal, float(scale),
+                       batch_part)
     return fn(q, k, v)

@@ -423,6 +423,41 @@ def test_lockwatch_reports_long_hold(monkeypatch):
         lockwatch.reset()
 
 
+def test_lockwatch_rpc_pseudo_sites_close_cross_process_cycle():
+    """The runtime half of R19's lock-across-RPC arm: a lock held across
+    a synchronous call plus a handler that re-acquires it closes a
+    site-order cycle through the ``rpc:<METHOD>`` pseudo-site."""
+    lockwatch.reset()
+    lk = lockwatch.wrap(name="fixture:client_lock")
+    try:
+        with lk:
+            lockwatch.rpc_client_wait("rpc:PING")   # lock -> wire edge
+        token = lockwatch.rpc_handler_enter("rpc:PING")
+        with lk:                                     # wire -> lock edge
+            pass
+        lockwatch.rpc_handler_exit(token)
+        cys = lockwatch.cycles()
+        assert any(c["kind"] == "site-order" and
+                   {"rpc:PING", "fixture:client_lock"} <= set(c["sites"])
+                   for c in cys), cys
+    finally:
+        lockwatch.reset()
+
+
+def test_lockwatch_rpc_pseudo_sites_quiet_without_held_locks():
+    lockwatch.reset()
+    lk = lockwatch.wrap(name="fixture:free_lock")
+    try:
+        lockwatch.rpc_client_wait("rpc:PING")   # nothing held: no edge
+        token = lockwatch.rpc_handler_enter("rpc:PING")
+        with lk:
+            pass
+        lockwatch.rpc_handler_exit(token)
+        assert lockwatch.cycles() == []
+    finally:
+        lockwatch.reset()
+
+
 def test_cli_exits_zero_on_clean_tree(tmp_path):
     clean = tmp_path / "clean.py"
     clean.write_text("def ok():\n    return 1\n")
@@ -1091,6 +1126,215 @@ def test_r18_reply_discipline_and_lifecycle_table(tmp_path):
     assert any("'DRAINED' -> 'ALIVE'" in m for m in msgs)
 
 
+# -- R19: distributed deadlock over the stitched graph ------------------------
+
+def test_r19_fires_on_cross_daemon_sync_call_cycle(tmp_path):
+    """PING's arm reaches a sync POKE send through a helper in another
+    file, and POKE's arm sync-sends PING back: a cross-process wait
+    cycle the stitched graph must witness."""
+    findings = run_tree(tmp_path, "R19", {
+        "hub.py": """\
+            from proj import spoke
+
+            def dispatch(env, ctx, client, pb):
+                if env.method == pb.PING:
+                    spoke.relay(client, pb)
+                    ctx.reply(b"")
+                elif env.method == pb.POKE:
+                    client.call(pb.PING, b"")
+                    ctx.reply(b"")
+                else:
+                    ctx.reply_error("unknown")
+        """,
+        "spoke.py": """\
+            def relay(client, pb):
+                client.call(pb.POKE, b"")
+        """,
+    })
+    assert [f.rule for f in findings] == ["R19"]
+    assert "CYCLE" in findings[0].message
+    assert "rpc:PING" in findings[0].message
+    assert "rpc:POKE" in findings[0].message
+
+
+def test_r19_quiet_when_one_leg_is_fire_and_forget(tmp_path):
+    findings = run_tree(tmp_path, "R19", {"hub.py": """\
+        def dispatch(env, ctx, client, pb):
+            if env.method == pb.PING:
+                client.call(pb.POKE, b"")
+                ctx.reply(b"")
+            elif env.method == pb.POKE:
+                client.call_async(pb.PING, b"", None)
+                ctx.reply(b"")
+            else:
+                ctx.reply_error("unknown")
+        """})
+    assert findings == []
+
+
+def test_r19_fires_when_lock_held_across_send_and_handler_reacquires(tmp_path):
+    findings = run_tree(tmp_path, "R19", {"locked.py": """\
+        import threading
+
+        _LOCK = threading.Lock()
+
+        def dispatch(env, ctx, pb):
+            if env.method == pb.GRAB:
+                with _LOCK:
+                    pass
+                ctx.reply(b"")
+            else:
+                ctx.reply_error("unknown")
+
+        def send_locked(client, pb):
+            with _LOCK:
+                client.call(pb.GRAB, b"")
+        """})
+    assert [f.rule for f in findings] == ["R19"]
+    assert "_LOCK" in findings[0].message
+    assert "GRAB" in findings[0].message
+
+
+def test_r19_quiet_when_lock_released_before_send(tmp_path):
+    findings = run_tree(tmp_path, "R19", {"locked.py": """\
+        import threading
+
+        _LOCK = threading.Lock()
+
+        def dispatch(env, ctx, pb):
+            if env.method == pb.GRAB:
+                with _LOCK:
+                    pass
+                ctx.reply(b"")
+            else:
+                ctx.reply_error("unknown")
+
+        def send_unlocked(client, pb):
+            with _LOCK:
+                body = b""
+            client.call(pb.GRAB, body)
+        """})
+    assert findings == []
+
+
+# -- R20: unbounded blocking reachable from a dispatch arm --------------------
+
+def test_r20_catches_naked_wait_reachable_from_dispatch_arm(tmp_path):
+    findings = run_tree(tmp_path, "R20", {"srv.py": """\
+        def helper(ev):
+            ev.wait()
+
+        def dispatch(env, ctx, ev, pb):
+            if env.method == pb.WORK:
+                helper(ev)
+                ctx.reply(b"")
+            else:
+                ctx.reply_error("unknown")
+        """})
+    assert [f.rule for f in findings] == ["R20"]
+    f = findings[0]
+    assert f.line == 2
+    assert "WORK" in f.message and "helper" in f.message
+
+
+def test_r20_quiet_on_deadline_scope_and_bounded_wait(tmp_path):
+    findings = run_tree(tmp_path, "R20", {"srv.py": """\
+        def scoped_helper(ev, deadline):
+            ev.wait()
+
+        def capped_helper(ev):
+            ev.wait(1.0)
+
+        def dispatch(env, ctx, ev, pb):
+            if env.method == pb.WORK:
+                scoped_helper(ev, 1.0)
+                capped_helper(ev)
+                ctx.reply(b"")
+            else:
+                ctx.reply_error("unknown")
+        """})
+    assert findings == []
+
+
+# -- R21: jit compile-cache stability -----------------------------------------
+
+def test_r21_fires_on_loop_and_per_call_constructions(tmp_path):
+    findings = run_rule(tmp_path, "R21", """\
+        import jax
+
+        def hot(xs):
+            for x in xs:
+                g = jax.jit(lambda v: v)
+                x = g(x)
+            return xs
+
+        def immediate(x):
+            return jax.jit(lambda v: v)(x)
+        """)
+    assert all(f.rule == "R21" for f in findings) and findings
+    msgs = " | ".join(f.message for f in findings)
+    assert "inside a loop" in msgs
+    assert "built and invoked in one expression" in msgs
+
+
+def test_r21_fires_on_donated_buffer_use_after_call(tmp_path):
+    findings = run_rule(tmp_path, "R21", """\
+        import jax
+
+        def _impl(state):
+            return state
+
+        _STEP = jax.jit(_impl, donate_argnums=(0,))
+
+        def bad(state):
+            out = _STEP(state)
+            return out, state
+
+        def good(state):
+            state = _STEP(state)
+            return state
+        """)
+    assert [f.rule for f in findings] == ["R21"]
+    assert "donated" in findings[0].message
+    assert findings[0].line == 10
+
+
+def test_r21_quiet_on_cached_builder_and_padded_scalar(tmp_path):
+    findings = run_rule(tmp_path, "R21", """\
+        import functools
+
+        import jax
+
+        def pad_items(items, buckets):
+            return items
+
+        @functools.lru_cache(maxsize=8)
+        def build(n):
+            return jax.jit(lambda v: v)
+
+        _STEP = jax.jit(lambda v, k: v, static_argnums=(1,))
+
+        def run(state, items):
+            items = pad_items(items, (8,))
+            return _STEP(state, len(items))
+        """)
+    assert findings == []
+
+
+def test_r21_ignores_non_jax_callables_named_jit(tmp_path):
+    findings = run_rule(tmp_path, "R21", """\
+        from mytools import jit
+
+        def hot(xs):
+            out = []
+            for x in xs:
+                f = jit(x)
+                out.append(f())
+            return out
+        """)
+    assert findings == []
+
+
 # -- regression guards for the defects R16/R17 found in the real tree ---------
 
 def _lint_repo(rule_id, *relpaths):
@@ -1119,6 +1363,28 @@ def test_r17_regression_drain_and_checkpoint_stay_bounded():
                       "ray_tpu/checkpoint/engine.py",
                       "ray_tpu/tune/execution.py",
                       "ray_tpu/util/client/client.py") == []
+
+
+def test_r19_r20_regression_runtime_rpc_plane_stays_clean():
+    # the stitched graph over the real dispatcher (_handle_rpc) must not
+    # find wait cycles or arm-reachable naked blocking in the runtime
+    for rule in ("R19", "R20"):
+        assert _lint_repo(rule,
+                          "ray_tpu/_private/rpc.py",
+                          "ray_tpu/_private/distributed.py",
+                          "ray_tpu/_private/state_client.py",
+                          "ray_tpu/_private/host_daemon.py") == []
+
+
+def test_r21_regression_parallel_shard_builders_stay_cached():
+    # moe_apply/pipeline_apply/ring_attention used to rebuild shard_map
+    # per call; the lru_cached builders must keep them R21-clean
+    assert _lint_repo("R21",
+                      "ray_tpu/parallel/expert.py",
+                      "ray_tpu/parallel/pipeline.py",
+                      "ray_tpu/parallel/sequence.py",
+                      "ray_tpu/rl/policy.py",
+                      "ray_tpu/rl/ppo.py") == []
 
 
 def test_rpc_server_ctor_abort_closes_listener(monkeypatch):
@@ -1220,13 +1486,131 @@ def test_cache_bypassed_under_rule_restriction(tmp_path, monkeypatch):
     assert not (tmp_path / "cache.json").exists()
 
 
+_STITCH_WIRE = """\
+class pb:
+    FWD = 1
+    BACK = 2
+
+
+def dispatch(env, ctx, client):
+    if env.method == pb.FWD:
+        client.call(pb.BACK, b"")
+        ctx.reply(b"")
+    elif env.method == pb.BACK:
+        client.call(pb.FWD, b"")
+        ctx.reply(b"")
+    else:
+        ctx.reply_error("unknown method")
+"""
+
+
+def test_stitch_cache_replays_cross_process_graph(tmp_path, monkeypatch):
+    """Per-file stitch facts (send sites + dispatcher arms) are cached by
+    content hash: an unrelated edit replays wire.py's facts instead of
+    re-deriving them, and the stitched R19 finding survives the replay."""
+    monkeypatch.setenv("RAYLINT_CACHE", str(tmp_path / "cache.json"))
+    root = tmp_path / "proj"
+    root.mkdir()
+    (root / "wire.py").write_text(_STITCH_WIRE)
+    (root / "other.py").write_text("x = 1\n")
+
+    eng_cold = LintEngine([str(root)], cache=True)
+    cold = eng_cold.run()
+    assert [f.rule for f in cold] == ["R19"]
+    assert eng_cold.stitch_stats == (0, 2)   # all facts derived fresh
+
+    (root / "other.py").write_text("x = 2\n")
+    eng_part = LintEngine([str(root)], cache=True)
+    part = eng_part.run()
+    assert eng_part.cache_stats == (1, 2, False)
+    assert eng_part.stitch_stats == (1, 2)   # wire.py replayed, other re-derived
+    assert [(f.rule, f.path, f.line) for f in part] == \
+        [(f.rule, f.path, f.line) for f in cold]
+
+    # editing the wire file itself invalidates its stitch entry
+    (root / "wire.py").write_text("# moved\n" + _STITCH_WIRE)
+    eng_dirty = LintEngine([str(root)], cache=True)
+    dirty = eng_dirty.run()
+    assert [f.rule for f in dirty] == ["R19"]
+    assert dirty[0].line == cold[0].line + 1
+    assert eng_dirty.stitch_stats == (1, 2)  # other.py replays, wire.py does not
+
+
+def test_r19_acceptance_flagged_cycle_really_deadlocks_two_daemons(tmp_path):
+    """The acceptance shape for R19: (a) the cyclic sync-RPC pattern is
+    flagged statically; (b) on a real two-daemon cluster the same shape
+    wedges — each single-threaded peer waits synchronously on the other,
+    so the entangled call misses a budget the one-way hop meets easily."""
+    findings = run_tree(tmp_path, "R19", {"wire.py": _STITCH_WIRE})
+    assert [f.rule for f in findings] == ["R19"]
+
+    from ray_tpu._native.build import build_state_service
+    try:
+        build_state_service()
+    except Exception as e:
+        pytest.skip(f"state service unavailable: {e}")
+
+    import ray_tpu
+    from ray_tpu import chaos
+    from ray_tpu.cluster_utils import ProcessCluster
+
+    ray_tpu.shutdown()
+    prev = chaos.schedule()
+    c = ProcessCluster(num_daemons=2, num_cpus=1)
+    ray_tpu.init(address=c.address)
+    try:
+        @ray_tpu.remote
+        class Peer:
+            def __init__(self):
+                self._peer = None
+
+            def set_peer(self, peer):
+                self._peer = peer
+                return True
+
+            def echo(self):
+                return "ok"
+
+            def relay(self):
+                import ray_tpu
+                return ray_tpu.get(self._peer.echo.remote(), timeout=30)
+
+            def entangle(self):
+                import ray_tpu
+                return ray_tpu.get(self._peer.entangle.remote(), timeout=8)
+
+        a, b = Peer.remote(), Peer.remote()
+        assert ray_tpu.get([a.set_peer.remote(b), b.set_peer.remote(a)],
+                           timeout=60) == [True, True]
+        # sanity: one synchronous hop across the wire completes fine
+        try:
+            assert ray_tpu.get(a.relay.remote(), timeout=60) == "ok"
+        except Exception as e:
+            pytest.skip(f"nested actor calls unavailable: {e}")
+        # chaos delay widens the window so both peers are mid-send when
+        # the wait cycle closes, the interleaving R19 warns about
+        chaos.configure(5, "rpc.client.send@2%3=delay(0.05)")
+        ref = a.entangle.remote()
+        with pytest.raises(ray_tpu.GetTimeoutError):
+            ray_tpu.get(ref, timeout=4)      # the cycle never completes
+    finally:
+        chaos.clear()
+        if prev is not None:
+            chaos.install(prev)
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
 def test_sarif_log_covers_all_rules_and_anchors_findings():
     from ray_tpu.devtools.linter import Finding, sarif_log
     log = sarif_log([Finding("R4", "swallow", "pkg/a.py", 3, "msg here")])
     assert log["version"] == "2.1.0"
     run = log["runs"][0]
     rules = run["tool"]["driver"]["rules"]
-    assert {r["id"] for r in rules} == {f"R{i}" for i in range(1, 19)}
+    assert {r["id"] for r in rules} == {f"R{i}" for i in range(1, 22)}
+    for r in rules:
+        assert r["fullDescription"]["text"], r["id"]
+        assert r["helpUri"].startswith("ARCHITECTURE.md#"), r["id"]
     res = run["results"][0]
     assert res["ruleId"] == "R4"
     assert rules[res["ruleIndex"]]["id"] == "R4"
