@@ -292,6 +292,67 @@ def bench_perf_overhead(prefix: str, n: int = 300):
         emit(f"{prefix}_task_execute_p99_us", s["p99_ms"] * 1e3, "us")
 
 
+def bench_goodput(prefix: str, n: int = 150):
+    """Goodput-ledger cost plus the fleet-goodput SLO row.
+
+    - ``_goodput_overhead_pct``: a synthetic training step — one batch
+      pulled through the ledger-wrapped data iterator, a host matmul as
+      the "device step", a ``step_mark`` — with the ledger recording vs
+      the module-bool fast path, paired A/B so machine drift cancels.
+      Smaller-is-better: the acceptance budget is the ledger staying in
+      low single digits on a real (sub-millisecond) step.
+    - ``_fleet_goodput_pct``: the federation math on a deterministic
+      two-node fleet, one node preempted (4.5 node-seconds of
+      restart_downtime plus an idle tail).  The inputs are fixed
+      ledgers, so the row moves only when ``merge_payloads`` /
+      ``goodput_pct`` change — a floor, gated as bigger-is-better by
+      ``check_against``'s goodput carve-out."""
+    import statistics
+
+    from ray_tpu.data.dataset import _data_wait_iter
+    from ray_tpu.observability import goodput
+
+    # 512x512 dgemm ~ 1ms of host work: the scale of a small real step.
+    # Undersizing it would bill the ledger's ~µs per step against a
+    # denominator no training loop has.
+    a = np.random.rand(512, 512)
+
+    def step_us():
+        t0 = time.perf_counter()
+        it = _data_wait_iter(iter([a] * n))
+        for b in it:
+            (b @ b).sum()
+            goodput.step_mark()
+        return (time.perf_counter() - t0) / n * 1e6
+
+    was = goodput.ENABLED
+    step_us()  # warm
+    pcts = []
+    for _ in range(5):
+        goodput.disable()
+        off = step_us()
+        goodput.enable()
+        on = step_us()
+        pcts.append(100.0 * (on - off) / off)
+    if not was:
+        goodput.disable()
+    goodput.reset()  # synthetic ledgers must not federate
+    emit(f"{prefix}_goodput_overhead_pct", statistics.median(pcts), "%")
+
+    healthy = {"jobs": {"train": {
+        "wall_s": 60.0, "compile_count": 1, "recompile_count": 0,
+        "cats": {"compute": 57.0, "compile": 0.6, "data_wait": 1.2,
+                 "collective_wait": 0.6, "ckpt_stall": 0.6,
+                 "restart_downtime": 0.0, "idle": 0.0}}}}
+    preempted = {"jobs": {"train": {
+        "wall_s": 60.0, "compile_count": 2, "recompile_count": 0,
+        "cats": {"compute": 54.0, "compile": 0.0, "data_wait": 0.0,
+                 "collective_wait": 0.0, "ckpt_stall": 0.0,
+                 "restart_downtime": 4.5, "idle": 1.5}}}}
+    fleet = goodput.merge_payloads([healthy, preempted])
+    emit(f"{prefix}_fleet_goodput_pct", fleet["train"]["goodput_pct"], "%")
+
+
 def bench_transport():
     """Startup bandwidth probe: what the transport auto-tuner measured on
     this host — and therefore which chunk size, stream count and socket
@@ -577,6 +638,7 @@ def run_inproc():
     bench_trace_overhead("inproc")
     bench_recorder_overhead("inproc")
     bench_perf_overhead("inproc")
+    bench_goodput("inproc")
     ray_tpu.shutdown()
 
 
@@ -602,6 +664,8 @@ def check_against(baseline_path: str, tolerance: float) -> int:
     overhead percentages (``_pct``) are inverted and must stay <=
     baseline / tolerance (for ``_pct`` the baseline is the budget itself
     — e.g. the 1% disabled-tracing bound — not a past measurement).
+    Exception: ``goodput_pct`` rows are efficiency *floors* — higher is
+    better, like throughput — so they gate as >= baseline * tolerance.
     Metrics missing from either side are skipped (a cluster-less
     environment still gates the inproc set, and TPU-scale target rows
     like ``tpu_serve_qps`` stay dormant until a run on real TPU emits
@@ -614,7 +678,13 @@ def check_against(baseline_path: str, tolerance: float) -> int:
         got = measured.get(metric)
         if got is None or base <= 0:
             continue
-        if metric.endswith(("_us", "_ms", "_pct")):
+        if metric.endswith("goodput_pct"):
+            # goodput is the one percentage where bigger is better: it
+            # is a fraction of wall-clock doing useful work, not an
+            # overhead budget
+            ok = got >= base * tolerance
+            bound = f">= {base * tolerance:.2f}"
+        elif metric.endswith(("_us", "_ms", "_pct")):
             ok = got <= base / tolerance
             bound = f"<= {base / tolerance:.2f}"
         else:

@@ -514,6 +514,9 @@ class StateService {
     if (!req.ParseFromString(env.body()))
       return ReplyError(fd, env, "bad HeartbeatRequest");
     raytpu::HeartbeatReply rep;
+    // Clock-sync beacon: always stamped, even on recognized=false, so a
+    // re-registering node keeps a fresh offset estimate.
+    rep.set_server_time_ms(now_ms());
     auto it = nodes_.find(req.node_id());
     if (it == nodes_.end() || !it->second.alive()) {
       rep.set_recognized(false);  // node must re-register

@@ -287,6 +287,14 @@ _config.define("perf_sampler_hz", float, 19.0,
                "while leaving the histograms on")
 _config.define("perf_top_interval_s", float, 2.0,
                "`ray-tpu top` refresh period between head polls")
+_config.define("goodput_enabled", bool, True,
+               "goodput ledger: per-job wall-clock attribution into exclusive "
+               "categories (compute/data_wait/collective_wait/ckpt_stall/"
+               "compile/restart_downtime/idle), federated at /api/goodput")
+_config.define("clock_sync_enabled", bool, True,
+               "estimate a per-daemon clock offset against the state service "
+               "from register/heartbeat request-reply midpoints and use it to "
+               "de-skew cross-host task.e2e latencies")
 _config.define("serve_ingress_put_threshold_bytes", int, 256 * 1024,
                "serve ingress bodies at least this large are put() into the "
                "object plane and handed to the replica as a ref, so the "

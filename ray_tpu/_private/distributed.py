@@ -58,7 +58,8 @@ import cloudpickle
 
 from ray_tpu import chaos, observability
 from ray_tpu import exceptions as exc
-from ray_tpu.observability import perf
+from ray_tpu.observability import goodput, perf
+from ray_tpu._private import clocksync
 from ray_tpu._private.backoff import BackoffPolicy, BreakerBoard
 from ray_tpu._private.config import _config
 from ray_tpu._private.framing import (FRAME_MAGIC as _FRAME_MAGIC,
@@ -635,8 +636,14 @@ class DistributedRuntime(Runtime):
                 total = self.local_node.resources.total.to_dict()
                 now = self.local_node.resources.available.to_dict()
                 avail = {k: now.get(k, 0.0) for k in total}
+                hb_send = time.time()
                 hb = self.state.heartbeat_ex(
                     self.local_node.node_id.binary(), avail)
+                if clocksync.ENABLED and hb.server_time_ms:
+                    # NTP-style offset sample rides every ack; the
+                    # estimator keeps the lowest-RTT one in its window.
+                    clocksync.observe(hb_send, time.time(),
+                                      hb.server_time_ms / 1e3)
                 recognized = hb.recognized
                 if recognized and hb.node_state == "DRAINING":
                     # Belt-and-braces drain delivery: the signal rides the
@@ -957,10 +964,15 @@ class DistributedRuntime(Runtime):
                 # rather than stalling every actor behind it
                 manifest = handle.result(
                     timeout=max(0.0, deadline - time.monotonic()))
+                # "ts" stamps when this actor went dark: the survivor's
+                # restore computes the cross-process downtime gap from it
+                # (wall clock — monotonic doesn't travel between hosts;
+                # the clock-skew corrector bounds the error).
                 rec = json.dumps({
                     "root": root, "manifest": manifest,
                     "cls": state.cls.__name__, "reason": reason,
-                    "node": self.local_node.node_id.hex()}).encode()
+                    "node": self.local_node.node_id.hex(),
+                    "ts": time.time()}).encode()
                 self.state.kv_put(b"actor:" + state.actor_id.binary(), rec,
                                   namespace=b"drain")
                 count += 1
@@ -994,6 +1006,13 @@ class DistributedRuntime(Runtime):
             if callable(resume):
                 resume()  # e.g. clear a drain-rejection flag
             self.state.kv_del(key, namespace=b"drain")
+            if goodput.ENABLED:
+                # checkpoint-stamp -> restore-here gap: the actor's
+                # preemption downtime, attributed on the survivor
+                ts = float(meta.get("ts") or 0.0)
+                if ts > 0.0:
+                    goodput.account("restart_downtime",
+                                    max(0.0, time.time() - ts))
             self.emit_event("ACTOR_DRAIN_RESTORED",
                             actor=state.cls.__name__)
             if observability.ENABLED:
@@ -2082,6 +2101,11 @@ class DistributedRuntime(Runtime):
         if pg is not None:
             msg.pg_id = pg.id.binary()
             msg.pg_bundle_index = spec.options.placement_group_bundle_index
+        if spec.perf_submit_s:
+            # Rebase the submit stamp onto the state-service timebase so
+            # the executing host (different clock) can rebase it back and
+            # measure task.e2e without cross-host skew.
+            msg.perf_submit_s = clocksync.to_server_s(spec.perf_submit_s)
         return msg, arg_pins
 
     def _release_arg_pins(self, pins: list, delay_s: float = 0.0):
@@ -3143,7 +3167,12 @@ class DistributedRuntime(Runtime):
             args=args, kwargs=kwargs, options=options,
             return_ids=tuple(ObjectID(r) for r in msg.return_ids),
             attempt=msg.attempt,
-            trace_id=msg.trace_id, parent_span_id=msg.parent_span_id)
+            trace_id=msg.trace_id, parent_span_id=msg.parent_span_id,
+            # Stamp arrives in the service timebase (see _spec_to_msg);
+            # rebase onto this host's clock so the execute-site delta is
+            # a plain local time.time() subtraction.
+            perf_submit_s=(clocksync.to_local_s(msg.perf_submit_s)
+                           if msg.perf_submit_s else 0.0))
         if msg.actor_id:
             spec.actor_id = ActorID(msg.actor_id)
             spec.method_name = msg.method_name

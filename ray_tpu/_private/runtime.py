@@ -905,10 +905,15 @@ class Runtime:
             if perf.ENABLED:
                 perf.observe("task.execute", dur * 1e3)
                 if spec.perf_submit_s:
-                    e2e = time.time() - spec.perf_submit_s
-                    if e2e >= dur:
-                        perf.observe("task.e2e", e2e * 1e3)
-                        perf.observe("task.sched", (e2e - dur) * 1e3)
+                    # Cross-host stamps are rebased onto this clock via
+                    # clocksync (heartbeat-beacon offset), so the delta is
+                    # already skew-corrected; residual error is bounded by
+                    # the heartbeat RTTs. Clamp instead of discard: a
+                    # stamp that still lands inside the execution window
+                    # means ~zero scheduling wait, not a bogus sample.
+                    e2e = max(time.time() - spec.perf_submit_s, dur)
+                    perf.observe("task.e2e", e2e * 1e3)
+                    perf.observe("task.sched", (e2e - dur) * 1e3)
             self.emit_event("TASK_DONE", task=spec.function_name,
                             ms=round(dur * 1e3, 3))
             span_args = {"task_id": spec.task_id.hex()}

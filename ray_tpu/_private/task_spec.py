@@ -56,8 +56,10 @@ class TaskSpec:
     # Perf plane: wall-clock submit stamp (time.time(), set only when
     # perf.ENABLED) so the executing side can split scheduling wait from
     # execution in the task.e2e / task.sched histograms.  Wall clock
-    # because submit and execute may be different processes; negative
-    # cross-host skew is discarded at the observe site.
+    # because submit and execute may be different processes; when the spec
+    # crosses a process boundary the stamp is rebased through the
+    # state-service timebase (clocksync) so the execute-site delta is
+    # skew-corrected, and residual negatives clamp to the execution time.
     perf_submit_s: float = 0.0
 
     def is_actor_task(self) -> bool:

@@ -71,7 +71,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ray_tpu import chaos, observability
-from ray_tpu.observability import perf
+from ray_tpu.observability import goodput, perf
 from ray_tpu._private.config import _config
 from ray_tpu._private.framing import FramedPayload, dumps_framed, loads_framed
 from ray_tpu.checkpoint import manifest as mf
@@ -390,9 +390,22 @@ class CheckpointEngine:
         self._ensure_writer()
         with self._writer_lock:
             self._inflight.append(handle)
-        self._queue.put(job)
+        # Bounded-queue backpressure: when the writer falls behind, this
+        # put blocks the training thread — goodput's ``ckpt_stall``.
+        try:
+            self._queue.put_nowait(job)
+        except queue.Full:
+            if goodput.ENABLED:
+                with goodput.interval("ckpt_stall"):
+                    self._queue.put(job)
+            else:
+                self._queue.put(job)
         if wait:
-            handle.result(timeout_s)
+            if goodput.ENABLED:  # synchronous save: commit wait is a stall
+                with goodput.interval("ckpt_stall"):
+                    handle.result(timeout_s)
+            else:
+                handle.result(timeout_s)
         return handle
 
     def _make_leaf(self, path: str, value: Any) -> _LeafTask:

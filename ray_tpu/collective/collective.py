@@ -12,6 +12,7 @@ registry — the host-granular analogue of the reference's per-process
 
 from __future__ import annotations
 
+import functools
 import threading
 from typing import Any, Dict, List, Optional
 
@@ -176,36 +177,59 @@ def _group(group_name: str):
     return g
 
 
+def _collective_wait(fn):
+    """Attribute the blocking time of a collective op to the goodput
+    ledger's ``collective_wait`` category.  First-trace compile inside
+    the op opens a nested ``compile`` interval, which pauses this one —
+    the exclusivity rule keeps the two from double-counting."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        from ray_tpu.observability import goodput
+        if not goodput.ENABLED:
+            return fn(*args, **kwargs)
+        with goodput.interval("collective_wait"):
+            return fn(*args, **kwargs)
+    return wrapper
+
+
+@_collective_wait
 def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
     return _group(group_name).allreduce(tensor, op)
 
 
+@_collective_wait
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
            op: ReduceOp = ReduceOp.SUM):
     return _group(group_name).reduce(tensor, dst_rank, op)
 
 
+@_collective_wait
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     return _group(group_name).broadcast(tensor, src_rank)
 
 
+@_collective_wait
 def allgather(tensor, group_name: str = "default"):
     return _group(group_name).allgather(tensor)
 
 
+@_collective_wait
 def reducescatter(tensor, group_name: str = "default",
                   op: ReduceOp = ReduceOp.SUM):
     return _group(group_name).reducescatter(tensor, op)
 
 
+@_collective_wait
 def send(tensor, dst_rank: int, group_name: str = "default"):
     return _group(group_name).send(tensor, dst_rank)
 
 
+@_collective_wait
 def recv(src_rank: int, group_name: str = "default"):
     return _group(group_name).recv(src_rank)
 
 
+@_collective_wait
 def barrier(group_name: str = "default"):
     return _group(group_name).barrier()
 

@@ -232,7 +232,10 @@ class XLAGroupShared:
                 raise ValueError(kind)
             fn = shard_map(body, mesh=self.mesh, in_specs=P("ranks"),
                            out_specs=out_spec, check_vma=False)
-            return jax.jit(fn)
+            # first-trace time is compile, not collective_wait
+            from ray_tpu.observability import goodput
+            return goodput.instrument_jit(jax.jit(fn),
+                                          name=f"collective.{kind}")
 
         fn = self._program(key, builder)
         stacked_shape = (self.world_size,) + tuple(shape)

@@ -115,6 +115,10 @@ class XLAProcessGroup:
             fn = jax.jit(lambda a: _REDUCE[op](a), out_shardings=scattered)
         else:
             raise ValueError(kind)
+        # First call per shape traces+compiles: attribute it to the
+        # goodput ledger's ``compile`` category, not collective_wait.
+        from ray_tpu.observability import goodput
+        fn = goodput.instrument_jit(fn, name=f"collective.{kind}")
         self._programs[key] = fn
         return fn
 

@@ -39,6 +39,11 @@ Endpoints:
                       perf histogram (rpc/task/fetch/ckpt/serve/...),
                       exact merge of the raw bucket counts riding the
                       metric federation
+  /api/goodput      — goodput ledger federation: per-node per-job
+                      wall-clock attribution (compute/compile/data_wait/
+                      collective_wait/ckpt_stall/restart_downtime/idle)
+                      merged into per-job category totals +
+                      ``goodput_pct``, degrading with ``missing_hosts``
   /api/profile?host=X&seconds=N
                     — federated sampling-profiler output (collapsed
                       stacks + pprof-shaped JSON). seconds=0 returns
@@ -344,6 +349,27 @@ class DashboardHead:
         return {"ts": time.time(), "nodes": nodes, "cluster": cluster,
                 "missing_hosts": missing}
 
+    # -- goodput ledger --------------------------------------------------
+    def _goodput(self) -> dict:
+        """Cluster goodput: each node's per-job wall-clock attribution
+        ledger (the ``"goodput"`` payload riding the federated metric
+        snapshots) merged into per-job category totals + ``goodput_pct``
+        (recomputed from merged seconds, never averaged from per-node
+        percentages). Per-node ledgers stay visible for skew triage;
+        unreachable daemons degrade into ``missing_hosts``."""
+        from ray_tpu.observability import goodput as goodput_mod
+        snaps, missing = self._metric_snapshots()
+        nodes = {}
+        for node, fams in snaps.items():
+            payload = goodput_mod.extract_goodput(fams)
+            if payload and payload.get("jobs"):
+                nodes[node] = payload["jobs"]
+        jobs = goodput_mod.merge_payloads(
+            {"jobs": per} for per in nodes.values())
+        return {"ts": time.time(),
+                "categories": list(goodput_mod.CATEGORIES),
+                "jobs": jobs, "nodes": nodes, "missing_hosts": missing}
+
     def _profile_snapshots(self, host: str = "") -> "tuple[dict, list]":
         """({host_label: cumulative profile}, missing) — the head's own
         sampler plus each alive daemon's (NODE_DEBUG include_stacks
@@ -498,6 +524,8 @@ class DashboardHead:
                                     "missing_hosts": missing})
                     elif route == "/api/perf":
                         self._json(head._perf())
+                    elif route == "/api/goodput":
+                        self._json(head._goodput())
                     elif route == "/api/profile":
                         self._json(head._profile(
                             q.get("host", [""])[0],

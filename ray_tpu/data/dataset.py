@@ -803,22 +803,44 @@ class GroupedData:
 # --------------------------------------------------------------------------- #
 
 
+def _data_wait_iter(it: Iterator) -> Iterator[Any]:
+    """Attribute each batch pull to the goodput ledger's ``data_wait``
+    category — the input-pipeline stall a train worker sees when the
+    producer (pandas assembly / prefetch thread) falls behind the step."""
+    from ray_tpu.observability import goodput
+    while True:
+        if goodput.ENABLED:
+            with goodput.interval("data_wait"):
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+        else:
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+        yield batch
+
+
 class DataIterator:
     """Consumer-facing iteration handle over one dataset shard
     (reference ``ray.data.DataIterator``, what ``streaming_split``
-    hands each Train worker)."""
+    hands each Train worker).  Batch pulls are goodput-attributed as
+    ``data_wait`` — this is the handle train workers consume from, so
+    pipeline stalls land in the job ledger."""
 
     def __init__(self, ds: "Dataset"):
         self._ds = ds
 
     def iter_batches(self, **kw) -> Iterator[Any]:
-        return self._ds.iter_batches(**kw)
+        return _data_wait_iter(self._ds.iter_batches(**kw))
 
     def iter_torch_batches(self, **kw) -> Iterator[Any]:
-        return self._ds.iter_torch_batches(**kw)
+        return _data_wait_iter(self._ds.iter_torch_batches(**kw))
 
     def iter_jax_batches(self, **kw) -> Iterator[Any]:
-        return self._ds.iter_jax_batches(**kw)
+        return _data_wait_iter(self._ds.iter_jax_batches(**kw))
 
     def iter_rows(self) -> Iterator[Any]:
         return self._ds.iter_rows()

@@ -2217,6 +2217,89 @@ def check_jit_stability(ctx: FileContext) -> Iterator[Finding]:
 
 
 # --------------------------------------------------------------------------
+# R22: metric-name registry — perf/ledger name literals must be declared
+
+_METRIC_REGISTRY: Optional[Tuple[frozenset, frozenset]] = None
+
+# resolved call target -> which registry its first literal arg must hit
+_R22_PERF_CALLS = frozenset({"ray_tpu.observability.perf.observe"})
+_R22_LEDGER_CALLS = frozenset({"ray_tpu.observability.goodput.account",
+                               "ray_tpu.observability.goodput.interval"})
+
+
+def _metric_registry() -> Tuple[frozenset, frozenset]:
+    """(PERF_HISTOGRAMS, LEDGER_CATEGORIES) from
+    ``ray_tpu/observability/metric_names.py``.  The module is
+    deliberately import-free, and exec'ing its source keeps the linter
+    from dragging the observability package (config, runtime state)
+    into a static-analysis process."""
+    global _METRIC_REGISTRY
+    if _METRIC_REGISTRY is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            os.pardir, "observability", "metric_names.py")
+        ns: Dict[str, object] = {}
+        with open(path, encoding="utf-8") as f:
+            exec(compile(f.read(), path, "exec"), ns)  # noqa: S102
+        _METRIC_REGISTRY = (frozenset(ns["PERF_HISTOGRAMS"]),
+                            frozenset(ns["LEDGER_CATEGORIES"]))
+    return _METRIC_REGISTRY
+
+
+def _resolved_call_target(node: ast.Call, ctx: FileContext
+                          ) -> Optional[str]:
+    """Fully-qualified dotted target of a call, resolving the head
+    segment through the file's imports (``from ray_tpu.observability
+    import perf`` makes ``perf.observe`` resolve to
+    ``ray_tpu.observability.perf.observe``)."""
+    full = _dotted(node.func)
+    if not full:
+        return None
+    head, _, rest = full.partition(".")
+    origin = ctx.import_origin.get(head)
+    if origin:
+        return origin + ("." + rest if rest else "")
+    return full
+
+
+@rule("R22", "metric-registry")
+def check_metric_registry(ctx: FileContext) -> Iterator[Finding]:
+    """A literal metric name passed to ``perf.observe(...)`` or a
+    literal ledger category passed to ``goodput.account(...)`` /
+    ``goodput.interval(...)`` that is not declared in
+    ``ray_tpu/observability/metric_names.py``.  A typo'd name does not
+    fail at runtime — it silently mints a parallel histogram family
+    every consumer (head quantiles, ``ray-tpu top``, doctor baselines)
+    ignores, and a misspelled category raises only when that code path
+    finally runs.  Non-literal names are dynamic and out of scope."""
+    perf_names, ledger_names = _metric_registry()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        target = _resolved_call_target(node, ctx)
+        if target in _R22_PERF_CALLS:
+            registry, kind, where = perf_names, "histogram", "PERF_HISTOGRAMS"
+        elif target in _R22_LEDGER_CALLS:
+            registry, kind, where = (ledger_names, "ledger category",
+                                     "LEDGER_CATEGORIES")
+        else:
+            continue
+        arg = node.args[0]
+        if not isinstance(arg, ast.Constant) or \
+                not isinstance(arg.value, str):
+            continue  # dynamic name: statically unverifiable
+        if arg.value in registry:
+            continue
+        if ctx.allowed(node.lineno, "R22", "metric-registry"):
+            continue
+        yield Finding(
+            "R22", "metric-registry", ctx.relpath, node.lineno,
+            f"{kind} {arg.value!r} is not declared in "
+            f"ray_tpu/observability/metric_names.py ({where}): a typo "
+            "here silently mints a parallel series no consumer reads — "
+            "fix the name or declare it in the registry")
+
+
+# --------------------------------------------------------------------------
 # engine
 
 class LintEngine:

@@ -302,8 +302,57 @@ def _perf_reports(collected: dict,
     return {"cluster": summaries, "drift": drift}
 
 
+def _goodput_reports(collected: dict,
+                     baseline: Optional[dict] = None) -> dict:
+    """Goodput-ledger section: per-job wall-clock attribution merged
+    across every node's ``"goodput"`` payload riding the already-
+    collected metric snapshots.
+
+    ``baseline`` (the ``--goodput-baseline`` JSON: ``{job: {"goodput_pct":
+    floor, "restart_downtime_s": ceiling, "tolerance": 1.0}}``) turns the
+    section into an efficiency-SLO gate: ``*_pct`` budgets are floors
+    (goodput below ``floor * tolerance`` is a drift finding), ``*_s``
+    budgets are ceilings on that category's merged seconds (above
+    ``ceiling * tolerance`` drifts).  Both count as issues."""
+    from ray_tpu.observability import goodput as goodput_mod
+    cluster = collected.get("cluster") or {}
+    snaps = (cluster.get("metrics") or {}).get("snapshots") or {}
+    payloads = []
+    for families in snaps.values():
+        p = goodput_mod.extract_goodput(families or [])
+        if p:
+            payloads.append(p)
+    jobs = goodput_mod.merge_payloads(payloads)
+    drift = []
+    for job, budgets in (baseline or {}).items():
+        rec = jobs.get(job)
+        if rec is None:
+            continue
+        tolerance = float(budgets.get("tolerance", 1.0))
+        for key, base in budgets.items():
+            if key == "tolerance":
+                continue
+            if key.endswith("_pct"):
+                got = float(rec.get("goodput_pct", 0.0))
+                if got < float(base) * tolerance:
+                    drift.append({"job": job, "metric": key,
+                                  "got_pct": round(got, 2),
+                                  "baseline_pct": float(base),
+                                  "tolerance": tolerance})
+            elif key.endswith("_s"):
+                cat = key[:-2]
+                got = float((rec.get("cats") or {}).get(cat, 0.0))
+                if got > float(base) * tolerance:
+                    drift.append({"job": job, "metric": key,
+                                  "got_s": round(got, 3),
+                                  "baseline_s": float(base),
+                                  "tolerance": tolerance})
+    return {"jobs": jobs, "drift": drift}
+
+
 def diagnose(collected: dict, straggler_factor: float = 3.0,
-             perf_baseline: Optional[dict] = None) -> dict:
+             perf_baseline: Optional[dict] = None,
+             goodput_baseline: Optional[dict] = None) -> dict:
     """Turn a :func:`collect` result into findings. Machine-readable;
     :func:`render_text` prints the same structure for humans."""
     crashes = _crash_reports(_all_bundles(collected))
@@ -350,14 +399,18 @@ def diagnose(collected: dict, straggler_factor: float = 3.0,
                              or h["node"].startswith(nid[:8])]})
     local = collected.get("local") or {}
     perf_section = _perf_reports(collected, baseline=perf_baseline)
+    goodput_section = _goodput_reports(collected,
+                                       baseline=goodput_baseline)
     n_issues = (len(crashes) + len(hangs) + len(stragglers) +
                 len(missing) + len(dead_nodes) +
-                len(perf_section["drift"]))
+                len(perf_section["drift"]) +
+                len(goodput_section["drift"]))
     return {
         "ts": collected.get("ts"),
         "healthy": n_issues == 0,
         "num_issues": n_issues,
         "perf": perf_section,
+        "goodput": goodput_section,
         "crashes": crashes,
         "hangs": hangs,
         "stragglers": stragglers,
@@ -485,6 +538,37 @@ def render_text(report: dict) -> str:
             lines.append(
                 f"  {d['hist']}.{d['metric']}: {d['got_ms']}ms > "
                 f"{d['baseline_ms']}ms x{d['tolerance']}")
+    goodput_section = report.get("goodput") or {}
+    gjobs = goodput_section.get("jobs") or {}
+    if gjobs:
+        lines.append("")
+        lines.append(f"GOODPUT ({len(gjobs)} job(s), cluster-merged)")
+        for job, rec in sorted(gjobs.items()):
+            cats = rec.get("cats") or {}
+            busy = ", ".join(
+                f"{c}={cats[c]:.1f}s" for c in sorted(cats)
+                if cats.get(c, 0.0) > 0.0)
+            lines.append(
+                f"  {job}: goodput {rec.get('goodput_pct', 0.0):.1f}% of "
+                f"{rec.get('wall_s', 0.0):.1f} node-seconds "
+                f"(compiles={rec.get('compile_count', 0)}, "
+                f"recompiles={rec.get('recompile_count', 0)})")
+            if busy:
+                lines.append(f"    {busy}")
+    gdrift = goodput_section.get("drift") or []
+    if gdrift:
+        lines.append("")
+        lines.append(f"GOODPUT DRIFT ({len(gdrift)}) — efficiency "
+                     "beyond recorded budget")
+        for d in gdrift:
+            if "got_pct" in d:
+                lines.append(
+                    f"  {d['job']}.{d['metric']}: {d['got_pct']}% < "
+                    f"{d['baseline_pct']}% x{d['tolerance']}")
+            else:
+                lines.append(
+                    f"  {d['job']}.{d['metric']}: {d['got_s']}s > "
+                    f"{d['baseline_s']}s x{d['tolerance']}")
     missing = report.get("unreachable_hosts") or []
     if missing:
         lines.append("")
@@ -544,18 +628,28 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "budgets ({name: {p99_ms: X, tolerance: "
                              "1.5}}); quantiles beyond budget*tolerance "
                              "count as issues")
+    parser.add_argument("--goodput-baseline", default=None,
+                        help="JSON file of per-job goodput budgets "
+                             "({job: {goodput_pct: floor, "
+                             "restart_downtime_s: ceiling, tolerance: "
+                             "1.0}}); budget violations count as issues")
     args = parser.parse_args(argv)
     perf_baseline = None
     if args.perf_baseline:
         with open(args.perf_baseline) as f:
             perf_baseline = json.load(f)
+    goodput_baseline = None
+    if args.goodput_baseline:
+        with open(args.goodput_baseline) as f:
+            goodput_baseline = json.load(f)
     try:
         collected = collect(flight_dir=args.flight_dir,
                             address=args.address,
                             seal=not args.no_seal)
         report = diagnose(collected,
                           straggler_factor=args.straggler_factor,
-                          perf_baseline=perf_baseline)
+                          perf_baseline=perf_baseline,
+                          goodput_baseline=goodput_baseline)
     except Exception as e:  # noqa: BLE001
         print(f"doctor: collection failed: {e!r}", file=sys.stderr)
         return 2

@@ -110,6 +110,8 @@ def cmd_doctor(args):
         argv += ["--out", args.output]
     if args.perf_baseline:
         argv += ["--perf-baseline", args.perf_baseline]
+    if args.goodput_baseline:
+        argv += ["--goodput-baseline", args.goodput_baseline]
     sys.exit(doctor_main(argv))
 
 
@@ -145,6 +147,11 @@ def _top_rows(payload, subsystems=None):
         for node in sorted(nodes):
             summ = nodes[node].get(name)
             if summ is None:
+                # Partial federation: this node never recorded the
+                # family (fresh node, subsystem not exercised there).
+                # Emit a placeholder row — rendered as "—" — instead of
+                # silently omitting the node from a filtered view.
+                rows.append((node, name, None, False))
                 continue
             straggler = (len(p95s) >= 2 and summ["count"] >= 3
                          and median > 0
@@ -158,6 +165,10 @@ def _render_top(payload, subsystems=None) -> str:
         "NODE", "HISTOGRAM", "COUNT", "MEAN_MS", "P50_MS", "P95_MS",
         "P99_MS")]
     for node, name, s, straggler in _top_rows(payload, subsystems):
+        if s is None:  # family absent on this node: placeholder row
+            lines.append("%-14s %-22s %9s %9s %9s %9s %9s" % (
+                node, name, "—", "—", "—", "—", "—"))
+            continue
         lines.append("%-14s %-22s %9d %9.2f %9.2f %9.2f %9.2f%s" % (
             node, name, int(s["count"]), s["mean_ms"], s["p50_ms"],
             s["p95_ms"], s["p99_ms"],
@@ -169,14 +180,56 @@ def _render_top(payload, subsystems=None) -> str:
     return "\n".join(lines)
 
 
+def _render_goodput(payload) -> str:
+    """Render an ``/api/goodput`` payload: per-job cluster totals first
+    (the SLO view), then the per-node ledgers (the skew-triage view)."""
+    cats = payload.get("categories") or []
+    short = [c[:8] for c in cats]
+    lines = ["%-14s %-10s %8s %8s " % ("NODE", "JOB", "WALL_S", "GOODPUT%")
+             + " ".join("%8s" % s for s in short)]
+
+    def fmt(label, job, rec):
+        c = rec.get("cats") or {}
+        return ("%-14s %-10s %8.1f %7.1f%% " % (
+            label, job[:10], float(rec.get("wall_s", 0.0)),
+            float(rec.get("goodput_pct", 0.0)))
+            + " ".join("%8.2f" % float(c.get(k, 0.0)) for k in cats))
+
+    for job, rec in sorted((payload.get("jobs") or {}).items()):
+        lines.append(fmt("CLUSTER", job, rec))
+    for node, jobs in sorted((payload.get("nodes") or {}).items()):
+        for job, rec in sorted(jobs.items()):
+            lines.append(fmt(node, job, rec))
+    if len(lines) == 1:
+        lines.append("(no goodput ledgers reported yet)")
+    missing = payload.get("missing_hosts") or []
+    if missing:
+        lines.append(f"({len(missing)} unreachable host(s) omitted)")
+    return "\n".join(lines)
+
+
 def cmd_top(args):
-    """Live per-node/per-subsystem latency table off the perf plane."""
+    """Live per-node/per-subsystem latency table off the perf plane
+    (``--goodput``: the per-job wall-clock attribution ledger instead)."""
     import time
     from ray_tpu._private.config import _config
     from ray_tpu.dashboard.head import DashboardHead
     subsystems = set(args.subsystem) if args.subsystem else None
     head = DashboardHead(args.address)
     try:
+        if args.goodput:
+            if args.json:
+                print(json.dumps(head._goodput(), indent=2))
+                return
+            interval = args.interval or \
+                float(_config.get("perf_top_interval_s"))
+            while True:
+                payload = head._goodput()
+                print("\x1b[2J\x1b[H", end="")
+                print(f"ray-tpu top --goodput — cluster {args.address} "
+                      f"(refresh {interval:.1f}s, Ctrl-C to quit)")
+                print(_render_goodput(payload))
+                time.sleep(interval)
         if args.json:
             payload = head._perf()
             payload["stragglers"] = [
@@ -249,6 +302,9 @@ def main(argv=None):
     hp.add_argument("-o", "--output", default=None)
     hp.add_argument("--perf-baseline", default=None,
                     help="JSON quantile budgets; drift counts as issues")
+    hp.add_argument("--goodput-baseline", default=None,
+                    help="JSON goodput budgets (per-job goodput_pct "
+                         "floors); drift counts as issues")
     hp.set_defaults(fn=cmd_doctor)
     gp = sub.add_parser("drain",
                         help="gracefully drain a node (workload migration)")
@@ -270,6 +326,9 @@ def main(argv=None):
     op.add_argument("--subsystem", action="append", default=None,
                     help="filter to a subsystem prefix (rpc, task, fetch, "
                          "ckpt, serve, train, ...); repeatable")
+    op.add_argument("--goodput", action="store_true",
+                    help="show the per-job goodput ledger (/api/goodput) "
+                         "instead of latency quantiles")
     op.set_defaults(fn=cmd_top)
     dp = sub.add_parser("dashboard",
                         help="serve the cluster dashboard UI")

@@ -16,7 +16,7 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
-from ray_tpu.observability import perf
+from ray_tpu.observability import goodput, perf
 
 logger = logging.getLogger("ray_tpu")
 
@@ -118,13 +118,20 @@ def report(metrics: Dict[str, Any], checkpoint=None) -> None:
     previous report — the user's step loop), ``train.ckpt_enqueue`` (the
     synchronous share of the engine save: device->host copy + queueing;
     hash/write/commit stay on the writer thread), and ``train.report``
-    (this call's own cost)."""
+    (this call's own cost).
+
+    Goodput ledger: each report closes one step — wall time since the
+    previous mark that no explicit interval claimed (data_wait,
+    collective_wait, ckpt_stall, compile are accounted at their own
+    sites) is credited to ``compute`` via :func:`goodput.step_mark`."""
     s = _get_session()
     if s is None:
         raise RuntimeError("session.report() called outside a train worker")
     t0 = time.monotonic() if perf.ENABLED else 0.0
     if t0 and s._last_report_s:
         perf.observe("train.step", (t0 - s._last_report_s) * 1e3)
+    if goodput.ENABLED:
+        goodput.step_mark()
     if checkpoint is not None:
         s.latest_checkpoint = checkpoint
         if s.checkpoint_spec:

@@ -19,6 +19,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_tpu.models import transformer
 from ray_tpu.models.transformer import TransformerConfig
+from ray_tpu.observability import goodput
 from ray_tpu.parallel import (ShardingRules, batch_sharding, pipeline_apply,
                               shard_pytree)
 
@@ -96,4 +97,11 @@ def make_lm_train_step(cfg: TransformerConfig, mesh: Mesh,
     def shard_batch(tokens):
         return jax.device_put(tokens, batch_sharding(mesh, rules, ndim=2))
 
-    return init_fn, step_fn, shard_batch
+    # Goodput compile detection: the first call per (state, tokens)
+    # signature traces+compiles the whole step — pipeline stages, ring
+    # attention and the gradient psum included, since parallel/ runs
+    # inline under this jit — and lands in the ledger's ``compile``
+    # category; a new tokens shape mid-run is a recompile (runtime
+    # mirror of lint rule R21).
+    return init_fn, goodput.instrument_jit(step_fn, name="train.step_fn"), \
+        shard_batch

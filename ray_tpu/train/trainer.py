@@ -24,6 +24,7 @@ from ray_tpu._private.backoff import BackoffPolicy
 from ray_tpu.air.checkpoint import Checkpoint
 from ray_tpu.air.config import (FailureConfig, Result, RunConfig,
                                 ScalingConfig)
+from ray_tpu.observability import goodput
 from ray_tpu.train.backend_executor import BackendExecutor
 
 logger = logging.getLogger("ray_tpu")
@@ -70,6 +71,10 @@ class JaxTrainer:
         history = []
         last_metrics: Dict[str, Any] = {}
         engine_root = self._engine_root()
+        # Goodput: stamp of the failure that triggered the current restart
+        # attempt; the gap until training is running again is the job's
+        # elastic-restart downtime, attributed on the driver ledger.
+        restart_t0: Optional[float] = None
         while True:
             executor = BackendExecutor(
                 self.scaling_config.num_workers,
@@ -84,6 +89,11 @@ class JaxTrainer:
                                         dataset_shards=self._dataset_shards(),
                                         checkpoint_spec=self._checkpoint_spec(
                                             engine_root))
+                if restart_t0 is not None:
+                    if goodput.ENABLED:
+                        goodput.account("restart_downtime",
+                                        time.monotonic() - restart_t0)
+                    restart_t0 = None
                 while True:
                     round_results = executor.get_next_results()
                     if round_results is None:
@@ -101,6 +111,8 @@ class JaxTrainer:
                               metrics_history=history)
             except (exc.ActorDiedError, exc.NodeDiedError,
                     exc.TaskError) as e:
+                if restart_t0 is None:
+                    restart_t0 = time.monotonic()
                 failures += 1
                 if max_failures != -1 and failures > max_failures:
                     return Result(metrics=last_metrics, checkpoint=checkpoint,

@@ -80,6 +80,20 @@ RAY_TPU_CHAOS="20260805:serve.replica.execute@4%9=delay(0.004);rpc.client.send@3
 JAX_PLATFORMS=cpu \
 python -m pytest tests/test_serve_scale.py -q
 
+echo "== goodput gate (wall-clock attribution ledger under delay-only chaos) =="
+# The goodput ledger must attribute correctly when latency actually
+# moves: fixed delays on the instrumented wait paths (checkpoint write,
+# RPC send) stretch the very intervals the ledger classifies, and every
+# test_goodput assertion — category exclusivity, sum-to-wall, step
+# marks, federation merge math, doctor SLO drift — must hold under the
+# perturbed timings. The preemption drill self-skips without the C++
+# state service; bench_micro's goodput rows (overhead budget +
+# deterministic fleet_goodput_pct floor) gate below with the rest of
+# BENCH_MICRO.json.
+RAY_TPU_CHAOS="20260805:checkpoint.write@2%4=delay(0.01);rpc.client.send@3%7=delay(0.005)" \
+JAX_PLATFORMS=cpu \
+python -m pytest tests/test_goodput.py -q
+
 echo "== forensics gate (crash bundles sealed + doctor reads them back) =="
 # Hard-death drill: the forensics suite kills processes mid-task — via a
 # deterministic chaos exit schedule (hooks run) and via raw SIGKILL (no
