@@ -353,6 +353,107 @@ def bench_goodput(prefix: str, n: int = 150):
     emit(f"{prefix}_fleet_goodput_pct", fleet["train"]["goodput_pct"], "%")
 
 
+def bench_comms(prefix: str):
+    """Comms-plane rows:
+
+    - ``_allreduce_f32_gbps``: two-rank CPU-backend allreduce of a 4 MiB
+      f32 tensor, algorithm bandwidth read back from the comms ledger
+      itself (summed bytes over summed seconds across both ranks) — the
+      seed of the ROADMAP ``allreduce_{f32,q8}_gbps`` quantization gate,
+      which will compare a q8 row against this f32 floor.
+    - ``_comms_overhead_pct``: what the full plane (fingerprint,
+      arrival stamps, op ledger) adds to a 4 MiB allreduce, relative
+      to the op itself.  Budget row, smaller-is-better.  Measured
+      differentially: a direct A/B at 4 MiB has wall-clock noise
+      several times the percent-level effect, so the ledger's per-op
+      cost is taken where it dominates the signal — a tiny-tensor
+      pair, plane on vs off, min-of-N on each side — and billed
+      against the measured 4 MiB op time.  The ledger's work is
+      size-independent (shape tuple, stamps, counters), so the
+      tiny-op delta is an upper bound on what the big op pays (there
+      the two ranks' ledger writes partly overlap the peer's
+      compute).  The two ranks are a thread pair calling the public
+      collective API directly — the same wrapper / rendezvous /
+      ledger path the actor route takes, minus actor dispatch, whose
+      scheduling noise would drown the signal.  A 4 MiB op (~ms) is
+      the scale of a small real collective; undersizing the
+      denominator would bill the ledger's ~µs per op against an op
+      time no training loop has (the goodput bench makes the same
+      call).
+    - ``_collective_skew_detect``: the attribution detector on fixed
+      inputs — a rank arriving 50 ms late, five times, folded through
+      snapshot -> merge -> ``skew_flags`` must name exactly that rank.
+      Emits 1.0 only when end-to-end attribution works (a floor: the
+      row moves only when the detector breaks)."""
+    import threading
+
+    from ray_tpu import collective as col
+    from ray_tpu.observability import comms
+
+    big = np.ones(1 << 20, np.float32)        # 4 MiB per rank
+    tiny = np.ones(8, np.float32)
+
+    def rounds(n, gname, arr):
+        errs = []
+
+        def worker(rank):
+            try:
+                if not col.is_group_initialized(gname):
+                    col.init_collective_group(2, rank, backend="cpu",
+                                              group_name=gname)
+                for _ in range(n):
+                    col.allreduce(arr, gname)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker, args=(r,)) for r in (0, 1)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errs:
+            raise errs[0]
+        return (time.perf_counter() - t0) / n * 1e6  # us per op
+
+    was = comms.ENABLED
+    comms.enable()
+    rounds(4, "bench_comms", big)             # warm: first rendezvous
+    comms.reset()
+    big_us = rounds(16, "bench_comms", big)
+    rec = comms.snapshot()["groups"]["bench_comms"]["ops"]["allreduce"]
+    emit(f"{prefix}_allreduce_f32_gbps", rec["algbw_gbps"], "GB/s")
+
+    # Best-of-N on each side: runtime background threads (heartbeats,
+    # samplers) only ever inflate a sample, so the min of each side
+    # isolates the intrinsic per-op cost where a per-pair ratio would
+    # gate on scheduler noise.  Pair order alternates so cache/clock
+    # warming inside a pair cannot systematically bill one side.
+    off_us, on_us = [], []
+    for i in range(10):
+        for state in ((False, True) if i % 2 else (True, False)):
+            (comms.enable if state else comms.disable)()
+            (on_us if state else off_us).append(
+                rounds(24, "bench_comms", tiny))
+    comms.enable()
+    delta_us = max(0.0, min(on_us) - min(off_us))
+    emit(f"{prefix}_comms_overhead_pct", 100.0 * delta_us / big_us, "%")
+
+    comms.reset()
+    for _ in range(5):
+        comms.record_arrivals("bench_skew", {0: 0.0002, 1: 0.050},
+                              world_size=2)
+    merged = comms.merge_payloads([comms.snapshot()])
+    flags = comms.skew_flags(merged["groups"], bounds=merged["bounds"])
+    named = [(f["group"], f["rank"]) for f in flags]
+    emit(f"{prefix}_collective_skew_detect",
+         1.0 if named == [("bench_skew", "1")] else 0.0, "bool")
+
+    if not was:
+        comms.disable()
+    comms.reset()  # synthetic ledgers must not federate
+
+
 def bench_transport():
     """Startup bandwidth probe: what the transport auto-tuner measured on
     this host — and therefore which chunk size, stream count and socket
@@ -639,6 +740,7 @@ def run_inproc():
     bench_recorder_overhead("inproc")
     bench_perf_overhead("inproc")
     bench_goodput("inproc")
+    bench_comms("inproc")
     ray_tpu.shutdown()
 
 

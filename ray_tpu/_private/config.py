@@ -291,6 +291,12 @@ _config.define("goodput_enabled", bool, True,
                "goodput ledger: per-job wall-clock attribution into exclusive "
                "categories (compute/data_wait/collective_wait/ckpt_stall/"
                "compile/restart_downtime/idle), federated at /api/goodput")
+_config.define("comms_enabled", bool, True,
+               "communication observability plane: per-op collective ledger "
+               "(bytes/duration/algbw/busbw), rendezvous arrival-skew "
+               "attribution, runtime collective-fingerprint divergence "
+               "check, and the StripedTransfer peer link matrix, federated "
+               "at /api/comms")
 _config.define("clock_sync_enabled", bool, True,
                "estimate a per-daemon clock offset against the state service "
                "from register/heartbeat request-reply midpoints and use it to "

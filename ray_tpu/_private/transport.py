@@ -39,7 +39,7 @@ import time
 from typing import Callable, Dict, Iterable, List, Optional
 
 from ray_tpu import chaos
-from ray_tpu.observability import perf
+from ray_tpu.observability import comms, perf
 from ray_tpu._private.backoff import BackoffPolicy
 from ray_tpu._private.config import _config
 from ray_tpu._private.rpc import (RpcClient, RpcConnectionError,
@@ -308,13 +308,22 @@ class StripedTransfer:
                         done.set()
 
             def _done_cb(off):
-                if not perf.ENABLED:
+                if not (perf.ENABLED or comms.ENABLED):
                     return lambda error: _settle(off, error)
                 t0 = time.monotonic()  # created immediately before submit
 
                 def _cb(error, _t0=t0, _off=off):
-                    perf.observe("transport.chunk",
-                                 (time.monotonic() - _t0) * 1e3)
+                    dur = time.monotonic() - _t0
+                    if perf.ENABLED:
+                        perf.observe("transport.chunk", dur * 1e3)
+                    if comms.ENABLED and error is None:
+                        # Link matrix: successful chunks only (failed
+                        # ones show up as retries below).  Chunk size is
+                        # the configured stripe size — an estimate for
+                        # the final partial chunk of a transfer.
+                        comms.link_observe(self.addr, self.consumer,
+                                           nbytes=fetch_chunk_bytes(),
+                                           seconds=dur, chunks=1)
                     _settle(_off, error)
                 return _cb
 
@@ -349,6 +358,12 @@ class StripedTransfer:
             # Transport failures: retry just the failed chunks on the
             # surviving streams (clients() replaces dead ones).
             pending = sorted(errors)
+            if comms.ENABLED:
+                # One failover per retry round (streams get replaced),
+                # plus the chunks it re-sends — the link-health signal
+                # the doctor's link-matrix outlier rule keys on.
+                comms.link_observe(self.addr, self.consumer,
+                                   retries=len(pending), failovers=1)
             if not backoff.sleep():
                 err = next(iter(errors.values()))
                 if isinstance(err, (RpcConnectionError, TimeoutError)):

@@ -52,6 +52,11 @@ serve.replica.execute deployment, replica — serve replica, before the user
                       one replica serve slow — the latency-aware router
                       routes around it and the SLO autoscaler sees its
                       p95 — and "error" fails its requests
+collective.op         group, op, rank — collective API entry
+                      (ray_tpu.collective.*), before the op is issued; a
+                      rank-filtered "delay" makes that rank arrive late
+                      at the rendezvous, which the comms plane's
+                      arrival-skew attribution must name
 ====================  =====================================================
 """
 

@@ -80,9 +80,11 @@ class GroupManager:
             shared = _shared_groups.get(group_name)
             if shared is None:
                 if backend == Backend.XLA:
-                    shared = XLAGroupShared(world_size, devices)
+                    shared = XLAGroupShared(world_size, devices,
+                                            label=group_name)
                 else:
-                    shared = CPUGroupShared(world_size, devices)
+                    shared = CPUGroupShared(world_size, devices,
+                                            label=group_name)
                 shared.join_count = 0
                 _shared_groups[group_name] = shared
             else:
@@ -177,18 +179,76 @@ def _group(group_name: str):
     return g
 
 
+def _op_group(args: tuple, kwargs: dict) -> str:
+    """Recover ``group_name`` from any collective signature: it is the
+    only string positional (tensors, ranks and ReduceOps never are)."""
+    return (kwargs.get("group_name")
+            or next((a for a in args if isinstance(a, str)), "default"))
+
+
+# numpy/jax dtype __str__ costs more than the whole ledger write; the
+# distinct dtypes crossing the collective API are a handful, so memoize.
+_dtype_strs: Dict[Any, str] = {}
+
+
+def _dtype_str(dtype) -> str:
+    try:
+        s = _dtype_strs.get(dtype)
+    except TypeError:               # unhashable dtype-like: stringify raw
+        return str(dtype)
+    if s is None:
+        s = _dtype_strs[dtype] = str(dtype)
+    return s
+
+
 def _collective_wait(fn):
-    """Attribute the blocking time of a collective op to the goodput
-    ledger's ``collective_wait`` category.  First-trace compile inside
-    the op opens a nested ``compile`` interval, which pauses this one —
-    the exclusivity rule keeps the two from double-counting."""
+    """The single seam every collective op passes through.
+
+    Attributes the blocking time to the goodput ledger's
+    ``collective_wait`` category (first-trace compile inside the op
+    opens a nested ``compile`` interval, which pauses this one — the
+    exclusivity rule keeps the two from double-counting), records the
+    completed op into the comms ledger (bytes / dtype / duration →
+    algbw/busbw), and exposes the ``collective.op`` chaos injection
+    point so a fault schedule can delay one rank into the rendezvous —
+    the drill the comms plane's skew attribution must catch.
+    """
+    op_name = fn.__name__
+
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
-        from ray_tpu.observability import goodput
-        if not goodput.ENABLED:
-            return fn(*args, **kwargs)
-        with goodput.interval("collective_wait"):
-            return fn(*args, **kwargs)
+        import time as _time
+        from ray_tpu import chaos
+        from ray_tpu.observability import comms, goodput, perf
+        if chaos.ENABLED:
+            group = _op_group(args, kwargs)
+            chaos.inject("collective.op", group=group, op=op_name,
+                         rank=str(get_rank(group)))
+        if not comms.ENABLED:
+            if not goodput.ENABLED:
+                return fn(*args, **kwargs)
+            with goodput.interval("collective_wait"):
+                return fn(*args, **kwargs)
+        group = _op_group(args, kwargs)
+        t0 = _time.monotonic()
+        if goodput.ENABLED:
+            with goodput.interval("collective_wait"):
+                result = fn(*args, **kwargs)
+        else:
+            result = fn(*args, **kwargs)
+        dur = _time.monotonic() - t0
+        # bytes/dtype come from the tensor argument when there is one
+        # (never for barrier; recv reports its received tensor).
+        obj = args[0] if args else None
+        nbytes = getattr(obj, "nbytes", None)
+        if nbytes is None:
+            nbytes = getattr(result, "nbytes", 0) or 0
+        dtype = getattr(obj, "dtype", None) or getattr(result, "dtype", "")
+        comms.record_op(group, op_name, int(nbytes), _dtype_str(dtype), dur,
+                        world_size=get_collective_group_size(group))
+        if perf.ENABLED:
+            perf.observe("collective.op", dur * 1e3)
+        return result
     return wrapper
 
 

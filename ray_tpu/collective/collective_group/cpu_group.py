@@ -13,6 +13,7 @@ import numpy as np
 
 from ray_tpu.collective.collective_group.xla_group import _Rendezvous
 from ray_tpu.collective.types import ReduceOp
+from ray_tpu.observability import comms
 
 _NP_REDUCE = {
     ReduceOp.SUM: lambda xs: np.sum(xs, axis=0),
@@ -23,15 +24,24 @@ _NP_REDUCE = {
 
 
 class CPUGroupShared:
-    def __init__(self, world_size: int, devices: Optional[List] = None):
+    def __init__(self, world_size: int, devices: Optional[List] = None,
+                 label: str = "default"):
         self.world_size = world_size
-        self._rdv = _Rendezvous(world_size)
+        self.label = label
+        # Shared rendezvous = same comms instrumentation as the XLA
+        # group: arrival stamps, fingerprint check, launch/collect phases.
+        self._rdv = _Rendezvous(world_size, label=label)
         self._p2p: Dict[tuple, _Rendezvous] = {}
         import threading
         self._p2p_lock = threading.Lock()
 
     def collective(self, rank: int, tensor, op_desc: tuple) -> Dict[int, Any]:
         arr = np.asarray(tensor)
+        # Raw-tuple fingerprint — see XLAGroupShared.collective: equality
+        # is what the divergence check needs, and per-op stringification
+        # is the single biggest avoidable ledger cost.
+        fp = ((op_desc, tuple(arr.shape), arr.dtype)
+              if comms.ENABLED else None)
 
         def compute(slots):
             kind = op_desc[0]
@@ -55,13 +65,14 @@ class CPUGroupShared:
                 return {r: chunks[r] for r in range(self.world_size)}
             raise ValueError(kind)
 
-        return self._rdv.run(rank, arr, compute)
+        return self._rdv.run(rank, arr, compute, fingerprint=fp)
 
     def _pair_rdv(self, src: int, dst: int) -> _Rendezvous:
         with self._p2p_lock:
             key = (src, dst)
             if key not in self._p2p:
-                self._p2p[key] = _Rendezvous(2)
+                # label=None: no fingerprint/skew on asymmetric p2p pairs.
+                self._p2p[key] = _Rendezvous(2, label=None)
             return self._p2p[key]
 
     def p2p_send(self, rank: int, dst_rank: int, tensor):

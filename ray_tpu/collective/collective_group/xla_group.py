@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
@@ -32,6 +33,7 @@ from ray_tpu._private.jax_compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_tpu.collective.types import ReduceOp
+from ray_tpu.observability import comms, perf
 
 _REDUCE_LAX = {
     ReduceOp.SUM: lambda x, axis: jax.lax.psum(x, axis),
@@ -48,12 +50,24 @@ _REDUCE_NP = {
 
 
 class _Rendezvous:
-    """All ranks deposit; last arrival runs ``compute`` once; all collect."""
+    """All ranks deposit; last arrival runs ``compute`` once; all collect.
 
-    def __init__(self, world_size: int):
+    When the comms plane is on (and the rendezvous belongs to a named
+    group — p2p pair rendezvous pass ``label=None`` and stay dark), each
+    rank stamps its arrival and deposits its collective fingerprint; the
+    last arrival checks the fingerprints (divergence raises into the
+    shared outcome, so every rank sees the error instead of computing
+    with the wrong op) and records the per-rank arrival-skew
+    distribution that lets the doctor name a laggard rank.
+    """
+
+    def __init__(self, world_size: int, label: Optional[str] = "default"):
         self.world_size = world_size
+        self.label = label
         self.lock = threading.Lock()
         self.slots: Dict[int, Any] = {}
+        self.stamps: Dict[int, float] = {}
+        self.fps: Dict[int, tuple] = {}
         # Per-generation outcomes so one failed collective doesn't poison the
         # next: outcome[gen] = (result, error). Old generations are pruned.
         self.outcomes: Dict[int, tuple] = {}
@@ -61,13 +75,31 @@ class _Rendezvous:
         self.cv = threading.Condition(self.lock)
 
     def run(self, rank: int, value: Any, compute: Callable[[Dict[int, Any]], Any],
-            timeout: float = 30.0) -> Any:
+            timeout: float = 30.0, fingerprint: Optional[tuple] = None) -> Any:
+        # Stamp before taking the lock so lock contention doesn't
+        # masquerade as rank arrival skew.
+        observed = comms.ENABLED and self.label is not None
+        t_arrive = time.monotonic() if observed else 0.0
+        stamps = launch_ms = None
         with self.cv:
             gen = self.generation
             self.slots[rank] = value
+            if observed:
+                self.stamps[rank] = t_arrive
+                if fingerprint is not None:
+                    self.fps[rank] = fingerprint
             if len(self.slots) == self.world_size:
+                stamps, fps = self.stamps, self.fps
+                self.stamps, self.fps = {}, {}
                 try:
-                    self.outcomes[gen] = (compute(dict(self.slots)), None)
+                    if len(fps) == self.world_size:
+                        comms.check_fingerprints(fps, group=self.label,
+                                                 seq=gen)
+                    t_launch = time.monotonic() if observed else 0.0
+                    result = compute(dict(self.slots))
+                    if observed:
+                        launch_ms = (time.monotonic() - t_launch) * 1e3
+                    self.outcomes[gen] = (result, None)
                 except BaseException as e:  # noqa: BLE001
                     self.outcomes[gen] = (None, e)
                 self.slots.clear()
@@ -79,13 +111,30 @@ class _Rendezvous:
                 if not self.cv.wait_for(lambda: self.generation > gen,
                                         timeout=timeout):
                     self.slots.pop(rank, None)
+                    self.stamps.pop(rank, None)
+                    self.fps.pop(rank, None)
                     raise TimeoutError(
                         f"collective rendezvous timed out at rank {rank} "
                         f"({len(self.slots)}/{self.world_size} arrived)")
             result, error = self.outcomes[gen]
-            if error is not None:
-                raise error
-            return result
+        # Ledger writes happen OUTSIDE the rendezvous critical section:
+        # they take the comms/perf locks, and every microsecond spent
+        # holding the condition variable extends the window in which the
+        # other ranks stay parked (and, under the GIL, stretches the
+        # whole group's op latency).
+        if observed and stamps is not None and len(stamps) == self.world_size:
+            first = min(stamps.values())
+            comms.record_arrivals(
+                self.label, {r: t - first for r, t in stamps.items()},
+                self.world_size)
+        if error is not None:
+            raise error
+        if observed and perf.ENABLED:
+            if launch_ms is not None:
+                perf.observe("collective.launch", launch_ms)
+            perf.observe("collective.collect",
+                         (time.monotonic() - t_arrive) * 1e3)
+        return result
 
 
 class XLAGroup:
@@ -134,8 +183,10 @@ class XLAGroup:
 class XLAGroupShared:
     """State shared by all ranks of one group in this process."""
 
-    def __init__(self, world_size: int, devices: Optional[List] = None):
+    def __init__(self, world_size: int, devices: Optional[List] = None,
+                 label: str = "default"):
         self.world_size = world_size
+        self.label = label
         devs = devices if devices is not None else jax.devices()
         # Fold ranks onto devices round-robin when ranks > devices.
         self.rank_devices = [devs[i % len(devs)] for i in range(world_size)]
@@ -144,7 +195,7 @@ class XLAGroupShared:
             self.mesh = Mesh(np.array(self.rank_devices), ("ranks",))
         else:
             self.mesh = None
-        self._rdv = _Rendezvous(world_size)
+        self._rdv = _Rendezvous(world_size, label=label)
         self._p2p: Dict[tuple, _Rendezvous] = {}
         self._p2p_lock = threading.Lock()
         self._compiled: Dict[tuple, Callable] = {}
@@ -159,11 +210,18 @@ class XLAGroupShared:
 
     def collective(self, rank: int, tensor, op_desc: tuple) -> Dict[int, Any]:
         tensor = jnp.asarray(tensor)
+        # Raw-tuple fingerprint: (op_desc, shape, dtype) compares by
+        # value; stringifying enum/dtype per op costs more than the rest
+        # of the ledger combined, so it only happens in the divergence
+        # error message (the cross-process path, which must publish
+        # JSON-safe fingerprints, uses comms.fingerprint instead).
+        fp = ((op_desc, tuple(tensor.shape), tensor.dtype)
+              if comms.ENABLED else None)
 
         def compute(slots: Dict[int, Any]) -> Dict[int, Any]:
             return self._run_group_op(slots, op_desc)
 
-        return self._rdv.run(rank, tensor, compute)
+        return self._rdv.run(rank, tensor, compute, fingerprint=fp)
 
     # -- the single fused program for the whole group -------------------------
 
@@ -288,7 +346,9 @@ class XLAGroupShared:
             key = (src, dst)
             rdv = self._p2p.get(key)
             if rdv is None:
-                rdv = _Rendezvous(2)
+                # label=None: pair rendezvous carry asymmetric values by
+                # design, so no fingerprint check and no skew attribution.
+                rdv = _Rendezvous(2, label=None)
                 self._p2p[key] = rdv
             return rdv
 

@@ -37,6 +37,7 @@ from ray_tpu.collective.types import ReduceOp
 logger = logging.getLogger("ray_tpu")
 
 P2P_NS = b"tplane-p2p"
+COMMS_NS = b"tplane-comms"
 
 
 def _np_dtype(name: str):
@@ -79,6 +80,10 @@ class XLAProcessGroup:
         self._local_lead = by_proc[jax.process_index()]
         self.mesh = Mesh(np.array(self._leads), ("p",))
         self._p2p_seq: Dict[tuple, int] = {}
+        # Collective sequence number: uniform across ranks because every
+        # rank must issue the same ops in the same order (the contract
+        # the comms fingerprint check enforces at runtime).
+        self._comms_seq = 0
         self._programs: Dict[tuple, Any] = {}  # per-instance, dies with us
         self._publish_p2p_addr()  # bulk p2p reachability (best-effort)
 
@@ -126,25 +131,130 @@ class XLAProcessGroup:
     def _local_value(arr):
         return jnp.asarray(arr.addressable_data(0))
 
+    # -- comms plane (fingerprint exchange + arrival skew over the KV) --------
+
+    def _comms_pre(self, op: str, x) -> Optional[tuple]:
+        """Publish this rank's (op, shape, dtype) fingerprint + arrival
+        stamp for the next collective and cross-check rank 0's before
+        launching.  A divergent rank raises CollectiveDivergenceError
+        with both fingerprints *pre-launch* — the cross-process face of
+        the ``_Rendezvous`` check, where the alternative is the whole
+        group hanging inside the runtime.  Waiting for rank 0's key adds
+        no critical-path time: the key lands before rank 0 enters the
+        very collective we are about to block on anyway."""
+        from ray_tpu.observability import comms
+        seq = self._comms_seq
+        self._comms_seq += 1
+        if not comms.ENABLED:
+            return None
+        import json
+        from ray_tpu._private import clocksync
+        fp = comms.fingerprint(op, x.shape, x.dtype)
+        ctx = (seq, time.monotonic())
+        try:
+            kv = self._kv()
+        except RuntimeError:
+            return ctx  # no state service: phase timings only
+        base = f"{self.group_name}/fp/{seq}"
+        # Stamps ride the server timebase so skew compares across hosts.
+        rec = json.dumps({"fp": [fp[0], list(fp[1]), fp[2]],
+                          "t": clocksync.to_server_s(time.time())})
+        try:
+            kv.kv_put(f"{base}/{self.rank}".encode(), rec.encode(),
+                      overwrite=True, namespace=COMMS_NS)
+        except Exception as e:
+            logger.debug("comms fingerprint publish failed: %s", e)
+            return ctx
+        if self.rank != 0:
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                try:
+                    raw = kv.kv_get(f"{base}/0".encode(),
+                                    namespace=COMMS_NS)
+                except Exception:  # raylint: allow(swallow) telemetry degrades, the collective itself must not
+                    return ctx
+                if raw is not None:
+                    other = json.loads(raw.decode())["fp"]
+                    theirs = (other[0], tuple(other[1]), other[2])
+                    comms.check_fingerprints({0: theirs, self.rank: fp},
+                                             group=self.group_name, seq=seq)
+                    break
+                # raylint: allow(bare-retry) deadline-bounded KV poll for a peer's key, not a failure retry: backoff would delay the pre-launch divergence check
+                time.sleep(0.005)
+        return ctx
+
+    def _comms_post(self, ctx: Optional[tuple]) -> None:
+        """Launch-phase timing, plus (rank 0) arrival-skew collection: op
+        completion implies every rank launched, which implies every rank
+        published its stamp — so all keys are present to read, convert
+        to skew-after-first-arrival, record, and delete."""
+        if ctx is None:
+            return
+        from ray_tpu.observability import comms, perf
+        if not comms.ENABLED:
+            return
+        seq, t_launch = ctx
+        if perf.ENABLED:
+            perf.observe("collective.launch",
+                         (time.monotonic() - t_launch) * 1e3)
+        if self.rank != 0:
+            return
+        import json
+        try:
+            kv = self._kv()
+        except RuntimeError:
+            return
+        base = f"{self.group_name}/fp/{seq}"
+        stamps: Dict[int, float] = {}
+        try:
+            for r in range(self.world_size):
+                key = f"{base}/{r}".encode()
+                raw = kv.kv_get(key, namespace=COMMS_NS)
+                if raw is not None:
+                    stamps[r] = float(json.loads(raw.decode())["t"])
+                kv.kv_del(key, namespace=COMMS_NS)
+        except Exception as e:
+            logger.debug("comms stamp collect failed: %s", e)
+            return
+        if len(stamps) == self.world_size:
+            first = min(stamps.values())
+            comms.record_arrivals(self.group_name,
+                                  {r: t - first for r, t in stamps.items()},
+                                  self.world_size)
+
     # -- ops (every process must call, same order) ---------------------------
 
     def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
-        out = self._program("allreduce", op, 0)(self._stacked(tensor))
-        return self._local_value(out)
+        x = jnp.asarray(tensor)
+        ctx = self._comms_pre(f"allreduce:{op}", x)
+        out = self._program("allreduce", op, 0)(self._stacked(x))
+        val = self._local_value(out)
+        self._comms_post(ctx)
+        return val
 
     def reduce(self, tensor, root_rank: int = 0, op: ReduceOp = ReduceOp.SUM):
+        x = jnp.asarray(tensor)
+        ctx = self._comms_pre(f"reduce:{op}:{root_rank}", x)
         out = self._local_value(
-            self._program("reduce", op, 0)(self._stacked(tensor)))
-        return out if self.rank == root_rank else jnp.asarray(tensor)
+            self._program("reduce", op, 0)(self._stacked(x)))
+        self._comms_post(ctx)
+        return out if self.rank == root_rank else x
 
     def broadcast(self, tensor, root_rank: int = 0):
-        out = self._program("broadcast", None, root_rank)(
-            self._stacked(tensor))
-        return self._local_value(out)
+        x = jnp.asarray(tensor)
+        ctx = self._comms_pre(f"broadcast:{root_rank}", x)
+        out = self._program("broadcast", None, root_rank)(self._stacked(x))
+        val = self._local_value(out)
+        self._comms_post(ctx)
+        return val
 
     def allgather(self, tensor):
-        out = self._program("allgather", None, 0)(self._stacked(tensor))
-        return self._local_value(out)
+        x = jnp.asarray(tensor)
+        ctx = self._comms_pre("allgather", x)
+        out = self._program("allgather", None, 0)(self._stacked(x))
+        val = self._local_value(out)
+        self._comms_post(ctx)
+        return val
 
     def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
         """Each rank contributes a tensor whose leading dim divides into
@@ -156,10 +266,13 @@ class XLAProcessGroup:
                 f"reducescatter leading dim {x.shape[0]} not divisible by "
                 f"world size {self.world_size}")
         chunk = x.shape[0] // self.world_size
+        ctx = self._comms_pre(f"reducescatter:{op}", x)
         chunks = x.reshape((self.world_size, chunk) + x.shape[1:])
         arr = self._stacked(chunks)  # (world, world, chunk...)
         out = self._program("reducescatter", op, 0)(arr)
-        return self._local_value(out)[0]
+        val = self._local_value(out)[0]
+        self._comms_post(ctx)
+        return val
 
     def barrier(self):
         self.allreduce(jnp.zeros((), jnp.int32))

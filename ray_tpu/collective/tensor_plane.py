@@ -39,6 +39,32 @@ KV_NS = b"tplane"
 _lock = threading.Lock()
 _active_plane: Optional[dict] = None  # {"group", "epoch", "world", "rank"}
 
+_epoch_gauge = None
+
+
+def _mark(event: str, group: str, epoch: int, **args) -> None:
+    """Epoch lifecycle breadcrumb: a trace instant (when tracing is on)
+    plus the ``tplane_epoch`` gauge, so a doctor correlating collective
+    stalls can see exactly when a plane formed, re-formed, or went away
+    (epoch -1).  Re-forms used to vanish silently."""
+    global _epoch_gauge
+    try:
+        import ray_tpu.observability as _obs
+        _obs.instant(f"tplane:{event}", cat="comms", group=group,
+                     epoch=epoch, **args)
+        if _epoch_gauge is None:
+            from ray_tpu.observability.metric_names import TPLANE_EPOCH_GAUGE
+            from ray_tpu.util import metrics
+            _epoch_gauge = metrics.Gauge(
+                TPLANE_EPOCH_GAUGE,
+                "active tensor-plane epoch per group (-1 once shut down)",
+                ("group",))
+        # Bounded cardinality: tag is the collective group name, a small
+        # application-chosen set, never a per-task or per-object id.
+        _epoch_gauge.set(float(epoch), tags={"group": group})
+    except Exception:
+        logger.debug("tplane lifecycle mark failed", exc_info=True)
+
 
 def _runtime_and_kv(runtime=None):
     """The distributed runtime + its state-service KV."""
@@ -93,6 +119,9 @@ def init_tensor_plane(group_name: str, world_size: int, rank: int,
                         f"rank {_active_plane['rank']}, not {rank}")
                 return dict(_active_plane)
             # Older (or different) plane: leave it before rejoining.
+            _mark("reform", group_name, epoch,
+                  old_group=_active_plane["group"],
+                  old_epoch=_active_plane["epoch"])
             try:
                 jax.distributed.shutdown()
             except Exception:
@@ -156,6 +185,8 @@ def init_tensor_plane(group_name: str, world_size: int, rank: int,
              "global_devices": len(jax.devices())}
     with _lock:
         _active_plane = plane
+    _mark("join", group_name, epoch, rank=rank, world=world_size,
+          devices=plane["global_devices"])
     logger.info("tensor plane %s@%d up: rank %d/%d, %d global devices",
                 group_name, epoch, rank, world_size,
                 plane["global_devices"])
@@ -168,8 +199,10 @@ def shutdown_tensor_plane():
         global _active_plane
         if _active_plane is None:
             return
+        gone = _active_plane
         try:
             jax.distributed.shutdown()
         except Exception:
             logger.debug("jax.distributed.shutdown failed", exc_info=True)
         _active_plane = None
+    _mark("shutdown", gone["group"], -1, last_epoch=gone["epoch"])

@@ -44,6 +44,12 @@ Endpoints:
                       collective_wait/ckpt_stall/restart_downtime/idle)
                       merged into per-job category totals +
                       ``goodput_pct``, degrading with ``missing_hosts``
+  /api/comms        — comms-plane federation: per-node collective
+                      ledgers (per-group op bytes/duration/algbw/busbw,
+                      per-rank arrival-skew histograms, fingerprint
+                      mismatches, the StripedTransfer link matrix)
+                      merged exactly, plus derived laggard-rank skew
+                      flags and link outliers
   /api/profile?host=X&seconds=N
                     — federated sampling-profiler output (collapsed
                       stacks + pprof-shaped JSON). seconds=0 returns
@@ -370,6 +376,31 @@ class DashboardHead:
                 "categories": list(goodput_mod.CATEGORIES),
                 "jobs": jobs, "nodes": nodes, "missing_hosts": missing}
 
+    # -- comms ledger ----------------------------------------------------
+    def _comms(self) -> dict:
+        """Cluster comms plane: each node's collective ledger (the
+        ``"comms"`` payload riding the federated metric snapshots)
+        merged exactly — bytes/seconds/bucket-counts add, bandwidths
+        recomputed from the sums — plus the derived attribution the CLI
+        and doctor consume: laggard-rank skew flags and link-matrix
+        outliers. Per-node ledgers stay visible; unreachable daemons
+        degrade into ``missing_hosts``."""
+        from ray_tpu.observability import comms as comms_mod
+        snaps, missing = self._metric_snapshots()
+        nodes = {}
+        for node, fams in snaps.items():
+            payload = comms_mod.extract_comms(fams)
+            if payload:
+                nodes[node] = payload
+        merged = comms_mod.merge_payloads(nodes.values())
+        return {"ts": time.time(),
+                "groups": merged["groups"], "links": merged["links"],
+                "recent": merged["recent"], "bounds": merged["bounds"],
+                "skew_flags": comms_mod.skew_flags(
+                    merged["groups"], bounds=merged["bounds"]),
+                "link_flags": comms_mod.link_flags(merged["links"]),
+                "nodes": nodes, "missing_hosts": missing}
+
     def _profile_snapshots(self, host: str = "") -> "tuple[dict, list]":
         """({host_label: cumulative profile}, missing) — the head's own
         sampler plus each alive daemon's (NODE_DEBUG include_stacks
@@ -526,6 +557,8 @@ class DashboardHead:
                         self._json(head._perf())
                     elif route == "/api/goodput":
                         self._json(head._goodput())
+                    elif route == "/api/comms":
+                        self._json(head._comms())
                     elif route == "/api/profile":
                         self._json(head._profile(
                             q.get("host", [""])[0],

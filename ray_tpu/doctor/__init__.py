@@ -350,9 +350,77 @@ def _goodput_reports(collected: dict,
     return {"jobs": jobs, "drift": drift}
 
 
+def _comms_reports(collected: dict, baseline: Optional[dict] = None,
+                   factor: float = 3.0) -> dict:
+    """Comms-plane section: every node's ``"comms"`` payload (collective
+    op ledger, per-rank arrival-skew histograms, link matrix) merged
+    exactly, then attributed — ``skew_flags`` names a laggard rank whose
+    p95 arrival skew is >= ``factor`` x the median of its peers,
+    ``link_flags`` names a peer link with failovers or an outlier GB/s.
+
+    ``baseline`` (the ``--comms-baseline`` JSON: ``{group: {"<op>_gbps":
+    floor, "skew_p95_ms": ceiling, "mismatches": ceiling,
+    "tolerance": 1.0}}``) turns the section into a bandwidth/skew SLO
+    gate: ``*_gbps`` budgets are floors on the merged algorithm
+    bandwidth (the ``allreduce_f32_gbps``-style gate the quantized-
+    collective roadmap item compares against), ``skew_p95_ms`` and
+    ``mismatches`` are ceilings.  Unknown groups in the baseline are
+    ignored (a gate for a group that never ran is not a drift).  Flags
+    and drift all count as issues."""
+    from ray_tpu.observability import comms as comms_mod
+    cluster = collected.get("cluster") or {}
+    snaps = (cluster.get("metrics") or {}).get("snapshots") or {}
+    payloads = []
+    for families in snaps.values():
+        p = comms_mod.extract_comms(families or [])
+        if p:
+            payloads.append(p)
+    merged = comms_mod.merge_payloads(payloads)
+    groups, bounds = merged["groups"], merged["bounds"]
+    skew = comms_mod.skew_flags(groups, factor=factor, bounds=bounds)
+    links = comms_mod.link_flags(merged["links"], factor=factor)
+    report = comms_mod.skew_report(groups, bounds=bounds)
+    drift = []
+    for group, budgets in (baseline or {}).items():
+        rec = groups.get(group)
+        if rec is None:
+            continue
+        tolerance = float(budgets.get("tolerance", 1.0))
+        for key, base in budgets.items():
+            if key == "tolerance":
+                continue
+            if key.endswith("_gbps"):
+                op = key[:-5]
+                got = float(((rec.get("ops") or {}).get(op) or {})
+                            .get("algbw_gbps", 0.0))
+                if got < float(base) * tolerance:
+                    drift.append({"group": group, "metric": key,
+                                  "got_gbps": round(got, 3),
+                                  "baseline_gbps": float(base),
+                                  "tolerance": tolerance})
+            elif key == "skew_p95_ms":
+                ranks = report.get(group) or {}
+                got = max((s["p95_ms"] for s in ranks.values()),
+                          default=0.0)
+                if got > float(base) * tolerance:
+                    drift.append({"group": group, "metric": key,
+                                  "got_ms": round(got, 3),
+                                  "baseline_ms": float(base),
+                                  "tolerance": tolerance})
+            elif key == "mismatches":
+                got = int(rec.get("mismatches") or 0)
+                if got > float(base) * tolerance:
+                    drift.append({"group": group, "metric": key,
+                                  "got": got, "baseline": float(base),
+                                  "tolerance": tolerance})
+    return {"groups": groups, "links": merged["links"], "skew": report,
+            "skew_flags": skew, "link_flags": links, "drift": drift}
+
+
 def diagnose(collected: dict, straggler_factor: float = 3.0,
              perf_baseline: Optional[dict] = None,
-             goodput_baseline: Optional[dict] = None) -> dict:
+             goodput_baseline: Optional[dict] = None,
+             comms_baseline: Optional[dict] = None) -> dict:
     """Turn a :func:`collect` result into findings. Machine-readable;
     :func:`render_text` prints the same structure for humans."""
     crashes = _crash_reports(_all_bundles(collected))
@@ -401,16 +469,22 @@ def diagnose(collected: dict, straggler_factor: float = 3.0,
     perf_section = _perf_reports(collected, baseline=perf_baseline)
     goodput_section = _goodput_reports(collected,
                                        baseline=goodput_baseline)
+    comms_section = _comms_reports(collected, baseline=comms_baseline,
+                                   factor=straggler_factor)
     n_issues = (len(crashes) + len(hangs) + len(stragglers) +
                 len(missing) + len(dead_nodes) +
                 len(perf_section["drift"]) +
-                len(goodput_section["drift"]))
+                len(goodput_section["drift"]) +
+                len(comms_section["skew_flags"]) +
+                len(comms_section["link_flags"]) +
+                len(comms_section["drift"]))
     return {
         "ts": collected.get("ts"),
         "healthy": n_issues == 0,
         "num_issues": n_issues,
         "perf": perf_section,
         "goodput": goodput_section,
+        "comms": comms_section,
         "crashes": crashes,
         "hangs": hangs,
         "stragglers": stragglers,
@@ -569,6 +643,47 @@ def render_text(report: dict) -> str:
                 lines.append(
                     f"  {d['job']}.{d['metric']}: {d['got_s']}s > "
                     f"{d['baseline_s']}s x{d['tolerance']}")
+    comms_section = report.get("comms") or {}
+    cgroups = comms_section.get("groups") or {}
+    if cgroups:
+        lines.append("")
+        lines.append(f"COMMS ({len(cgroups)} group(s), cluster-merged)")
+        for gname, rec in sorted(cgroups.items()):
+            for op, o in sorted((rec.get("ops") or {}).items()):
+                lines.append(
+                    f"  {gname}.{op}: n={o.get('count', 0)} "
+                    f"{o.get('bytes', 0) / 1e6:.1f}MB "
+                    f"algbw={o.get('algbw_gbps', 0.0):.2f}GB/s "
+                    f"busbw={o.get('busbw_gbps', 0.0):.2f}GB/s")
+            if rec.get("mismatches"):
+                lines.append(f"  {gname}: {rec['mismatches']} collective "
+                             "fingerprint mismatch(es) — divergent ranks")
+        for fl in comms_section.get("skew_flags") or []:
+            lines.append(
+                f"  LAGGARD {fl['group']} rank {fl['rank']}: arrival-skew "
+                f"p95 {fl['p95_ms']:.1f}ms vs peer median "
+                f"{fl['median_ms']:.1f}ms ({fl['samples']} samples)")
+        for fl in comms_section.get("link_flags") or []:
+            lines.append(
+                f"  LINK {fl['peer']} ({fl['consumer']}): {fl['why']}")
+    cdrift = comms_section.get("drift") or []
+    if cdrift:
+        lines.append("")
+        lines.append(f"COMMS DRIFT ({len(cdrift)}) — bandwidth/skew "
+                     "beyond recorded budget")
+        for d in cdrift:
+            if "got_gbps" in d:
+                lines.append(
+                    f"  {d['group']}.{d['metric']}: {d['got_gbps']}GB/s < "
+                    f"{d['baseline_gbps']}GB/s x{d['tolerance']}")
+            elif "got_ms" in d:
+                lines.append(
+                    f"  {d['group']}.{d['metric']}: {d['got_ms']}ms > "
+                    f"{d['baseline_ms']}ms x{d['tolerance']}")
+            else:
+                lines.append(
+                    f"  {d['group']}.{d['metric']}: {d['got']} > "
+                    f"{d['baseline']} x{d['tolerance']}")
     missing = report.get("unreachable_hosts") or []
     if missing:
         lines.append("")
@@ -633,6 +748,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "({job: {goodput_pct: floor, "
                              "restart_downtime_s: ceiling, tolerance: "
                              "1.0}}); budget violations count as issues")
+    parser.add_argument("--comms-baseline", default=None,
+                        help="JSON file of per-group comms budgets "
+                             "({group: {allreduce_gbps: floor, "
+                             "skew_p95_ms: ceiling, mismatches: ceiling, "
+                             "tolerance: 1.0}}); budget violations count "
+                             "as issues")
     args = parser.parse_args(argv)
     perf_baseline = None
     if args.perf_baseline:
@@ -642,6 +763,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.goodput_baseline:
         with open(args.goodput_baseline) as f:
             goodput_baseline = json.load(f)
+    comms_baseline = None
+    if args.comms_baseline:
+        with open(args.comms_baseline) as f:
+            comms_baseline = json.load(f)
     try:
         collected = collect(flight_dir=args.flight_dir,
                             address=args.address,
@@ -649,7 +774,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         report = diagnose(collected,
                           straggler_factor=args.straggler_factor,
                           perf_baseline=perf_baseline,
-                          goodput_baseline=goodput_baseline)
+                          goodput_baseline=goodput_baseline,
+                          comms_baseline=comms_baseline)
     except Exception as e:  # noqa: BLE001
         print(f"doctor: collection failed: {e!r}", file=sys.stderr)
         return 2

@@ -66,4 +66,15 @@ PERF_HISTOGRAMS = frozenset({
     "jit.compile",
     # drain / lifecycle
     "drain.migrate",
+    # comms plane (collective rendezvous phases; observability/comms.py)
+    "collective.op",       # full API-layer op duration (collective.py seam)
+    "collective.launch",   # last-arrival compute / compiled-program run
+    "collective.collect",  # per-rank blocked time from arrival to result
 })
+
+# Comms-plane sample families.  Not literal-checked by a lint rule the
+# way perf.observe names are — they are declared here so the exporters
+# (observability/comms.py, collective/tensor_plane.py) and their
+# consumers (dashboard head, doctor, tests) share one spelling.
+COMMS_FAMILY = "raytpu_comms_bytes"
+TPLANE_EPOCH_GAUGE = "tplane_epoch"

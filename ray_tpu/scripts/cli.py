@@ -112,6 +112,8 @@ def cmd_doctor(args):
         argv += ["--perf-baseline", args.perf_baseline]
     if args.goodput_baseline:
         argv += ["--goodput-baseline", args.goodput_baseline]
+    if args.comms_baseline:
+        argv += ["--comms-baseline", args.comms_baseline]
     sys.exit(doctor_main(argv))
 
 
@@ -208,15 +210,81 @@ def _render_goodput(payload) -> str:
     return "\n".join(lines)
 
 
+def _render_comms(payload) -> str:
+    """Render an ``/api/comms`` payload: the per-group op ledger (count,
+    bytes, algbw/busbw), the per-rank arrival-skew table with laggards
+    marked, then the peer link matrix with outliers marked."""
+    from ray_tpu.observability import comms as comms_mod
+    lines = ["%-14s %-14s %7s %10s %10s %10s" % (
+        "GROUP", "OP", "COUNT", "MB", "ALGBW_GB/S", "BUSBW_GB/S")]
+    groups = payload.get("groups") or {}
+    for gname, rec in sorted(groups.items()):
+        for op, o in sorted((rec.get("ops") or {}).items()):
+            lines.append("%-14s %-14s %7d %10.1f %10.2f %10.2f" % (
+                gname, op, int(o.get("count", 0)),
+                float(o.get("bytes", 0)) / 1e6,
+                float(o.get("algbw_gbps", 0.0)),
+                float(o.get("busbw_gbps", 0.0))))
+        if rec.get("mismatches"):
+            lines.append(f"  {gname}: {rec['mismatches']} fingerprint "
+                         "mismatch(es) — divergent collective submissions")
+    if len(lines) == 1:
+        lines.append("(no collective ops recorded yet)")
+    skew = comms_mod.skew_report(groups, bounds=payload.get("bounds"))
+    flagged = {(f["group"], f["rank"])
+               for f in payload.get("skew_flags") or []}
+    if skew:
+        lines.append("")
+        lines.append("%-14s %-6s %9s %9s %9s" % (
+            "GROUP", "RANK", "ARRIVALS", "SKEW_P50", "SKEW_P95"))
+        for gname, ranks in sorted(skew.items()):
+            for rank, s in sorted(ranks.items(), key=lambda kv: kv[0]):
+                lines.append("%-14s %-6s %9d %8.2fms %8.2fms%s" % (
+                    gname, rank, int(s["count"]), s["p50_ms"], s["p95_ms"],
+                    "  <-- LAGGARD (>=3x peer median p95)"
+                    if (gname, rank) in flagged else ""))
+    links = payload.get("links") or {}
+    if links:
+        flagged_links = {f["link"] for f in payload.get("link_flags") or []}
+        lines.append("")
+        lines.append("%-22s %-14s %8s %8s %8s %9s" % (
+            "PEER", "CONSUMER", "GB/S", "CHUNKS", "RETRIES", "FAILOVERS"))
+        for key, rec in sorted(links.items()):
+            peer, _, consumer = key.partition("|")
+            lines.append("%-22s %-14s %8.2f %8d %8d %9d%s" % (
+                peer, consumer, float(rec.get("gbps", 0.0)),
+                int(rec.get("chunks", 0)), int(rec.get("retries", 0)),
+                int(rec.get("failovers", 0)),
+                "  <-- DEGRADED" if key in flagged_links else ""))
+    missing = payload.get("missing_hosts") or []
+    if missing:
+        lines.append(f"({len(missing)} unreachable host(s) omitted)")
+    return "\n".join(lines)
+
+
 def cmd_top(args):
     """Live per-node/per-subsystem latency table off the perf plane
-    (``--goodput``: the per-job wall-clock attribution ledger instead)."""
+    (``--goodput``: the per-job wall-clock attribution ledger;
+    ``--comms``: the collective telemetry + link matrix instead)."""
     import time
     from ray_tpu._private.config import _config
     from ray_tpu.dashboard.head import DashboardHead
     subsystems = set(args.subsystem) if args.subsystem else None
     head = DashboardHead(args.address)
     try:
+        if args.comms:
+            if args.json:
+                print(json.dumps(head._comms(), indent=2))
+                return
+            interval = args.interval or \
+                float(_config.get("perf_top_interval_s"))
+            while True:
+                payload = head._comms()
+                print("\x1b[2J\x1b[H", end="")
+                print(f"ray-tpu top --comms — cluster {args.address} "
+                      f"(refresh {interval:.1f}s, Ctrl-C to quit)")
+                print(_render_comms(payload))
+                time.sleep(interval)
         if args.goodput:
             if args.json:
                 print(json.dumps(head._goodput(), indent=2))
@@ -305,6 +373,10 @@ def main(argv=None):
     hp.add_argument("--goodput-baseline", default=None,
                     help="JSON goodput budgets (per-job goodput_pct "
                          "floors); drift counts as issues")
+    hp.add_argument("--comms-baseline", default=None,
+                    help="JSON comms budgets (per-group <op>_gbps floors, "
+                         "skew_p95_ms/mismatches ceilings); drift counts "
+                         "as issues")
     hp.set_defaults(fn=cmd_doctor)
     gp = sub.add_parser("drain",
                         help="gracefully drain a node (workload migration)")
@@ -329,6 +401,10 @@ def main(argv=None):
     op.add_argument("--goodput", action="store_true",
                     help="show the per-job goodput ledger (/api/goodput) "
                          "instead of latency quantiles")
+    op.add_argument("--comms", action="store_true",
+                    help="show collective telemetry, rank arrival skew "
+                         "and the peer link matrix (/api/comms) instead "
+                         "of latency quantiles")
     op.set_defaults(fn=cmd_top)
     dp = sub.add_parser("dashboard",
                         help="serve the cluster dashboard UI")

@@ -94,6 +94,21 @@ RAY_TPU_CHAOS="20260805:checkpoint.write@2%4=delay(0.01);rpc.client.send@3%7=del
 JAX_PLATFORMS=cpu \
 python -m pytest tests/test_goodput.py -q
 
+echo "== comms gate (collective telemetry + skew attribution under delay-only chaos) =="
+# The comms plane must keep its books when collective timing actually
+# moves: a fixed delay-only schedule on the collective entry seam (plus
+# RPC sends) stretches the very rendezvous intervals the ledger stamps,
+# and every test_comms assertion — algbw/busbw derivation, arrival-skew
+# attribution naming the laggard rank, fingerprint divergence raising
+# instead of hanging, link-matrix flags, federation merge math, doctor
+# COMMS drift — must hold under the perturbed timings. The ProcessCluster
+# drill self-skips without the C++ state service; bench_micro's comms
+# rows (overhead budget + skew-detector floor) gate below with the rest
+# of BENCH_MICRO.json.
+RAY_TPU_CHAOS="20260806:collective.op@2%5=delay(0.01);rpc.client.send@3%7=delay(0.005)" \
+JAX_PLATFORMS=cpu \
+python -m pytest tests/test_comms.py -q
+
 echo "== forensics gate (crash bundles sealed + doctor reads them back) =="
 # Hard-death drill: the forensics suite kills processes mid-task — via a
 # deterministic chaos exit schedule (hooks run) and via raw SIGKILL (no

@@ -1,0 +1,517 @@
+"""Communication observability plane: the comms ledger and its surfaces.
+
+Covers the per-op collective ledger (bytes/duration -> algbw/busbw,
+NCCL-tests factors), rendezvous arrival-skew attribution (the laggard
+rank is *named*, not averaged away), the runtime collective-fingerprint
+check (divergence raises with both fingerprints instead of hanging —
+the runtime mirror of lint R12), the StripedTransfer peer link matrix,
+exact federation math (``merge_payloads`` / ``/api/comms``), the
+``ray-tpu top --comms`` and doctor ``--comms-baseline`` surfaces, the
+tensor-plane epoch gauge, and a ProcessCluster chaos drill (self-skips
+without the C++ state service) where a rank-filtered collective delay
+must be attributed to that rank end-to-end.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.observability import comms
+
+
+@pytest.fixture(autouse=True)
+def _comms_state():
+    was = comms.ENABLED
+    comms.enable()
+    comms.reset()
+    yield
+    comms.reset()
+    if not was:
+        comms.disable()
+
+
+def _require_state_service():
+    """ProcessCluster needs the C++ state service (protoc + g++)."""
+    from ray_tpu._native.build import build_state_service
+    try:
+        build_state_service()
+    except Exception as e:
+        pytest.skip(f"state service unavailable: {e}")
+
+
+# -- op ledger ---------------------------------------------------------------
+
+def test_record_op_derives_algbw_and_busbw():
+    # 8 MiB allreduce in 8 ms: algbw = 8MiB / 8ms ~ 1.049 GB/s;
+    # busbw at world=4 applies the nccl-tests 2(n-1)/n factor (1.5x).
+    comms.record_op("g", "allreduce", 8 << 20, "float32", 0.008,
+                    world_size=4)
+    g = comms.snapshot()["groups"]["g"]
+    rec = g["ops"]["allreduce"]
+    assert rec["count"] == 1 and rec["bytes"] == 8 << 20
+    assert rec["algbw_gbps"] == pytest.approx((8 << 20) / 0.008 / 1e9)
+    assert rec["busbw_gbps"] == pytest.approx(rec["algbw_gbps"] * 1.5)
+    assert g["world_size"] == 4 and g["seq"] == 1
+    # non-factored op: busbw == algbw
+    comms.record_op("g", "broadcast", 1 << 20, "float32", 0.004)
+    bc = comms.snapshot()["groups"]["g"]["ops"]["broadcast"]
+    assert bc["busbw_gbps"] == pytest.approx(bc["algbw_gbps"])
+    # the recent ring carries (group, seq, op, bytes, dtype, ms)
+    recent = comms.snapshot()["recent"]
+    assert recent[-1][0] == "g" and recent[-1][2] == "broadcast"
+
+
+def test_recent_ring_is_bounded():
+    for i in range(200):
+        comms.record_op("g", "allreduce", 8, "float32", 1e-6)
+    snap = comms.snapshot()
+    assert len(snap["recent"]) == comms._RECENT_CAP
+    assert snap["groups"]["g"]["ops"]["allreduce"]["count"] == 200
+
+
+# -- arrival skew ------------------------------------------------------------
+
+def test_arrival_skew_names_the_laggard_rank():
+    # rank 1 arrives ~50ms after rank 0 at every rendezvous
+    for _ in range(5):
+        comms.record_arrivals("g", {0: 0.0002, 1: 0.050}, world_size=2)
+    snap = comms.snapshot()
+    report = comms.skew_report(snap["groups"], bounds=snap["bounds"])
+    assert report["g"]["1"]["p95_ms"] >= 40.0
+    assert report["g"]["0"]["p95_ms"] <= 1.0
+    flags = comms.skew_flags(snap["groups"], bounds=snap["bounds"])
+    assert [(f["group"], f["rank"]) for f in flags] == [("g", "1")]
+    assert flags[0]["samples"] == 5
+    assert flags[0]["p95_ms"] >= 3.0 * flags[0]["median_ms"]
+
+
+def test_skew_flags_guards():
+    # below min_samples: no flag, however skewed
+    comms.record_arrivals("g", {0: 0.0, 1: 0.050})
+    snap = comms.snapshot()
+    assert comms.skew_flags(snap["groups"], bounds=snap["bounds"]) == []
+    comms.reset()
+    # symmetric sub-millisecond jitter is noise, not a straggler
+    for _ in range(10):
+        comms.record_arrivals("g", {0: 0.0, 1: 0.0004})
+    snap = comms.snapshot()
+    assert comms.skew_flags(snap["groups"], bounds=snap["bounds"]) == []
+    # a single-rank group can have no laggard
+    comms.reset()
+    for _ in range(10):
+        comms.record_arrivals("solo", {0: 5.0})
+    snap = comms.snapshot()
+    assert comms.skew_flags(snap["groups"], bounds=snap["bounds"]) == []
+
+
+# -- fingerprint check -------------------------------------------------------
+
+def test_check_fingerprints_raises_with_both_fingerprints():
+    fp0 = comms.fingerprint("allreduce:SUM", (4, 4), "float32")
+    fp1 = comms.fingerprint("allreduce:SUM", (8,), "float32")
+    comms.check_fingerprints({0: fp0, 1: fp0}, group="g", seq=3)  # agree
+    with pytest.raises(comms.CollectiveDivergenceError) as ei:
+        comms.check_fingerprints({0: fp0, 1: fp1}, group="g", seq=4)
+    err = ei.value
+    assert err.group == "g" and err.seq == 4
+    assert err.fingerprint_a == fp0 and err.fingerprint_b == fp1
+    msg = str(err)
+    assert "(4, 4)" in msg and "(8,)" in msg and "R12" in msg
+    # the mismatch is counted into the group ledger for the doctor
+    assert comms.snapshot()["groups"]["g"]["mismatches"] == 1
+
+
+def test_threaded_group_divergence_raises_on_every_rank():
+    """Two ranks of a thread-shared CPU group submit different shapes:
+    both get the divergence error instead of a silently-wrong compute."""
+    from ray_tpu.collective.collective_group.cpu_group import CPUGroupShared
+    from ray_tpu.collective.types import ReduceOp
+    shared = CPUGroupShared(2, label="tdiv")
+    errs = {}
+
+    def run(rank, shape):
+        try:
+            shared.collective(rank, np.ones(shape), ("allreduce",
+                                                     ReduceOp.SUM))
+        except Exception as e:  # noqa: BLE001 — the divergence under test
+            errs[rank] = e
+
+    ts = [threading.Thread(target=run, args=(0, (4,))),
+          threading.Thread(target=run, args=(1, (8,)))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert set(errs) == {0, 1}
+    for e in errs.values():
+        assert isinstance(e, comms.CollectiveDivergenceError)
+
+
+def test_disabled_fast_path_is_a_noop():
+    comms.disable()
+    comms.record_op("g", "allreduce", 1 << 20, "float32", 0.001)
+    comms.record_arrivals("g", {0: 0.0, 1: 9.0})
+    comms.link_observe("peer", "object.fetch", nbytes=1, seconds=1.0)
+    # divergent fingerprints do not raise while the plane is off
+    comms.check_fingerprints({0: ("a", (1,), "f"), 1: ("b", (2,), "f")})
+    snap = comms.snapshot()
+    assert snap["groups"] == {} and snap["links"] == {}
+    assert comms.families() == []
+    comms.enable()
+
+
+# -- collective API instrumentation ------------------------------------------
+
+def _spawn_group(n, gname):
+    @ray_tpu.remote(num_cpus=0.1)
+    class Member:
+        def run(self, fn_name, *args, **kwargs):
+            from ray_tpu import collective as col
+            return getattr(col, fn_name)(*args, **kwargs)
+
+    actors = [Member.remote() for _ in range(n)]
+    from ray_tpu.collective import create_collective_group
+    create_collective_group(actors, n, list(range(n)), backend="cpu",
+                            group_name=gname)
+    return actors
+
+
+def test_collective_api_records_ops_and_arrivals(ray_start_regular):
+    actors = _spawn_group(2, "gapi")
+    for _ in range(3):
+        refs = [a.run.remote("allreduce", np.ones(1024), "gapi")
+                for a in actors]
+        ray_tpu.get(refs)
+    snap = comms.snapshot()
+    g = snap["groups"]["gapi"]
+    rec = g["ops"]["allreduce"]
+    assert rec["count"] == 6                      # 2 ranks x 3 ops
+    assert rec["bytes"] == 6 * 1024 * 8           # float64 tensors
+    assert g["world_size"] == 2
+    # every rendezvous stamped both ranks' arrivals
+    assert {r["arrivals"] for r in g["ranks"].values()} == {3}
+
+
+def test_collective_api_divergence_raises_not_hangs(ray_start_regular):
+    from ray_tpu.exceptions import TaskError
+    actors = _spawn_group(2, "gdiv")
+    refs = [actors[0].run.remote("allreduce", np.ones(4), "gdiv"),
+            actors[1].run.remote("allreduce", np.ones(8), "gdiv")]
+    with pytest.raises(TaskError, match="collective divergence"):
+        ray_tpu.get(refs, timeout=60)
+
+
+# -- link matrix -------------------------------------------------------------
+
+class _FakeClient:
+    closed = False
+
+
+class _FakePool:
+    def clients(self, address):
+        return [_FakeClient()]
+
+
+def test_striped_transfer_feeds_link_matrix():
+    from ray_tpu._private.transport import StripedTransfer
+
+    def submit(client, off, done_cb):
+        done_cb(None)
+
+    st = StripedTransfer(_FakePool(), "10.0.0.9:7000",
+                         consumer="object.fetch", streams=[_FakeClient()])
+    st.run([0, 1, 2, 3], submit)
+    links = comms.snapshot()["links"]
+    rec = links["10.0.0.9:7000|object.fetch"]
+    assert rec["chunks"] == 4 and rec["bytes"] > 0
+    assert rec["retries"] == 0 and rec["failovers"] == 0
+
+
+def test_striped_transfer_failover_recorded_and_flagged():
+    from ray_tpu._private.rpc import RpcConnectionError
+    from ray_tpu._private.transport import StripedTransfer
+    attempts = {}
+
+    def submit(client, off, done_cb):
+        attempts[off] = attempts.get(off, 0) + 1
+        if off == 1 and attempts[off] == 1:
+            done_cb(RpcConnectionError("stripe died"))
+        else:
+            done_cb(None)
+
+    st = StripedTransfer(_FakePool(), "10.0.0.9:7000",
+                         consumer="ckpt.restore", streams=[_FakeClient()])
+    st.run([0, 1], submit)
+    assert attempts[1] == 2
+    merged = comms.merge_payloads([comms.snapshot()])
+    rec = merged["links"]["10.0.0.9:7000|ckpt.restore"]
+    assert rec["retries"] == 1 and rec["failovers"] == 1
+    flags = comms.link_flags(merged["links"])
+    assert [f["link"] for f in flags] == ["10.0.0.9:7000|ckpt.restore"]
+    assert "failover" in flags[0]["why"]
+
+
+def test_link_flags_bandwidth_outlier():
+    # three rated links; one runs at ~1/500th of the others' GB/s
+    for peer, secs in (("a:1", 0.001), ("b:1", 0.001), ("c:1", 0.5)):
+        for _ in range(3):
+            comms.link_observe(peer, "object.fetch", nbytes=1 << 20,
+                               seconds=secs, chunks=1)
+    merged = comms.merge_payloads([comms.snapshot()])
+    flags = comms.link_flags(merged["links"])
+    assert [f["peer"] for f in flags] == ["c:1"]
+    assert "vs link median" in flags[0]["why"]
+    # a lone link is never an outlier of itself
+    assert comms.link_flags(
+        {"a:1|object.fetch": merged["links"]["c:1|object.fetch"]}) == []
+
+
+# -- federation --------------------------------------------------------------
+
+def test_merge_payloads_adds_exactly_and_rederives():
+    comms.record_op("g", "allreduce", 1 << 20, "float32", 0.002,
+                    world_size=2)
+    for _ in range(4):
+        comms.record_arrivals("g", {0: 0.0, 1: 0.040}, world_size=2)
+    comms.link_observe("p:1", "object.fetch", nbytes=1 << 20, seconds=0.01,
+                       chunks=1)
+    snap = json.loads(json.dumps(comms.snapshot()))  # a federation hop
+    merged = comms.merge_payloads([snap, snap])
+    g = merged["groups"]["g"]
+    assert g["ops"]["allreduce"]["count"] == 2
+    assert g["ops"]["allreduce"]["bytes"] == 2 << 20
+    # bandwidth is recomputed from summed bytes/seconds, not averaged
+    assert g["ops"]["allreduce"]["algbw_gbps"] == pytest.approx(
+        (2 << 20) / 0.004 / 1e9)
+    assert g["world_size"] == 2
+    assert g["ranks"]["1"]["arrivals"] == 8
+    assert sum(g["ranks"]["1"]["counts"]) == 8
+    assert merged["links"]["p:1|object.fetch"]["bytes"] == 2 << 20
+    # a doubled histogram still names the same laggard
+    flags = comms.skew_flags(merged["groups"], bounds=merged["bounds"])
+    assert [(f["group"], f["rank"]) for f in flags] == [("g", "1")]
+    # malformed node payloads are skipped, not fatal
+    again = comms.merge_payloads([None, "bogus", {"groups": {"g": 7}},
+                                  snap])
+    assert again["groups"]["g"]["ops"]["allreduce"]["count"] == 1
+
+
+def test_families_export_and_extract_roundtrip():
+    comms.record_op("g", "allgather", 2048, "int8", 0.001, world_size=4)
+    fams = comms.families()
+    assert len(fams) == 1 and fams[0]["type"] == "gauge"
+    assert fams[0]["name"] == comms.COMMS_FAMILY
+    (name, tags, value), = fams[0]["samples"]
+    assert dict(tags) == {"group": "g", "op": "allgather"}
+    assert value == 2048.0
+    # the raw payload survives a JSON federation hop untouched
+    wire = json.loads(json.dumps(fams))
+    payload = comms.extract_comms(wire)
+    assert payload["groups"]["g"]["ops"]["allgather"]["count"] == 1
+    assert comms.extract_comms([{"name": "x", "samples": []}]) is None
+    assert comms.extract_comms(None) is None
+
+
+def test_metrics_snapshot_carries_comms_family():
+    from ray_tpu.util import metrics
+    comms.record_op("g", "allreduce", 64, "float32", 0.001)
+    snap = metrics.snapshot()
+    assert any(f.get("name") == comms.COMMS_FAMILY for f in snap)
+
+
+def test_head_comms_merges_and_degrades():
+    """_comms merges per-node payloads, attributes skew, and surfaces
+    unreachable hosts without failing the endpoint."""
+    from ray_tpu.dashboard.head import DashboardHead
+    for _ in range(5):
+        comms.record_arrivals("g", {0: 0.0002, 1: 0.050}, world_size=2)
+    comms.record_op("g", "allreduce", 1 << 20, "float32", 0.002,
+                    world_size=2)
+    head = DashboardHead.__new__(DashboardHead)
+    fams = comms.families()
+    head._metric_snapshots = lambda: (
+        {"head": fams, "node:aa": fams, "node:bb": []}, ["node:cc"])
+    payload = head._comms()
+    assert payload["missing_hosts"] == ["node:cc"]
+    assert set(payload["nodes"]) == {"head", "node:aa"}
+    assert payload["groups"]["g"]["ops"]["allreduce"]["count"] == 2
+    assert [(f["group"], f["rank"]) for f in payload["skew_flags"]] == \
+        [("g", "1")]
+    assert payload["link_flags"] == []
+    assert payload["bounds"]
+
+
+# -- surfaces: top render / doctor -------------------------------------------
+
+def test_render_comms_table():
+    from ray_tpu.scripts.cli import _render_comms
+    for _ in range(5):
+        comms.record_arrivals("g", {0: 0.0002, 1: 0.050}, world_size=2)
+    comms.record_op("g", "allreduce", 8 << 20, "float32", 0.008,
+                    world_size=2)
+    comms.link_observe("p:1", "object.fetch", nbytes=1 << 20,
+                       seconds=0.001, chunks=4, retries=2, failovers=1)
+    merged = comms.merge_payloads([comms.snapshot()])
+    payload = dict(merged,
+                   skew_flags=comms.skew_flags(merged["groups"],
+                                               bounds=merged["bounds"]),
+                   link_flags=comms.link_flags(merged["links"]),
+                   missing_hosts=["node:dead"])
+    text = _render_comms(payload)
+    assert "ALGBW" in text and "BUSBW" in text
+    assert any("allreduce" in ln for ln in text.splitlines())
+    assert "LAGGARD" in text           # rank 1 marked in the skew table
+    assert "DEGRADED" in text          # the failover link marked
+    assert "1 unreachable host(s) omitted" in text
+    empty = _render_comms({"groups": {}, "links": {}})
+    assert "no collective ops recorded" in empty
+
+
+def test_doctor_comms_section_and_baseline_drift():
+    from ray_tpu import doctor
+    for _ in range(5):
+        comms.record_arrivals("g", {0: 0.0002, 1: 0.050}, world_size=2)
+    comms.record_op("g", "allreduce", 8 << 20, "float32", 0.008,
+                    world_size=2)
+    collected = {"ts": time.time(), "errors": [],
+                 "cluster": {"metrics": {"snapshots": {
+                     "head": comms.families()}}}}
+    loose = doctor._comms_reports(
+        collected, baseline={"g": {"allreduce_gbps": 0.001,
+                                   "skew_p95_ms": 1000.0,
+                                   "mismatches": 0.0}})
+    assert loose["drift"] == []
+    assert [(f["group"], f["rank"]) for f in loose["skew_flags"]] == \
+        [("g", "1")]
+    tight = doctor._comms_reports(
+        collected, baseline={"g": {"allreduce_gbps": 99.0,
+                                   "skew_p95_ms": 1.0,
+                                   "tolerance": 1.0}})
+    assert {d["metric"] for d in tight["drift"]} == \
+        {"allreduce_gbps", "skew_p95_ms"}
+    # unknown groups in the baseline are ignored, not phantom drift
+    assert doctor._comms_reports(
+        collected, baseline={"ghost": {"allreduce_gbps": 9.0}})["drift"] \
+        == []
+    report = doctor.diagnose(
+        collected, comms_baseline={"g": {"allreduce_gbps": 99.0}})
+    assert not report["healthy"]        # the skew flag alone is an issue
+    assert report["comms"]["drift"]
+    rendered = doctor.render_text(report)
+    assert "COMMS" in rendered and "COMMS DRIFT" in rendered
+    assert "LAGGARD" in rendered and "allreduce" in rendered
+
+
+def test_doctor_counts_mismatches_as_drift():
+    from ray_tpu import doctor
+    fp0 = comms.fingerprint("allreduce:SUM", (4,), "float32")
+    fp1 = comms.fingerprint("allreduce:SUM", (8,), "float32")
+    with pytest.raises(comms.CollectiveDivergenceError):
+        comms.check_fingerprints({0: fp0, 1: fp1}, group="g")
+    collected = {"ts": time.time(), "errors": [],
+                 "cluster": {"metrics": {"snapshots": {
+                     "head": comms.families()}}}}
+    rep = doctor._comms_reports(collected,
+                                baseline={"g": {"mismatches": 0.0}})
+    assert [d["metric"] for d in rep["drift"]] == ["mismatches"]
+
+
+# -- tensor-plane epoch gauge ------------------------------------------------
+
+def test_tensor_plane_mark_sets_epoch_gauge():
+    from ray_tpu.collective import tensor_plane
+    from ray_tpu.observability.metric_names import TPLANE_EPOCH_GAUGE
+    tensor_plane._mark("join", "gx", 3, rank=0, world=2)
+    gauge = tensor_plane._epoch_gauge
+    assert gauge is not None
+    assert any(name == TPLANE_EPOCH_GAUGE
+               and dict(tags).get("group") == "gx" and v == 3.0
+               for name, tags, v in gauge.samples())
+    # shutdown parks the group at epoch -1 instead of vanishing
+    tensor_plane._mark("shutdown", "gx", -1, last_epoch=3)
+    assert any(dict(tags).get("group") == "gx" and v == -1.0
+               for _n, tags, v in gauge.samples())
+
+
+# -- acceptance drill (self-skip without the C++ state service) --------------
+
+def test_cluster_comms_chaos_drill():
+    """A rank-filtered chaos delay (`collective.op[rank=1]`) makes rank 1
+    arrive ~120ms late at every rendezvous on its daemon: the federated
+    /api/comms skew attribution must NAME that rank, the doctor COMMS
+    section must flag it, and a --comms-baseline must gate on it (pos +
+    neg)."""
+    from ray_tpu.cluster_utils import ProcessCluster
+    from ray_tpu.dashboard.head import DashboardHead
+    from ray_tpu import doctor
+    _require_state_service()
+    ray_tpu.shutdown()
+    c = ProcessCluster(num_daemons=1, num_cpus=2)
+    # both ranks live on the chaos daemon (thread-shared CPU group);
+    # the label filter delays only rank 1's collectives
+    c.add_daemon(resources={"pin": 2.0},
+                 env={"RAY_TPU_CHAOS":
+                      "7:collective.op[rank=1]@1+=delay(0.12)"})
+    try:
+        ray_tpu.init(address=c.address)
+
+        @ray_tpu.remote(num_cpus=0.1)
+        class Member:
+            def run(self, fn_name, *args, **kwargs):
+                from ray_tpu import collective as col
+                return getattr(col, fn_name)(*args, **kwargs)
+
+        actors = [Member.options(resources={"pin": 1.0}).remote()
+                  for _ in range(2)]
+        from ray_tpu.collective import create_collective_group
+        create_collective_group(actors, 2, [0, 1], backend="cpu",
+                                group_name="gdrill")
+        for _ in range(6):
+            refs = [a.run.remote("allreduce", np.ones(1024), "gdrill")
+                    for a in actors]
+            ray_tpu.get(refs, timeout=60)
+
+        head = DashboardHead(c.address)
+        try:
+            payload = head._comms()
+            g = payload["groups"].get("gdrill")
+            assert g is not None, payload
+            assert g["ops"]["allreduce"]["count"] == 12
+            flagged = {(f["group"], f["rank"])
+                       for f in payload["skew_flags"]}
+            assert ("gdrill", "1") in flagged, payload["skew_flags"]
+            assert ("gdrill", "0") not in flagged
+            report = comms.skew_report(payload["groups"],
+                                       bounds=payload["bounds"])
+            assert report["gdrill"]["1"]["p95_ms"] >= 50.0
+
+            # the doctor names the same rank and gates on the baseline
+            snaps, _missing = head._metric_snapshots()
+            collected = {"ts": time.time(), "errors": [],
+                         "cluster": {"metrics": {"snapshots": snaps}}}
+            rep = doctor.diagnose(
+                collected,
+                comms_baseline={"gdrill": {"skew_p95_ms": 1.0}})
+            assert not rep["healthy"]
+            assert ("gdrill", "1") in {
+                (f["group"], f["rank"])
+                for f in rep["comms"]["skew_flags"]}
+            assert [d["metric"] for d in rep["comms"]["drift"]] == \
+                ["skew_p95_ms"]
+            rendered = doctor.render_text(rep)
+            assert "LAGGARD gdrill rank 1" in rendered
+            # negative control: a loose baseline records no drift
+            loose = doctor._comms_reports(
+                collected,
+                baseline={"gdrill": {"skew_p95_ms": 100000.0,
+                                     "mismatches": 10.0}})
+            assert loose["drift"] == []
+        finally:
+            head.stop()
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
