@@ -16,7 +16,7 @@ from typing import Optional
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _LOCK = threading.Lock()
-_CACHE = {}
+_CACHE = {}  # raylint: guarded-by(_LOCK)
 
 
 def _sanitize_flags() -> list:

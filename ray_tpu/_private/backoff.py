@@ -182,21 +182,26 @@ class BackoffState:
 
 _rng = random.Random()
 
-_retry_counter = None
+_counter_lock = threading.Lock()
+_retry_counter = None  # raylint: guarded-by(_counter_lock)
 
 
 def _count_retry(site: str):
     # Lazy singleton (metrics must not be a hard import here: backoff is
     # used by the wire layer during bootstrap). One counter, tagged by
-    # call site, covers every BackoffPolicy loop in the runtime.
+    # call site, covers every BackoffPolicy loop in the runtime.  Created
+    # under _counter_lock: two first-retry threads racing here used to
+    # mint two Counters and trip the registry's duplicate check.
     global _retry_counter
     try:
         from ray_tpu.util.metrics import Counter
-        if _retry_counter is None:
-            _retry_counter = Counter(
-                "backoff_retries_total",
-                "retry attempts by call site", tag_keys=("site",))
-        _retry_counter.inc(tags={"site": site})
+        with _counter_lock:
+            c = _retry_counter
+            if c is None:
+                c = _retry_counter = Counter(
+                    "backoff_retries_total",
+                    "retry attempts by call site", tag_keys=("site",))
+        c.inc(tags={"site": site})
     except Exception:  # raylint: allow(swallow) metrics must never break a retry loop
         pass
 
@@ -323,7 +328,7 @@ class BreakerBoard:
         self._clock = clock
         self._on_open = on_open
         self._lock = threading.Lock()
-        self._breakers = {}
+        self._breakers = {}  # raylint: guarded-by(self._lock)
 
     def get(self, addr: str) -> CircuitBreaker:
         with self._lock:

@@ -43,7 +43,7 @@ ENABLED = bool(_config.get("clock_sync_enabled"))
 _WINDOW = 16
 
 _lock = threading.Lock()
-_samples: deque = deque(maxlen=_WINDOW)  # (rtt_s, offset_s)
+_samples: deque = deque(maxlen=_WINDOW)  # (rtt_s, offset_s)  # raylint: guarded-by(_lock)
 _offset_s = 0.0
 _synced = False
 _gauge = None
@@ -53,6 +53,7 @@ def _skew_gauge():
     global _gauge
     if _gauge is None:
         from ray_tpu.util import metrics as _metrics
+        # raylint: allow(data-race) idempotent lazy gauge init; the metrics registry dedups by name
         _gauge = _metrics.Gauge(
             "clock_skew_ms",
             "estimated local wall-clock lead over the state-service clock "
@@ -74,7 +75,7 @@ def observe(t_send_s: float, t_recv_s: float, server_time_s: float):
     with _lock:
         _samples.append((rtt, offset))
         # Lowest-RTT sample in the window is the least asymmetric one.
-        _offset_s = min(_samples)[1]
+        _offset_s = min(_samples)[1]  # raylint: guarded-by(_lock)
         _synced = True
         est_ms = _offset_s * 1e3
     _skew_gauge().set(est_ms)

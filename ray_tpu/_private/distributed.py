@@ -185,7 +185,7 @@ class DistributedRuntime(Runtime):
                  namespace: str = "default"):
         # Before super().__init__: the base constructor starts the
         # dispatcher thread, whose pass-end hook reads these.
-        self._push_batch: Dict[str, list] = {}
+        self._push_batch: Dict[str, list] = {}  # raylint: guarded-by(self._push_batch_lock)
         self._push_batch_lock = threading.Lock()
         # Linger flusher for task-push batches: dispatch hooks only STAMP a
         # deadline; this thread ships the accumulated frame when it expires,
@@ -226,9 +226,9 @@ class DistributedRuntime(Runtime):
         # Cluster view: node_id bytes -> (pb.NodeInfo, NodeResources view).
         self._states_memo = None  # (monotonic_ts, [NodeState]) micro-TTL
         self._view_lock = threading.Lock()
-        self._view: Dict[bytes, pb.NodeInfo] = {}
-        self._view_avail: Dict[bytes, NodeResources] = {}
-        self._addr_by_node: Dict[bytes, str] = {}
+        self._view: Dict[bytes, pb.NodeInfo] = {}  # raylint: guarded-by(self._view_lock)
+        self._view_avail: Dict[bytes, NodeResources] = {}  # raylint: guarded-by(self._view_lock)
+        self._addr_by_node: Dict[bytes, str] = {}  # raylint: guarded-by(self._view_lock)
 
         # Ownership / borrow bookkeeping.
         self._owner_addr: Dict[ObjectID, str] = {}  # oid -> owner address
@@ -243,20 +243,20 @@ class DistributedRuntime(Runtime):
         self._fn_key_by_identity = weakref.WeakKeyDictionary()
         self._fn_cache: Dict[bytes, Any] = {}  # hash -> callable/class
         self._inflight_lock = threading.Lock()
-        self._inflight_remote: Dict[Tuple[TaskID, int], dict] = {}
+        self._inflight_remote: Dict[Tuple[TaskID, int], dict] = {}  # raylint: guarded-by(self._inflight_lock)
         # Reverse index return-oid -> inflight info: get() probes this per
         # poll, and a linear scan over all in-flight pushes is O(n^2)
         # across a driver gathering n results.
-        self._inflight_by_return: Dict[ObjectID, dict] = {}
+        self._inflight_by_return: Dict[ObjectID, dict] = {}  # raylint: guarded-by(self._inflight_lock)
         self._completed_returns: set = set()  # return oids known done
         # Bulk p2p mailbox: (group, src, dst, seq) -> (dtype, shape,
         # bytes). Fed by P2P_DATA frames (tensor in the raw lane),
         # drained by XLAProcessGroup.recv.
-        self._p2p_box: Dict[tuple, tuple] = {}
+        self._p2p_box: Dict[tuple, tuple] = {}  # raylint: guarded-by(self._p2p_cv)
         self._p2p_cv = threading.Condition()
         # Nodes whose death we already processed (signals arrive from both
         # the pubsub push and the view refresh; handling must be idempotent).
-        self._dead_handled: set = set()
+        self._dead_handled: set = set()  # raylint: guarded-by(self._view_lock)
         self._infeasible_grace_s = 10.0  # view may trail a joining node
         # Serialize-time pins created while building a task-push message are
         # collected here (thread-local) and released when the push attempt
@@ -270,18 +270,18 @@ class DistributedRuntime(Runtime):
         self._pin_reaper_cv = threading.Condition()
         # One reply per task completion, shared by duplicate-push hooks
         # (rebuilding would race the first build's inline store.free).
-        self._reply_bytes_cache: Dict[TaskID, bytes] = {}
+        self._reply_bytes_cache: Dict[TaskID, bytes] = {}  # raylint: guarded-by(self.lock)
 
         # Remote actors this process created or uses.
         self.remote_actors: Dict[ActorID, _RemoteActorRecord] = {}
         self._dir_probe_at: Dict[ObjectID, float] = {}
-        self._fetch_cache: Dict[ObjectID, bytes] = {}
+        self._fetch_cache: Dict[ObjectID, bytes] = {}  # raylint: guarded-by(self._fetch_cache_lock)
         self._fetch_cache_lock = threading.Lock()
         # Addresses with recent connection failures are excluded from
         # selection until the deadline passes or the heartbeat sweep
         # settles their fate (the submitter-side analogue of the lease
         # policy avoiding known-bad raylets).
-        self._suspect_addrs: Dict[str, float] = {}
+        self._suspect_addrs: Dict[str, float] = {}  # raylint: guarded-by(self._view_lock)
         # Per-peer circuit breakers: after circuit_failure_threshold
         # consecutive transport failures a peer's breaker OPENs, optional
         # traffic (object pushes) to it is shed immediately instead of
@@ -348,7 +348,7 @@ class DistributedRuntime(Runtime):
         # never blocks the unpickle path, a REMOVE can never overtake its
         # ADD (both target the owner), and one slow peer cannot
         # head-of-line-block traffic to the others.
-        self._borrow_qs: Dict[str, "queue.Queue"] = {}
+        self._borrow_qs: Dict[str, "queue.Queue"] = {}  # raylint: guarded-by(self._borrow_q_lock)
         self._borrow_q_lock = threading.Lock()
         self._borrow_registered: set = set()
 
@@ -375,8 +375,8 @@ class DistributedRuntime(Runtime):
         self._push_mgr = _PushManager(self)
         # In-flight incoming pushes: oid -> [store recv-buffer view,
         # bytes filled]. The view is the object's final resting place.
-        self._incoming_pushes: Dict[ObjectID, list] = {}
-        self._incoming_push_seen: Dict[ObjectID, float] = {}
+        self._incoming_pushes: Dict[ObjectID, list] = {}  # raylint: guarded-by(self._incoming_pushes_lock)
+        self._incoming_push_seen: Dict[ObjectID, float] = {}  # raylint: guarded-by(self._incoming_pushes_lock)
         self._incoming_pushes_lock = threading.Lock()
 
         # OOM guard: executors shed admissions above the host/cgroup
@@ -441,10 +441,10 @@ class DistributedRuntime(Runtime):
             store = NativeObjectStore(cap)
             if store.serve(path) and self.state.kv_put(
                     host_key, path.encode(), overwrite=False, namespace=ns):
-                self.host_arena = store
-                self.host_arena_key = path
+                self.host_arena = store  # raylint: allow(data-race) set once during __init__ before the runtime is shared
+                self.host_arena_key = path  # raylint: allow(data-race) set once during __init__ before the runtime is shared
                 self._arena_is_owner = True
-                self._arena_host_key = host_key
+                self._arena_host_key = host_key  # raylint: allow(data-race) set once during __init__ before the runtime is shared
                 logger.debug("serving host arena at %s (%d MB)", path,
                              cap >> 20)
                 return
@@ -458,11 +458,11 @@ class DistributedRuntime(Runtime):
         existing = self.state.kv_get(host_key, namespace=ns)
         if existing:
             try:
-                self.host_arena = NativeStoreClient(existing.decode())
-                self.host_arena_key = existing.decode()
+                self.host_arena = NativeStoreClient(existing.decode())  # raylint: allow(data-race) set once during __init__ before the runtime is shared
+                self.host_arena_key = existing.decode()  # raylint: allow(data-race) set once during __init__ before the runtime is shared
                 logger.debug("joined host arena at %s", self.host_arena_key)
             except Exception:
-                self.host_arena = None
+                self.host_arena = None  # raylint: allow(data-race) set once during __init__ before the runtime is shared
                 if not self._arena_owner_dead(existing.decode()):
                     # The claimed owner still looks alive: the connect
                     # failure is transient (or a cross-container /tmp).
@@ -670,14 +670,14 @@ class DistributedRuntime(Runtime):
                         except Exception as e:
                             logger.debug("location re-publish failed: %s", e)
                             break
-                self.heartbeat_misses = 0
-                self.heartbeat_last_success = time.time()
+                self.heartbeat_misses = 0  # raylint: allow(data-race) single-writer heartbeat thread; debug reads are GIL-atomic snapshots
+                self.heartbeat_last_success = time.time()  # raylint: allow(data-race) single-writer heartbeat thread; debug reads are GIL-atomic snapshots
                 self._hb_miss_gauge.set(0)
                 self._hb_success_gauge.set(self.heartbeat_last_success)
             except Exception:
                 if self._hb_stop.is_set():
                     return
-                self.heartbeat_misses += 1
+                self.heartbeat_misses += 1  # raylint: allow(data-race) single-writer heartbeat thread; debug reads are GIL-atomic snapshots
                 self._hb_miss_gauge.set(self.heartbeat_misses)
                 logger.warning("heartbeat to state service failed "
                                "(%d consecutive)", self.heartbeat_misses,
@@ -750,7 +750,7 @@ class DistributedRuntime(Runtime):
                         known.state = "DRAINING"
                     else:
                         self._view[info.node_id] = info
-                    self._states_memo = None
+                    self._states_memo = None  # raylint: allow(data-race) immutable tuple publish; the unlocked micro-TTL read re-validates within 2ms
                 self._kick()
         elif ev.kind == "NODE_ADDED":
             if info.node_id != self.local_node.node_id.binary():
@@ -787,13 +787,13 @@ class DistributedRuntime(Runtime):
         Reached from the NODE_DEAD pubsub push AND the periodic view
         reconciliation; runs exactly once per node."""
         nid = info.node_id
-        # The registration-time address is authoritative; event payloads on
-        # a restarted state service may lack it.
-        addr = self._addr_by_node.get(nid, "") or info.address
         with self._view_lock:
             if nid in self._dead_handled:
                 return
             self._dead_handled.add(nid)
+            # The registration-time address is authoritative; event payloads
+            # on a restarted state service may lack it.
+            addr = self._addr_by_node.get(nid, "") or info.address
             entry = self._view.get(nid)
             if entry is not None:
                 entry.alive = False
@@ -806,7 +806,9 @@ class DistributedRuntime(Runtime):
             # this, but the pubsub path covers half-open connections).
             self._fail_inflight_to(addr, f"node {info.node_id.hex()[:8]} died")
             # Restart/kill actors we own that lived there.
-            for rec in list(self.remote_actors.values()):
+            with self.lock:
+                remote_recs = list(self.remote_actors.values())
+            for rec in remote_recs:
                 if rec.address == addr and rec.status == "ALIVE":
                     self._handle_remote_actor_death(
                         rec, exc.NodeDiedError(
@@ -814,7 +816,7 @@ class DistributedRuntime(Runtime):
         # Drop location hints pointing at the dead node.
         for oid, hint in list(self._location_hints.items()):
             if hint == addr:
-                del self._location_hints[oid]
+                del self._location_hints[oid]  # raylint: allow(data-race) GIL-atomic op on best-effort location hint; stale hint costs one extra directory probe
         self.emit_event("NODE_DEAD", node_id=info.node_id.hex())
         self._kick()
 
@@ -845,9 +847,9 @@ class DistributedRuntime(Runtime):
         else:
             budget = _config.get("drain_deadline_s")
         deadline = time.monotonic() + budget
-        self.local_node.draining = True
+        self.local_node.draining = True  # raylint: allow(data-race) GIL-atomic bool store on the long-lived node object; readers converge next pass
         with self._view_lock:
-            self._states_memo = None  # placement must see the flip NOW
+            self._states_memo = None  # placement must see the flip NOW  # raylint: allow(data-race) immutable tuple publish; the unlocked micro-TTL read re-validates within 2ms
         self._node_state_gauge.set(1)
         if observability.ENABLED:
             observability.instant("drain:begin", cat="drain", reason=reason,
@@ -940,7 +942,9 @@ class DistributedRuntime(Runtime):
         import numpy as np
         from ray_tpu.checkpoint import CheckpointEngine
         count = 0
-        for state in list(self.actors.values()):
+        with self.lock:
+            local_actors = list(self.actors.values())
+        for state in local_actors:
             if state.instance is None or state.status != ActorState.ALIVE:
                 continue
             if time.monotonic() > deadline:
@@ -1285,8 +1289,8 @@ class DistributedRuntime(Runtime):
         any REMOVE_BORROW we might emit later. ``managed`` pins are
         released by the pusher at attempt settle, not by us."""
         if owner_addr != self.address:
-            self._owner_addr[oid] = owner_addr
-            self._location_hints.setdefault(oid, owner_addr)
+            self._owner_addr[oid] = owner_addr  # raylint: allow(data-race) GIL-atomic op on best-effort owner cache; mis-resolve falls back to broadcast lookup
+            self._location_hints.setdefault(oid, owner_addr)  # raylint: allow(data-race) GIL-atomic op on best-effort location hint; stale hint costs one extra directory probe
             self._borrow_enqueue("add", oid, owner_addr)
         if managed:
             return
@@ -1382,7 +1386,7 @@ class DistributedRuntime(Runtime):
                     self._borrow_registered.discard(oid)
 
     def _on_ref_zero(self, oid: ObjectID):
-        owner = self._owner_addr.pop(oid, None) if hasattr(
+        owner = self._owner_addr.pop(oid, None) if hasattr(  # raylint: allow(data-race) GIL-atomic op on best-effort owner cache; mis-resolve falls back to broadcast lookup
             self, "_owner_addr") else None
         if owner is not None and owner != getattr(self, "address", None):
             # We were a borrower: tell the owner, drop local cache.
@@ -1408,7 +1412,7 @@ class DistributedRuntime(Runtime):
                 logger.debug("free propagation to %s failed",
                              remote_copy, exc_info=True)
         if hasattr(self, "_location_hints"):
-            self._location_hints.pop(oid, None)
+            self._location_hints.pop(oid, None)  # raylint: allow(data-race) GIL-atomic op on best-effort location hint; stale hint costs one extra directory probe
             self._completed_returns.discard(oid)
             self._dir_probe_at.pop(oid, None)
             with self._fetch_cache_lock:
@@ -1423,7 +1427,7 @@ class DistributedRuntime(Runtime):
 
     def put_object(self, value: Any, owner_node: Optional[Node] = None) -> ObjectID:
         oid = super().put_object(value, owner_node=self.local_node)
-        self._owner_addr[oid] = self.address
+        self._owner_addr[oid] = self.address  # raylint: allow(data-race) GIL-atomic op on best-effort owner cache; mis-resolve falls back to broadcast lookup
         return oid
 
     def get_object(self, oid: ObjectID, timeout: Optional[float] = None) -> Any:
@@ -1550,7 +1554,7 @@ class DistributedRuntime(Runtime):
                     self.local_node.store.put(oid, value)
                 with self.lock:
                     self.object_locations[oid] = self.local_node.node_id
-                self._location_hints[oid] = addr
+                self._location_hints[oid] = addr  # raylint: allow(data-race) GIL-atomic op on best-effort location hint; stale hint costs one extra directory probe
                 try:
                     self.state.add_location(
                         oid.binary(), self.local_node.node_id.binary())
@@ -1796,7 +1800,7 @@ class DistributedRuntime(Runtime):
         try:
             rep = self.state.get_locations(oid.binary())
             if rep.addresses:
-                self._location_hints[oid] = next(
+                self._location_hints[oid] = next(  # raylint: allow(data-race) GIL-atomic op on best-effort location hint; stale hint costs one extra directory probe
                     (a for a in rep.addresses if a), "")
                 return True
         except Exception as e:
@@ -1807,10 +1811,11 @@ class DistributedRuntime(Runtime):
 
     def node_states(self) -> List[NodeState]:
         """Worker-facing cluster view (drives ``ray_tpu.nodes()`` etc.)."""
-        return self._cluster_states() + [
-            NodeState(NodeID(nid), NodeResources(
+        with self._view_lock:
+            dead = [NodeState(NodeID(nid), NodeResources(
                 ResourceSet(dict(info.total.amounts))), False)
-            for nid, info in self._view.items() if not info.alive]
+                for nid, info in self._view.items() if not info.alive]
+        return self._cluster_states() + dead
 
     def _cluster_states(self, include_suspects: bool = False
                         ) -> List[NodeState]:
@@ -1840,7 +1845,7 @@ class DistributedRuntime(Runtime):
                 states.append(NodeState(NodeID(nid), nr, True,
                                         draining=info.state == "DRAINING"))
             if not include_suspects:
-                self._states_memo = (now, states)
+                self._states_memo = (now, states)  # raylint: allow(data-race) immutable tuple publish; the unlocked micro-TTL read re-validates within 2ms
         return states
 
     def _select_node(self, spec: TaskSpec) -> Optional[NodeID]:
@@ -1856,7 +1861,8 @@ class DistributedRuntime(Runtime):
                 strategy.placement_group_bundle_index)
         states = self._cluster_states()
         if pg is not None:
-            pg_state = self.placement_groups.get(pg.id)
+            with self.lock:
+                pg_state = self.placement_groups.get(pg.id)
             if pg_state is None or not pg_state.ready.is_set():
                 return None
             if pg_state.bundle_nodes is None:
@@ -2008,9 +2014,9 @@ class DistributedRuntime(Runtime):
         key = _fn_key(payload)
         if key not in self._exported_fns:
             self.state.kv_put(key, payload, overwrite=False, namespace=FN_NS)
-            self._exported_fns[key] = payload
+            self._exported_fns[key] = payload  # raylint: allow(data-race) idempotent content-addressed export cache; duplicate compute is harmless
         try:
-            self._fn_key_by_identity[fn] = key
+            self._fn_key_by_identity[fn] = key  # raylint: allow(data-race) idempotent content-addressed export cache; duplicate compute is harmless
         except TypeError:
             pass
         return key
@@ -2027,7 +2033,7 @@ class DistributedRuntime(Runtime):
         payload = cloudpickle.dumps(fn)
         key = _fn_key(payload)
         self.state.kv_put(key, payload, overwrite=False, namespace=FN_NS)
-        self._fn_cache[key] = fn
+        self._fn_cache[key] = fn  # raylint: allow(data-race) idempotent content-addressed export cache; duplicate compute is harmless
         self.state.kv_put(name.encode(), key, overwrite=True,
                           namespace=NAMED_FN_NS)
 
@@ -2047,7 +2053,7 @@ class DistributedRuntime(Runtime):
                 raise exc.RayTpuError(
                     f"function {key.hex()[:12]} not in function table")
             fn = cloudpickle.loads(payload)
-            self._fn_cache[key] = fn
+            self._fn_cache[key] = fn  # raylint: allow(data-race) idempotent content-addressed export cache; duplicate compute is harmless
         return fn
 
     def _spec_to_msg(self, spec: TaskSpec) -> Tuple[pb.TaskSpecMsg, list]:
@@ -2294,7 +2300,7 @@ class DistributedRuntime(Runtime):
                 return
         with self._push_flush_cv:
             if self._push_flush_due is None:
-                self._push_flush_due = time.monotonic() + linger / 1000.0
+                self._push_flush_due = time.monotonic() + linger / 1000.0  # raylint: guarded-by(self._push_flush_cv)
             if self._push_flusher is None or not self._push_flusher.is_alive():
                 self._push_flusher = threading.Thread(
                     target=self._push_flush_loop, name="push-flush",
@@ -2370,10 +2376,12 @@ class DistributedRuntime(Runtime):
         # Success/spillback: settle BEFORE removing the in-flight entry so
         # concurrent get()s keep blocking on its event rather than racing
         # the seal (they re-check the store once the event fires).
-        info = self._inflight_remote.get(key)
+        with self._inflight_lock:
+            info = self._inflight_remote.get(key)
         spilled = False
         try:
-            self._suspect_addrs.pop(addr, None)  # proven alive
+            with self._view_lock:
+                self._suspect_addrs.pop(addr, None)  # proven alive
             self.breakers.record_success(addr)
             rep = pb.PushTaskReply()
             rep.ParseFromString(env.body)
@@ -2411,11 +2419,11 @@ class DistributedRuntime(Runtime):
                             value = pickle.loads(rep.inline_results[i])
                             self.local_node.store.put(rid, value)
                             self.object_locations[rid] = self.local_node.node_id
-                            self._owner_addr.setdefault(rid, self.address)
+                            self._owner_addr.setdefault(rid, self.address)  # raylint: allow(data-race) GIL-atomic op on best-effort owner cache; mis-resolve falls back to broadcast lookup
                         else:
-                            self._location_hints[rid] = addr
-                            self._owner_addr.setdefault(rid, addr)
-                        self._completed_returns.add(rid)
+                            self._location_hints[rid] = addr  # raylint: allow(data-race) GIL-atomic op on best-effort location hint; stale hint costs one extra directory probe
+                            self._owner_addr.setdefault(rid, addr)  # raylint: allow(data-race) GIL-atomic op on best-effort owner cache; mis-resolve falls back to broadcast lookup
+                        self._completed_returns.add(rid)  # raylint: allow(data-race) GIL-atomic op on monotone completion set; late reader just retries the fetch
                     self.task_states[spec.task_id] = "FINISHED"
             self._notify_sealed()  # wake get()/wait() blocked on the seal cv
             self._unpin_args(spec)
@@ -2645,7 +2653,7 @@ class DistributedRuntime(Runtime):
             state.actor_id, state.cls.__name__, addr, nid, state.options,
             state.name or "", state.namespace, spec_msg=msg)
         rec.restart_count = state.restart_count
-        self.remote_actors[state.actor_id] = rec
+        self.remote_actors[state.actor_id] = rec  # raylint: allow(data-race) GIL-atomic registry op; accessors use get/pop idioms and tolerate misses
         with state.lock:
             state.status = ActorState.ALIVE
             state.node_id = NodeID(nid)
@@ -2703,8 +2711,9 @@ class DistributedRuntime(Runtime):
             if rec.status == "DEAD":
                 return
             rec.status = "DEAD"
-        state = self.actors.get(rec.actor_id)
-        self.remote_actors.pop(rec.actor_id, None)
+        with self.lock:
+            state = self.actors.get(rec.actor_id)
+        self.remote_actors.pop(rec.actor_id, None)  # raylint: allow(data-race) GIL-atomic registry op; accessors use get/pop idioms and tolerate misses
         if state is None:
             return
         max_restarts = getattr(state.options, "max_restarts", 0)
@@ -2746,7 +2755,8 @@ class DistributedRuntime(Runtime):
         # trace context like every other hop.
         self._attach_trace(spec)
         rec = self.remote_actors.get(actor_id)
-        state = self.actors.get(actor_id)
+        with self.lock:
+            state = self.actors.get(actor_id)
         if rec is None and state is None:
             # Maybe a named/foreign actor we learned about from the table
             # (e.g. a handle created by ANOTHER process, like a serve
@@ -2764,7 +2774,7 @@ class DistributedRuntime(Runtime):
                     rec = _RemoteActorRecord(
                         actor_id, info.class_name, info.address,
                         info.node_id, None, info.name, info.namespace)
-                    self.remote_actors[actor_id] = rec
+                    self.remote_actors[actor_id] = rec  # raylint: allow(data-race) GIL-atomic registry op; accessors use get/pop idioms and tolerate misses
                     break
                 if info.address == self.address and info.address:
                     break  # ours after all; local path below
@@ -2817,8 +2827,9 @@ class DistributedRuntime(Runtime):
             except (RpcConnectionError, TimeoutError, RpcRemoteError):
                 pass
             rec.status = "DEAD"
-            self.remote_actors.pop(actor_id, None)
-            state = self.actors.get(actor_id)
+            self.remote_actors.pop(actor_id, None)  # raylint: allow(data-race) GIL-atomic registry op; accessors use get/pop idioms and tolerate misses
+            with self.lock:
+                state = self.actors.get(actor_id)
             if state is not None:
                 if no_restart:
                     self._mark_actor_dead(state, exc.ActorDiedError(
@@ -2829,7 +2840,8 @@ class DistributedRuntime(Runtime):
                         rec, exc.ActorDiedError("killed"))
             return
         super().kill_actor(actor_id, no_restart=no_restart)
-        state = self.actors.get(actor_id)
+        with self.lock:
+            state = self.actors.get(actor_id)
         if state is not None:
             self._sync_actor_info(state)
 
@@ -2874,7 +2886,7 @@ class DistributedRuntime(Runtime):
                                      info.node_id, None, info.name,
                                      info.namespace)
             if info.address != self.address:
-                self.remote_actors[actor_id] = rec
+                self.remote_actors[actor_id] = rec  # raylint: allow(data-race) GIL-atomic registry op; accessors use get/pop idioms and tolerate misses
         return rec
 
     # ---------------------------------------------------- placement groups
@@ -3336,7 +3348,8 @@ class DistributedRuntime(Runtime):
         push attaches a second hook, and rebuilding would race the first
         build's store.free (inline results are freed on consumption) —
         the second reply would otherwise advertise a freed object."""
-        cached = self._reply_bytes_cache.get(spec.task_id)
+        with self.lock:
+            cached = self._reply_bytes_cache.get(spec.task_id)
         if cached is not None:
             ctx.reply(cached)
             return
@@ -3413,12 +3426,13 @@ class DistributedRuntime(Runtime):
                     except Exception as e:
                         logger.debug("add_location failed: %s", e)
         data = rep.SerializeToString()
-        self._reply_bytes_cache[spec.task_id] = data
-        while len(self._reply_bytes_cache) > 512:
-            stale_key = next(iter(self._reply_bytes_cache), None)
-            if stale_key is None:
-                break
-            self._reply_bytes_cache.pop(stale_key, None)
+        with self.lock:
+            self._reply_bytes_cache[spec.task_id] = data
+            while len(self._reply_bytes_cache) > 512:
+                stale_key = next(iter(self._reply_bytes_cache), None)
+                if stale_key is None:
+                    break
+                self._reply_bytes_cache.pop(stale_key, None)
         ctx.reply(data)
 
     def _actor_alloc_target(self, options, node):
@@ -3904,11 +3918,11 @@ class _PushManager:
         self.window = int(_config.get("object_push_window_bytes"))
         self._cv = threading.Condition()
         self._inflight: Dict[str, int] = {}       # addr -> bytes on the wire
-        self._active: set = set()                 # (addr, oid) deduplication
+        self._active: set = set()                 # (addr, oid) deduplication  # raylint: guarded-by(self._cv)
         self._pool = ThreadPoolExecutor(max_workers=4,
                                         thread_name_prefix="obj-push")
         self._closed = False
-        self.pushes_initiated = 0  # monotone; observable in tests/metrics
+        self.pushes_initiated = 0  # monotone; observable in tests/metrics  # raylint: guarded-by(self._cv)
 
     def maybe_push(self, addr: str, oid: ObjectID, threshold: int):
         # Pushes are optional: shed them outright while the peer's circuit

@@ -165,7 +165,7 @@ def main(argv=None) -> int:
     # Install as the process-global worker so tasks executing here can call
     # ray_tpu.get/put/remote/etc. (the driver-API-inside-worker contract).
     with _worker._global_lock:
-        _worker._global = _worker.Worker(runtime, "default")
+        _worker._global = _worker.Worker(runtime, "default")  # raylint: allow(data-race) installed once at daemon bootstrap under _global_lock; is_initialized's unlocked peek is a GIL-atomic snapshot
 
     stop = {"flag": False}
 

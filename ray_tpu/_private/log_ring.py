@@ -87,7 +87,7 @@ def install(capacity: int = 2000) -> RingLogHandler:
     global _handler
     with _install_lock:
         if _handler is None:
-            _handler = RingLogHandler(capacity)
+            _handler = RingLogHandler(capacity)  # raylint: allow(data-race) emit-path readers take a GIL-atomic snapshot; install is idempotent under _install_lock
             logging.getLogger().addHandler(_handler)
         return _handler
 

@@ -107,9 +107,9 @@ class MemoryMonitor:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._used = 0
-        self._total = 0
-        self._over = False
+        self._used = 0  # raylint: guarded-by(self._lock)
+        self._total = 0  # raylint: guarded-by(self._lock)
+        self._over = False  # raylint: guarded-by(self._lock)
         self._sampled_at = 0.0
         if self.enabled:
             self._sample()  # first decision must not wait a full period
@@ -154,15 +154,16 @@ class MemoryMonitor:
     def _sample(self):
         used, total = self._usage_reader()
         with self._lock:
-            self._used, self._total = used, total
+            self._used, self._total = used, total  # raylint: guarded-by(self._lock)
             self._over = bool(total) and (used / total) >= self.threshold
-            self._sampled_at = time.monotonic()
+            self._sampled_at = time.monotonic()  # raylint: guarded-by(self._lock)
 
     # -- queries ---------------------------------------------------------
     def is_over_threshold(self) -> bool:
         if not self.enabled:
             return False
-        return self._over
+        with self._lock:
+            return self._over
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:

@@ -21,16 +21,17 @@ from ray_tpu._private.ids import ObjectID
 class ReferenceCounter:
     def __init__(self, on_zero: Optional[Callable[[ObjectID], None]] = None):
         self._lock = threading.Lock()
-        self._local_refs: Dict[ObjectID, int] = {}
-        self._pins: Dict[ObjectID, int] = {}  # in-flight task arg pins
+        self._local_refs: Dict[ObjectID, int] = {}  # raylint: guarded-by(self._lock)
+        self._pins: Dict[ObjectID, int] = {}  # in-flight task arg pins  # raylint: guarded-by(self._lock)
         # Cross-process borrows: oid -> {borrower address -> count}. The
         # owner holds the value while any borrower process retains a
         # deserialized handle (reference_count.h:61 borrower bookkeeping).
-        self._borrows: Dict[ObjectID, Dict[str, int]] = {}
-        self._on_zero = on_zero
+        self._borrows: Dict[ObjectID, Dict[str, int]] = {}  # raylint: guarded-by(self._lock)
+        self._on_zero = on_zero  # raylint: allow(data-race) set during __init__ before the counter is shared
 
     def set_on_zero(self, cb: Callable[[ObjectID], None]):
-        self._on_zero = cb
+        with self._lock:
+            self._on_zero = cb
 
     def add_local_ref(self, oid: ObjectID):
         with self._lock:

@@ -211,9 +211,9 @@ class RpcClient:
                     raise RpcConnectionError(
                         f"auth handshake to {address} failed: {e}") from e
             self._wlock = threading.Lock()
-            self._plock = threading.Lock()
-            self._pending: Dict[int, _Pending] = {}
-            self._seq = 0
+            self._pending_lock = threading.Lock()
+            self._pending: Dict[int, _Pending] = {}  # raylint: guarded-by(self._pending_lock)
+            self._seq = 0  # raylint: guarded-by(self._pending_lock)
             self._on_push = on_push
             self._on_close = on_close
             self._closed = False
@@ -251,7 +251,7 @@ class RpcClient:
                 timeout = default
         pending = _Pending()
         pending.raw_sink = raw_sink
-        with self._plock:
+        with self._pending_lock:
             if self._closed:
                 raise RpcConnectionError(
                     f"connection to {self.address} is closed: {self._close_exc}")
@@ -274,7 +274,7 @@ class RpcClient:
                 raise TimeoutError(
                     f"rpc {pb.Method.Name(method)} to {self.address} timed out")
         finally:
-            with self._plock:
+            with self._pending_lock:
                 self._pending.pop(seq, None)
         reply = pending.env
         if reply is None:
@@ -308,7 +308,7 @@ class RpcClient:
         pending = _Pending()
         pending.callback = callback  # type: ignore[attr-defined]
         pending.raw_sink = raw_sink
-        with self._plock:
+        with self._pending_lock:
             if self._closed:
                 callback(None, RpcConnectionError(
                     f"connection to {self.address} is closed"))
@@ -322,7 +322,7 @@ class RpcClient:
         try:
             self._send(env, raw=raw)
         except Exception as e:
-            with self._plock:
+            with self._pending_lock:
                 self._pending.pop(seq, None)
             callback(None, e)
 
@@ -336,7 +336,7 @@ class RpcClient:
         per-connection in order (the state service's epoll loop) observes
         the ops in exactly the order they were enqueued."""
         pendings = []
-        with self._plock:
+        with self._pending_lock:
             if self._closed:
                 err = RpcConnectionError(
                     f"connection to {self.address} is closed")
@@ -380,7 +380,7 @@ class RpcClient:
         fail_pending when the batch send errors."""
         pending = _Pending()
         pending.callback = callback
-        with self._plock:
+        with self._pending_lock:
             if self._closed:
                 raise RpcConnectionError(
                     f"connection to {self.address} is closed")
@@ -396,7 +396,7 @@ class RpcClient:
             error = RpcConnectionError(
                 f"connection to {self.address}: {error}")
         for seq in seqs:
-            with self._plock:
+            with self._pending_lock:
                 pending = self._pending.pop(seq, None)
             if pending is not None and pending.callback is not None:
                 try:
@@ -478,7 +478,7 @@ class RpcClient:
                     if env.raw_len > MAX_FRAME:
                         raise RpcConnectionError(
                             f"raw payload too large: {env.raw_len}")
-                    with self._plock:
+                    with self._pending_lock:
                         raw_pending = self._pending.get(env.seq)
                     sink = (raw_pending.raw_sink
                             if raw_pending is not None else None)
@@ -500,14 +500,14 @@ class RpcClient:
                         except Exception:
                             logger.exception("push handler failed")
                     continue
-                with self._plock:
+                with self._pending_lock:
                     pending = self._pending.get(env.seq)
                 if pending is None:
                     continue
                 pending.env = env
                 cb = getattr(pending, "callback", None)
                 if cb is not None:
-                    with self._plock:
+                    with self._pending_lock:
                         self._pending.pop(env.seq, None)
                     err = RpcRemoteError(env.error) if env.error else None
                     try:
@@ -520,11 +520,11 @@ class RpcClient:
             self._shutdown(e)
 
     def _shutdown(self, exc: Exception):
-        with self._plock:
+        with self._pending_lock:
             if self._closed:
                 return
             self._closed = True
-            self._close_exc = exc
+            self._close_exc = exc  # raylint: allow(data-race) set under _pending_lock before pending events fire; post-wait readers see it via the event's happens-before edge
             pending, self._pending = dict(self._pending), {}
         try:
             self._sock.close()
@@ -648,7 +648,7 @@ class RpcServer:
             self.address = f"{self.host}:{self.port}"
             self._pool = ThreadPoolExecutor(max_workers=max_workers,
                                             thread_name_prefix="rpc-srv")
-            self._conns: Dict[int, Tuple[socket.socket, threading.Lock]] = {}
+            self._conns: Dict[int, Tuple[socket.socket, threading.Lock]] = {}  # raylint: guarded-by(self._conn_lock)
             self._conn_lock = threading.Lock()
             self._closed = False
             self._quiesced = False
@@ -669,7 +669,7 @@ class RpcServer:
             raise
 
     def set_on_disconnect(self, cb: Callable[[int], None]):
-        self._on_disconnect = cb
+        self._on_disconnect = cb  # raylint: allow(data-race) callback installed once during server wiring before serving starts
 
     def quiesce(self):
         """Stop accepting NEW connections while established ones (and the
@@ -803,7 +803,7 @@ class ConnectionPool:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._clients: Dict[str, RpcClient] = {}
+        self._clients: Dict[str, RpcClient] = {}  # raylint: guarded-by(self._lock)
 
     def get(self, address: str,
             on_close: Optional[Callable[[str, Exception], None]] = None

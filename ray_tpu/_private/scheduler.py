@@ -39,11 +39,11 @@ def _native():
     """The C++ policy kernels (``_native/scheduling.cc``), or None."""
     global _native_sched, _native_checked
     if not _native_checked:
-        _native_checked = True
+        _native_checked = True  # raylint: allow(data-race) idempotent lazy probe; a racing double-load yields equivalent handles
         if _config.get("use_native_scheduler"):
             try:
                 from ray_tpu._native.build import load_native_library
-                _native_sched = load_native_library("scheduling")
+                _native_sched = load_native_library("scheduling")  # raylint: allow(data-race) idempotent lazy probe; a racing double-load yields equivalent handles
                 if _native_sched is not None:
                     import ctypes
                     dp = ctypes.POINTER(ctypes.c_double)
@@ -58,7 +58,7 @@ def _native():
                         dp, up, dp, i64, i64, i64]
             except Exception as e:
                 logger.warning("native scheduling lib unavailable: %s", e)
-                _native_sched = None
+                _native_sched = None  # raylint: allow(data-race) idempotent lazy probe; a racing double-load yields equivalent handles
     return _native_sched
 
 
@@ -163,7 +163,7 @@ class HybridPolicy:
 
 class SpreadPolicy:
     def __init__(self):
-        self._next = 0
+        self._next = 0  # raylint: guarded-by(self._lock)
         self._lock = threading.Lock()
 
     def select(self, nodes: Sequence[NodeState], request: ResourceSet,
@@ -174,7 +174,7 @@ class SpreadPolicy:
                                                                  request)
             with self._lock:
                 cursor = self._next
-                self._next += 1
+                self._next += 1  # raylint: guarded-by(self._lock)
             idx = lib.sched_spread_select(avail, alive, req, n_nodes,
                                           n_res, cursor)
             return nodes[idx].node_id if idx >= 0 else None

@@ -78,7 +78,7 @@ class _StateBatcher:
     def __init__(self, sc: "StateClient"):
         self.sc = sc
         self._cv = threading.Condition()
-        self._ops: List[Tuple[int, bytes]] = []
+        self._ops: List[Tuple[int, bytes]] = []  # raylint: guarded-by(self._cv)
         self._thread: Optional[threading.Thread] = None
         self._inflight = 0          # ops sent, reply not yet seen
         self._stopped = False
@@ -134,7 +134,7 @@ class _StateBatcher:
                         lambda: len(self._ops) >= max_ops or self._stopped,
                         timeout=wait_s)
                 batch, self._ops = self._ops[:max_ops], self._ops[max_ops:]
-                self._inflight = len(batch)
+                self._inflight = len(batch)  # raylint: guarded-by(self._cv)
             try:
                 self._send(batch)
             finally:
@@ -197,17 +197,17 @@ class StateClient:
     def __init__(self, address: str, auth_token=None):
         self.address = address
         self._auth_token = auth_token
-        self._client = RpcClient(address, auth_token=auth_token)
+        self._client = RpcClient(address, auth_token=auth_token)  # raylint: guarded-by(self._client_lock)
         self._client_lock = threading.Lock()
         self._sub_client: Optional[RpcClient] = None
         self._sub_lock = threading.Lock()      # subscription connection
-        self._sub_channels: List[str] = []
+        self._sub_channels: List[str] = []  # raylint: guarded-by(self._sub_lock)
         # handlers have their OWN lock: _on_push runs on the subscription
         # connection's reader thread, and blocking it on _sub_lock while a
         # SUBSCRIBE call awaits its reply on that same thread would stall
         # resubscription for the full call timeout
         self._handlers_lock = threading.Lock()
-        self._handlers: Dict[str, List[Callable[[pb.Event], None]]] = {}
+        self._handlers: Dict[str, List[Callable[[pb.Event], None]]] = {}  # raylint: guarded-by(self._handlers_lock)
         self._batcher = _StateBatcher(self)
         self._closed = False
 
@@ -233,7 +233,9 @@ class StateClient:
             try:
                 if chaos.ENABLED:
                     chaos.inject("state.call", method=_method_name(method))
-                return self._client.call(method, body, timeout=timeout).body
+                with self._client_lock:
+                    c = self._client
+                return c.call(method, body, timeout=timeout).body
             except (RpcConnectionError, chaos.ChaosConnectionReset) as e:
                 if self._closed or not retry:
                     raise
@@ -289,7 +291,7 @@ class StateClient:
                 self._sub_client.close()
             except Exception as e:
                 logger.debug("subscriber close failed: %s", e)
-            self._sub_client = None
+            self._sub_client = None  # raylint: guarded-by(self._sub_lock)
         if self._sub_client is None:
             try:
                 self._sub_client = RpcClient(

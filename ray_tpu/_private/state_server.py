@@ -25,7 +25,8 @@ from typing import Optional
 SESSION_DIR = "/tmp/ray_tpu"
 PORT_FILE = os.path.join(SESSION_DIR, "state_server_port")
 
-_server = None
+_server_lock = threading.Lock()
+_server = None  # raylint: guarded-by(_server_lock)
 
 
 def start_state_server(port: int = 0) -> int:
@@ -82,9 +83,11 @@ def start_state_server(port: int = 0) -> int:
         def log_message(self, *a):
             pass
 
-    _server = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
-    bound = _server.server_address[1]
-    threading.Thread(target=_server.serve_forever, daemon=True,
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    with _server_lock:
+        _server = srv
+    bound = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True,
                      name="state-server").start()
     os.makedirs(SESSION_DIR, exist_ok=True)
     with open(PORT_FILE, "w") as f:
@@ -94,10 +97,11 @@ def start_state_server(port: int = 0) -> int:
 
 def stop_state_server():
     global _server
-    if _server is not None:
-        _server.shutdown()
-        _server.server_close()  # release the listening socket now, not at GC
-        _server = None
+    with _server_lock:
+        srv, _server = _server, None
+    if srv is not None:
+        srv.shutdown()
+        srv.server_close()  # release the listening socket now, not at GC
         try:
             os.unlink(PORT_FILE)
         except OSError:

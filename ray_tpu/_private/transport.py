@@ -111,7 +111,8 @@ def ensure_probed() -> None:
     with _tuned_lock:
         if _tuned:
             return
-        _tuned["probe_gbps"] = 0.0  # marks the attempt: probe runs once
+        # marks the attempt below: the probe runs once
+        _tuned["probe_gbps"] = 0.0  # raylint: allow(data-race) unlocked readers see a GIL-atomic dict snapshot; a miss falls back to static defaults
         nbytes = int(_config.get("transport_probe_bytes"))
         if nbytes <= 0:
             return
@@ -126,6 +127,7 @@ def ensure_probed() -> None:
             if not best_chunk:
                 return
             ncpu = os.cpu_count() or 4
+            # raylint: allow(data-race) unlocked readers see a GIL-atomic dict snapshot; a miss falls back to static defaults
             _tuned.update(
                 chunk_bytes=best_chunk,
                 sock_buf=min(max(2 * best_chunk, 1 << 20), 64 << 20),
@@ -148,7 +150,7 @@ def probe_report() -> Dict[str, float]:
 
 def _reset_probe_for_tests() -> None:
     with _tuned_lock:
-        _tuned.clear()
+        _tuned.clear()  # raylint: allow(data-race) test-only reset; unlocked readers fall back to static defaults
 
 
 # -- knob resolution (explicit value wins; probe fills the "auto" holes) ------
@@ -202,7 +204,7 @@ class _DataStreamPool:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._streams: Dict[str, List[RpcClient]] = {}
+        self._streams: Dict[str, List[RpcClient]] = {}  # raylint: guarded-by(self._lock)
 
     def clients(self, address: str) -> List[RpcClient]:
         n = streams_per_peer()

@@ -106,7 +106,7 @@ def init(num_cpus: Optional[float] = None,
             # a later init(address=other_cluster) must not inherit this
             # cluster's token.
             global _displaced_auth_token
-            _displaced_auth_token = os.environ.get("RAY_TPU_AUTH_TOKEN")
+            _displaced_auth_token = os.environ.get("RAY_TPU_AUTH_TOKEN")  # raylint: guarded-by(_global_lock)
             os.environ["RAY_TPU_AUTH_TOKEN"] = auth_token
         if address is not None:
             from ray_tpu._private.distributed import DistributedRuntime
@@ -133,7 +133,7 @@ def init(num_cpus: Optional[float] = None,
                     raise
                 worker.dashboard_head = head
                 worker.dashboard_port = head.port
-            _global = worker
+            _global = worker  # raylint: allow(data-race) installed under _global_lock; unlocked peeks like is_initialized are GIL-atomic snapshots
             return _global
         runtime = Runtime()
         if _create_default_node:
@@ -147,9 +147,10 @@ def init(num_cpus: Optional[float] = None,
             if resources:
                 amounts.update(resources)
             runtime.add_node(ResourceSet(amounts))
-        _global = Worker(runtime, namespace or "default")
+        _global = Worker(runtime, namespace or "default")  # raylint: allow(data-race) installed under _global_lock; unlocked peeks like is_initialized are GIL-atomic snapshots
         if include_dashboard:
             from ray_tpu._private.state_server import start_state_server
+            # raylint: allow(data-race) dashboard_port set under _global_lock during init
             _global.dashboard_port = start_state_server(dashboard_port)
         return _global
 
@@ -177,7 +178,7 @@ def shutdown():
                 from ray_tpu._private.state_server import stop_state_server
                 stop_state_server()
             _global.runtime.shutdown()
-            _global = None
+            _global = None  # raylint: allow(data-race) cleared under _global_lock at shutdown; unlocked peeks are GIL-atomic snapshots
         global _displaced_auth_token
         if _displaced_auth_token is not _UNSET:
             if _displaced_auth_token is None:

@@ -139,7 +139,7 @@ class StandardAutoscaler:
                 continue
             self.provider.create_node(tname, count)
             launched += count
-        self.num_launches += launched
+        self.num_launches += launched  # raylint: allow(data-race) single autoscaler update loop is the only writer; counter is monitoring-only
         return launched
 
     def _scale_down(self) -> int:
@@ -158,14 +158,14 @@ class StandardAutoscaler:
                 continue
             info = util.get(rid)
             if info is None or not info["idle"]:
-                self._idle_since.pop(pid, None)
+                self._idle_since.pop(pid, None)  # raylint: allow(data-race) single autoscaler update loop is the only mutator of idle tracking
                 continue
-            first_idle = self._idle_since.setdefault(pid, now)
+            first_idle = self._idle_since.setdefault(pid, now)  # raylint: allow(data-race) single autoscaler update loop is the only mutator of idle tracking
             if now - first_idle >= self.config.idle_timeout_s:
                 self.provider.terminate_node(pid)
-                self._idle_since.pop(pid, None)
+                self._idle_since.pop(pid, None)  # raylint: allow(data-race) single autoscaler update loop is the only mutator of idle tracking
                 terminated += 1
-        self.num_terminations += terminated
+        self.num_terminations += terminated  # raylint: allow(data-race) single autoscaler update loop is the only writer; counter is monitoring-only
         return terminated
 
     # -- monitor thread (reference: Monitor process, monitor.py:125) ------

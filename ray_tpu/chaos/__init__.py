@@ -94,13 +94,13 @@ _observer = None
 
 def set_observer(fn) -> None:
     global _observer
-    _observer = fn
+    _observer = fn  # raylint: allow(data-race) observer installed once during chaos setup before faults fire
 
 
 def install(sched: FaultSchedule) -> FaultSchedule:
     """Install ``sched`` as the process-wide schedule and enable injection."""
     global ENABLED, _schedule
-    _schedule = sched
+    _schedule = sched  # raylint: allow(data-race) schedule installed once during chaos setup; inject() reads a GIL-atomic snapshot
     ENABLED = True
     return sched
 
@@ -114,7 +114,7 @@ def clear():
     """Disable injection and drop the schedule."""
     global ENABLED, _schedule
     ENABLED = False
-    _schedule = None
+    _schedule = None  # raylint: allow(data-race) uninstall is test teardown; inject() reads a GIL-atomic snapshot and tolerates None
 
 
 def schedule() -> Optional[FaultSchedule]:

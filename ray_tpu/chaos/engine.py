@@ -67,7 +67,7 @@ def register_exit_hook(fn) -> None:
     kills the process. Hooks are best-effort: exceptions are swallowed
     (the process is dying either way) and must not block."""
     if fn not in _exit_hooks:
-        _exit_hooks.append(fn)
+        _exit_hooks.append(fn)  # raylint: allow(data-race) GIL-atomic list append at setup; read once at injected process exit
 
 
 class ChaosError(RuntimeError):

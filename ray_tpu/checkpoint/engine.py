@@ -207,7 +207,7 @@ class _HashCache:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._entries: Dict[int, tuple] = {}
+        self._entries: Dict[int, tuple] = {}  # raylint: guarded-by(self._lock)
 
     def lookup(self, x: Any) -> Optional[tuple]:
         """(chunk_id, nbytes, dtype_str, shape) or None."""
@@ -278,8 +278,9 @@ class SaveHandle:
 
     def _finish(self, manifest_name: Optional[str],
                 error: Optional[BaseException]) -> None:
+        # raylint: allow(data-race) written before _done.set(); result() reads only after a successful wait
         self._manifest_name = manifest_name
-        self._error = error
+        self._error = error  # raylint: allow(data-race) written before _done.set(); result() reads only after a successful wait
         self._done.set()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
@@ -333,10 +334,10 @@ class CheckpointEngine:
             maxsize=max(1, int(_config.checkpoint_queue_depth)))
         self._writer: Optional[threading.Thread] = None
         self._writer_lock = threading.Lock()
-        self._inflight: List[SaveHandle] = []
+        self._inflight: List[SaveHandle] = []  # raylint: guarded-by(self._writer_lock)
         self._inflight_chunks: set = set()   # GC must not reap these
         self._closed = False
-        self.stats = EngineStats()
+        self.stats = EngineStats()  # raylint: guarded-by(self._stats_lock)
         self._stats_lock = threading.Lock()  # io-pool workers share stats
         self._hash_cache = _HashCache()
         self._pool: Optional[ThreadPoolExecutor] = None
@@ -393,13 +394,13 @@ class CheckpointEngine:
         # Bounded-queue backpressure: when the writer falls behind, this
         # put blocks the training thread — goodput's ``ckpt_stall``.
         try:
-            self._queue.put_nowait(job)
+            self._queue.put_nowait(job)  # raylint: allow(data-race) queue.Queue is internally synchronized
         except queue.Full:
             if goodput.ENABLED:
                 with goodput.interval("ckpt_stall"):
-                    self._queue.put(job)
+                    self._queue.put(job)  # raylint: allow(data-race) queue.Queue is internally synchronized
             else:
-                self._queue.put(job)
+                self._queue.put(job)  # raylint: allow(data-race) queue.Queue is internally synchronized
         if wait:
             if goodput.ENABLED:  # synchronous save: commit wait is a stall
                 with goodput.interval("ckpt_stall"):
@@ -529,7 +530,7 @@ class CheckpointEngine:
             # time — account the dedup without touching the bytes (no
             # host copy, no hash, no write)
             protected.append(leaf.chunk_id)
-            self._inflight_chunks.add(leaf.chunk_id)
+            self._inflight_chunks.add(leaf.chunk_id)  # raylint: allow(data-race) GIL-atomic set add; worst case protects a chunk from cleanup twice
             with self._stats_lock:
                 self.stats.chunks_deduped += 1
                 self.stats.bytes_deduped += leaf.nbytes
@@ -541,7 +542,7 @@ class CheckpointEngine:
         if t0:
             perf.observe("ckpt.hash", (time.monotonic() - t0) * 1e3)
         protected.append(chunk_id)
-        self._inflight_chunks.add(chunk_id)
+        self._inflight_chunks.add(chunk_id)  # raylint: allow(data-race) GIL-atomic set add; worst case protects a chunk from cleanup twice
         if leaf.origin is not None:
             self._hash_cache.remember(leaf.origin, chunk_id, leaf.nbytes,
                                       leaf.dtype, leaf.shape)
@@ -553,7 +554,8 @@ class CheckpointEngine:
         return chunk_id
 
     def _process_stages(self, job: _SaveJob) -> Optional[str]:
-        self.stats.saves += 1
+        with self._stats_lock:
+            self.stats.saves += 1
         protected: List[str] = []
         try:
             pool = self._io_pool()
@@ -598,7 +600,7 @@ class CheckpointEngine:
                                                        chunk_ids))]
             skel_id = mf.hash_bytes("skeleton", job.skeleton_frame)
             protected.append(skel_id)
-            self._inflight_chunks.add(skel_id)
+            self._inflight_chunks.add(skel_id)  # raylint: allow(data-race) GIL-atomic set add; worst case protects a chunk from cleanup twice
             if chaos.ENABLED:
                 chaos.inject("checkpoint.write", path="<skeleton>",
                              rank=str(job.rank))
@@ -623,6 +625,7 @@ class CheckpointEngine:
                 return None
             return self._commit(job, pend_dir)
         finally:
+            # raylint: allow(data-race) GIL-atomic set op; a racing saver re-adds its chunk before the next GC scan
             self._inflight_chunks.difference_update(
                 [c for c in protected if c])
 
@@ -654,7 +657,8 @@ class CheckpointEngine:
             mf.set_latest(self.root, name)
         if t0:
             perf.observe("ckpt.commit", (time.monotonic() - t0) * 1e3)
-        self.stats.commits += 1
+        with self._stats_lock:
+            self.stats.commits += 1
         self._register(name)
         self._cleanup_pending(pend_dir)
         if self.num_to_keep is not None:
@@ -770,7 +774,8 @@ class CheckpointEngine:
                     reaped += 1
                 except OSError as e:
                     logger.debug("checkpoint: gc skipped %s: %s", fn, e)
-        self.stats.chunks_gced += reaped
+        with self._stats_lock:
+            self.stats.chunks_gced += reaped
         return reaped
 
     # -- restore --------------------------------------------------------------
@@ -809,7 +814,7 @@ class CheckpointEngine:
             writer = self._writer
             pool = self._pool
         if writer is not None and writer.is_alive():
-            self._queue.put(None)
+            self._queue.put(None)  # raylint: allow(data-race) queue.Queue is internally synchronized
             writer.join(timeout=5.0)
         if pool is not None:
             pool.shutdown(wait=True)

@@ -23,9 +23,9 @@ from ray_tpu.collective.collective_group.xla_group import (XLAGroup,
 from ray_tpu.collective.types import Backend, ReduceOp
 
 _registry_lock = threading.Lock()
-_shared_groups: Dict[str, Any] = {}        # group_name -> Shared state
+_shared_groups: Dict[str, Any] = {}        # group_name -> Shared state  # raylint: guarded-by(_registry_lock)
 _local_groups = threading.local()          # per-caller rank-bound groups
-_process_joined: set = set()               # process-level plane memberships
+_process_joined: set = set()               # process-level plane memberships  # raylint: guarded-by(_registry_lock)
 
 
 def _spans_processes() -> bool:

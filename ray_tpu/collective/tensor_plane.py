@@ -55,6 +55,7 @@ def _mark(event: str, group: str, epoch: int, **args) -> None:
         if _epoch_gauge is None:
             from ray_tpu.observability.metric_names import TPLANE_EPOCH_GAUGE
             from ray_tpu.util import metrics
+            # raylint: allow(data-race) idempotent lazy gauge init; the metrics registry dedups by name
             _epoch_gauge = metrics.Gauge(
                 TPLANE_EPOCH_GAUGE,
                 "active tensor-plane epoch per group (-1 once shut down)",

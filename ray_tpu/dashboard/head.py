@@ -579,9 +579,10 @@ class DashboardHead:
             def log_message(self, *a):
                 pass
 
+        # raylint: allow(data-race) start() runs once from the owning process before the serve thread exists
         self._httpd = http.server.ThreadingHTTPServer(
             (self._host, self._want_port), Handler)
-        self.port = self._httpd.server_address[1]
+        self.port = self._httpd.server_address[1]  # raylint: allow(data-race) start() runs once from the owning process before the serve thread exists
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
             name="dashboard-head")
@@ -592,7 +593,7 @@ class DashboardHead:
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
-            self._httpd = None
+            self._httpd = None  # raylint: allow(data-race) stop() runs after shutdown() has joined the serve loop; no reader remains
         try:
             self.pool.close_all()
         except Exception as e:

@@ -78,7 +78,8 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 __all__ = ["ProjectIndex", "FunctionInfo", "ClassInfo", "ModuleInfo",
-           "CallSite", "module_name_for"]
+           "CallSite", "module_name_for", "FieldAccess", "FieldPlan",
+           "field_display"]
 
 _LOCKISH = re.compile(r"(^|[._])(lock|mutex|cv|cond|sem)", re.IGNORECASE)
 
@@ -171,6 +172,45 @@ class ClassInfo:
 
 
 @dataclass
+class FieldAccess:
+    """One shared-attribute access site, expanded with the thread
+    contexts that can reach it.  ``ctxs[name]`` is the *effective*
+    lockset there: locks held lexically at the site, unioned with the
+    locks every path from that context's root must hold on entry to the
+    enclosing function (intersection over call paths — a must-analysis,
+    so a lock only counts when it is provably held)."""
+    key: str                       # "mod:Cls.attr" | "mod.name"
+    rel: str                       # file, lint-root relative
+    line: int
+    mode: str                      # read | write | mutate
+    fnq: str                       # enclosing function qname
+    locks: Tuple[str, ...]         # lexically held at the site
+    wconst: str                    # "flag" for True/False/None writes
+    ctxs: Dict[str, frozenset] = field(default_factory=dict)
+
+
+@dataclass
+class FieldPlan:
+    """Joined whole-program field-safety facts for R23-R25."""
+    roots: Dict[str, Tuple[str, int, str]]        # ctx -> (rel, line, how)
+    contexts: Dict[str, Dict[str, frozenset]]     # fnq -> ctx -> must-held
+    accesses: Dict[str, List[FieldAccess]]        # key -> live sites
+    guarded: Dict[str, Tuple[str, str, int]]      # key -> (lock, rel, line)
+    splits: List[Tuple[str, str, int, int, str]]  # (fnq,key,rline,wline,kind)
+    init_only: Set[str]                           # construction-only fns
+    atomic_keys: Set[str]
+    flag_keys: Set[str]                           # bool fast-path fields
+    spawns_in: Dict[str, List[Tuple[str, int]]]   # fnq -> [(root, line)]
+
+
+def field_display(key: str) -> str:
+    """Human/runtime-correlatable name for a field key: strip the module
+    qualifier from ``mod:Cls.attr`` so static R25 findings and lockwatch
+    level-2 reports (which only know ``Cls.attr``) compare equal."""
+    return key.split(":", 1)[1] if ":" in key else key
+
+
+@dataclass
 class ModuleInfo:
     name: str
     ctx: object
@@ -184,7 +224,8 @@ class ProjectIndex:
     """Symbol table + resolved call graph over a set of FileContexts."""
 
     def __init__(self, ctxs: Iterable[object],
-                 stitch_facts: Optional[Dict[str, dict]] = None):
+                 stitch_facts: Optional[Dict[str, dict]] = None,
+                 field_facts: Optional[Dict[str, dict]] = None):
         self.modules: Dict[str, ModuleInfo] = {}
         self.functions: Dict[str, FunctionInfo] = {}
         self.classes: Dict[str, ClassInfo] = {}
@@ -207,6 +248,12 @@ class ProjectIndex:
         self.stitch_facts: Dict[str, dict] = {}
         self.stitch_hits = 0
         self._stitch_rpc(stitch_facts or {})
+        # per-file field facts (R23-R25), built lazily by field_plan():
+        # ``field_facts`` replays hash-validated entries from the cache
+        self.field_facts: Dict[str, dict] = {}
+        self.field_hits = 0
+        self._field_cache: Dict[str, dict] = field_facts or {}
+        self._plan: Optional[FieldPlan] = None
 
     # -- construction ------------------------------------------------------
 
@@ -729,6 +776,222 @@ class ProjectIndex:
                             kind="rpc", locks_held=tuple(held)))
                     self.rpc_sites.append(
                         (hq, line, method, bool(sync), tuple(held), targets))
+
+    # -- field-level thread-safety plan (R23-R25) --------------------------
+
+    def _file_field_facts(self, rel: str) -> dict:
+        """JSON-able per-file field facts, a pure function of that one
+        file's source (cacheable under its content hash): shared-attribute
+        access records and atomicity splits per function (synthetic rpc
+        arms included — their accesses carry the arm's thread context),
+        guarded-by declarations, atomic-typed attributes, and the tracked
+        module-global name set."""
+        from ray_tpu.devtools import dataflow as _df
+        ctx = self.ctx_of[rel]
+        mod_name = module_name_for(rel)
+        gnames = _df.module_global_names(ctx.tree)
+        accesses: Dict[str, List[list]] = {}
+        splits: Dict[str, List[list]] = {}
+        for q in sorted(self.functions):
+            fn = self.functions[q]
+            if fn.ctx is not ctx:
+                continue
+            acc, spl = _df._FieldScan(fn, self, gnames).run()
+            if acc:
+                accesses[q] = acc
+            if spl:
+                splits[q] = spl
+        return {
+            "accesses": accesses,
+            "splits": splits,
+            "guarded": _df.guarded_decls(ctx, mod_name, self),
+            "atomic": _df.atomic_attr_keys(ctx, mod_name, self),
+            "globals": sorted(gnames),
+        }
+
+    def field_facts_all(self) -> Dict[str, dict]:
+        if not self.field_facts:
+            for rel in sorted(self.ctx_of):
+                facts = self._field_cache.get(rel)
+                if facts is not None:
+                    self.field_hits += 1
+                else:
+                    facts = self._file_field_facts(rel)
+                self.field_facts[rel] = facts
+        return self.field_facts
+
+    def field_plan(self) -> FieldPlan:
+        """Join the per-file field facts with thread contexts and
+        interprocedural must-hold locksets (memoized; built on demand by
+        the first of R23-R25 to run).
+
+        *Thread contexts* are the distinct roots code can run under:
+        ``main`` (module import / direct API calls), every resolved
+        ``spawn`` target (Thread/executor submit/call_soon_threadsafe),
+        every ``Thread`` subclass ``run``, and every synthesized RPC
+        dispatch arm.  Contexts propagate over ``call``/``loop`` edges
+        (``loop`` resets the held-lock set: the task runs later); they do
+        NOT cross ``spawn``/``rpc`` edges — the callee side is its own
+        root.  Per (function, context) the must-held lockset is the
+        intersection over all call paths from the root, so it can only
+        shrink as more paths are discovered (sound for a race checker).
+        """
+        if self._plan is not None:
+            return self._plan
+        facts = self.field_facts_all()
+        # 1. thread roots + spawn bookkeeping (for the happens-before-
+        #    spawn suppression: a write before the spawn cannot race with
+        #    the thread it starts)
+        roots: Dict[str, Tuple[str, int, str]] = {}
+        spawns_in: Dict[str, List[Tuple[str, int]]] = {}
+        for q in sorted(self.functions):
+            fn = self.functions[q]
+            for s in fn.call_sites:
+                if s.kind == "spawn" and s.target in self.functions:
+                    roots.setdefault(
+                        s.target, (fn.ctx.relpath, s.line,
+                                   f"spawned from {q}"))
+                    spawns_in.setdefault(q, []).append((s.target, s.line))
+            if fn.synthetic == "rpc-arm":
+                roots.setdefault(q, (fn.ctx.relpath, fn.node.lineno,
+                                     "rpc dispatch arm"))
+        for cq in sorted(self.classes):
+            cls = self.classes[cq]
+            if any(b.rsplit(".", 1)[-1] == "Thread" for b in cls.bases):
+                run = cls.methods.get("run")
+                if run is not None:
+                    roots.setdefault(run.qname,
+                                     (run.ctx.relpath, run.node.lineno,
+                                      f"{cls.name}.run"))
+        # 2. nested defs never become main entries: they only run when
+        #    (and where) their enclosing function invokes them
+        by_node = {id(f.node): q for q, f in self.functions.items()
+                   if not f.synthetic}
+        nested: Set[str] = set()
+        for q, fn in self.functions.items():
+            if fn.synthetic:
+                continue
+            for node in ast.walk(fn.node):
+                if node is not fn.node and id(node) in by_node:
+                    nested.add(by_node[id(node)])
+        # 3. context fixpoint over call/loop edges
+        callers: Dict[str, List[str]] = {}
+        callees_of: Dict[str, List[CallSite]] = {}
+        for q, fn in self.functions.items():
+            outs = [s for s in fn.call_sites
+                    if s.kind in ("call", "loop")
+                    and s.target in self.functions]
+            callees_of[q] = outs
+            for s in outs:
+                callers.setdefault(s.target, []).append(q)
+        contexts: Dict[str, Dict[str, frozenset]] = {}
+        work: List[str] = []
+        for q in sorted(roots):
+            contexts[q] = {q: frozenset()}
+            work.append(q)
+        for q in sorted(self.functions):
+            fn = self.functions[q]
+            if fn.synthetic or q in roots or q in nested or q in callers:
+                continue
+            contexts[q] = {"main": frozenset()}
+            work.append(q)
+        while work:
+            q = work.pop()
+            cur = contexts.get(q)
+            if not cur:
+                continue
+            for s in callees_of.get(q, ()):
+                tgt = contexts.setdefault(s.target, {})
+                changed = False
+                for cname, held in cur.items():
+                    eff = frozenset() if s.kind == "loop" else \
+                        held | frozenset(s.locks_held)
+                    old = tgt.get(cname)
+                    if old is None:
+                        tgt[cname] = eff
+                        changed = True
+                    elif not (old <= eff):
+                        tgt[cname] = old & eff
+                        changed = True
+                if changed:
+                    work.append(s.target)
+        # 4. construction-only closure: accesses there touch an instance
+        #    no other thread can see yet (fresh-instance assumption —
+        #    single-writer-before-spawn / immutable-after-init)
+        init_names = {"__init__", "__new__", "__post_init__"}
+        init_only: Set[str] = {q for q, fn in self.functions.items()
+                               if fn.name in init_names and not fn.synthetic}
+        changed = True
+        while changed:
+            changed = False
+            for q, fn in self.functions.items():
+                if q in init_only or fn.synthetic or q in roots:
+                    continue
+                cl = callers.get(q)
+                if cl and all(c in init_only for c in cl):
+                    init_only.add(q)
+                    changed = True
+        # 5. suppression sets + declarations, merged across files
+        globals_of: Dict[str, Set[str]] = {}
+        atomic_keys: Set[str] = set()
+        guarded: Dict[str, Tuple[str, str, int]] = {}
+        splits: List[Tuple[str, str, int, int, str]] = []
+        for rel in sorted(facts):
+            f = facts[rel]
+            globals_of[module_name_for(rel)] = set(f.get("globals") or ())
+            atomic_keys.update(f.get("atomic") or ())
+            for key, lock, line in f.get("guarded") or ():
+                guarded.setdefault(key, (lock, rel, line))
+            for fnq in sorted(f.get("splits") or {}):
+                for key, rline, wline, kind in f["splits"][fnq]:
+                    splits.append((fnq, key, rline, wline, kind))
+        # 6. expand access records with contexts; dedupe sites the stitch
+        #    pass duplicated into rpc arms (same key/rel/line/mode) by
+        #    unioning their context maps
+        site_map: Dict[Tuple[str, str, int, str], FieldAccess] = {}
+        for rel in sorted(facts):
+            for fnq in sorted(facts[rel].get("accesses") or {}):
+                fn = self.functions.get(fnq)
+                if fn is None:
+                    continue
+                fctxs = contexts.get(fnq) or {}
+                if not fctxs or fnq in init_only:
+                    continue
+                for line, key, mode, locks, wconst in \
+                        facts[rel]["accesses"][fnq]:
+                    if key in atomic_keys:
+                        continue
+                    if ":" not in key:
+                        kmod, _, kname = key.rpartition(".")
+                        tracked = globals_of.get(kmod)
+                        if tracked is None or kname not in tracked:
+                            continue
+                    ident = (key, rel, line, mode)
+                    fa = site_map.get(ident)
+                    if fa is None:
+                        fa = FieldAccess(key=key, rel=rel, line=line,
+                                         mode=mode, fnq=fnq,
+                                         locks=tuple(locks), wconst=wconst)
+                        site_map[ident] = fa
+                    for cname, held in fctxs.items():
+                        eff = frozenset(locks) | held
+                        old = fa.ctxs.get(cname)
+                        fa.ctxs[cname] = eff if old is None else (old & eff)
+        by_key: Dict[str, List[FieldAccess]] = {}
+        for ident in sorted(site_map):
+            fa = site_map[ident]
+            by_key.setdefault(fa.key, []).append(fa)
+        flag_keys = {
+            key for key, lst in by_key.items()
+            if any(a.mode == "write" for a in lst)
+            and not any(a.mode == "mutate" for a in lst)
+            and all(a.wconst == "flag" for a in lst if a.mode == "write")}
+        self._plan = FieldPlan(
+            roots=roots, contexts=contexts, accesses=by_key,
+            guarded=guarded, splits=splits, init_only=init_only,
+            atomic_keys=atomic_keys, flag_keys=flag_keys,
+            spawns_in=spawns_in)
+        return self._plan
 
     # -- fixpoint helpers for the interprocedural rules --------------------
 

@@ -47,8 +47,10 @@ argument) and can be asserted explicitly where the sink is dynamic::
 from __future__ import annotations
 
 import ast
+import io
 import os
 import re
+import tokenize
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
@@ -57,7 +59,8 @@ __all__ = [
     "naked_blocking", "deadline_params", "arms_backoff_budget",
     "protocol_sends", "protocol_handlers", "native_protocol_facts",
     "proto_method_names", "reply_candidates", "lifecycle_writes",
-    "NODE_LIFECYCLE",
+    "NODE_LIFECYCLE", "module_global_names", "guarded_decls",
+    "atomic_attr_keys", "ATOMIC_TYPE_LEAVES",
 ]
 
 _TRANSFER_RE = re.compile(r"#\s*raylint:\s*transfer\(([A-Za-z0-9_,\- ]+)\)")
@@ -1074,3 +1077,467 @@ def lifecycle_writes(ctxs) -> List[Tuple[object, int, str, Set[str], str,
         for child in ast.iter_child_nodes(ctx.tree):
             visit(child, ctx, [])
     return out
+
+
+# --------------------------------------------------------------------------
+# field-level thread-safety facts (R23-R25)
+#
+# Per-function shared-attribute access records, in-function atomicity-split
+# candidates, `# raylint: guarded-by(...)` declarations, and the per-module
+# tracked-global/atomic-attribute sets.  Every output here is JSON-able and
+# a pure function of ONE file's source, so the linter caches it under the
+# file's content hash exactly like the stitch facts; the callgraph layer
+# (ProjectIndex.field_plan) joins the records with thread contexts and
+# interprocedural must-hold locksets.
+#
+# Under-approximation stance, same polarity as the rest of this module: a
+# construct the scanner does not understand contributes no access record,
+# so the field rules can miss a race through dynamic attribute names or
+# getattr() but never report a site that does not textually exist.
+
+_GUARDED_RE = re.compile(r"#\s*raylint:\s*guarded-by\(([^)]+)\)")
+
+#: method names that mutate their receiver in place — a call through a
+#: shared attribute with one of these is a write for race purposes
+_MUTATOR_ATTRS = frozenset({
+    "append", "extend", "insert", "pop", "popitem", "remove", "discard",
+    "add", "clear", "update", "setdefault", "sort", "reverse",
+    "appendleft", "popleft", "rotate", "put", "put_nowait",
+})
+
+#: constructor leaf names whose instances are internally synchronized (or
+#: atomic by construction, like itertools.count under the GIL); attributes
+#: assigned from them are exempt from the field rules — calling their
+#: methods IS the synchronization
+ATOMIC_TYPE_LEAVES = frozenset({
+    "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue", "deque",
+    "Event", "Condition", "Semaphore", "BoundedSemaphore", "Barrier",
+    "local", "Lock", "RLock", "count", "Thread", "Timer",
+    "ThreadPoolExecutor", "ProcessPoolExecutor",
+})
+
+#: attributes/globals the field analysis never tracks: dunders and the
+#: locks themselves (lock objects are the synchronization, not the state)
+_FIELD_SKIP_RE = re.compile(
+    r"(^__)|((^|[._])(lock|mutex|cv|cond|sem))", re.IGNORECASE)
+
+
+def module_global_names(tree: ast.AST) -> Set[str]:
+    """Module globals the field analysis tracks: names assigned at module
+    top level plus every name in an ``ast.Global`` statement (container
+    globals are mutated without ``global``, so top-level binding is the
+    signal that matters)."""
+    out: Set[str] = set()
+    for stmt in tree.body:
+        targets: List[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            targets = [stmt.target]
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Global):
+            out.update(node.names)
+    return out
+
+
+class _FieldScan:
+    """One function's shared-attribute accesses and atomicity splits.
+
+    Mirrors the ``held``-stack walk of ``ProjectIndex._analyze`` but also
+    tracks *acquisition identity* (the ``with``-statement line), so a
+    release-and-retake of the same lock between a read and its dependent
+    write is visible — that gap is exactly what R24 reports.  Emits:
+
+    - access records ``[line, key, mode, locks, wconst]`` where ``key`` is
+      ``mod:Cls.attr`` for ``self.attr`` or ``mod.name`` for a module
+      global, ``mode`` is ``read``/``write``/``mutate``, ``locks`` is the
+      lexically-held lock-id set, and ``wconst`` is ``"flag"`` for
+      True/False/None constant writes (the bool fast-path suppression);
+    - split records ``[key, read_line, write_line, kind]`` for
+      check-then-act and read-modify-write sequences whose read and write
+      share no lock acquisition (double-checked re-reads under the write's
+      acquisition suppress the candidate).
+    """
+
+    def __init__(self, fn, index, global_names: Set[str]):
+        self.fn = fn
+        self.index = index
+        self.mod = fn.module
+        self.global_names = global_names
+        self.accesses: List[list] = []
+        self.splits: List[list] = []
+        self._held: List[Tuple[str, int]] = []     # (lock id, with line)
+        self._reads: List[Tuple[str, int, frozenset]] = []  # scan order
+        self._checks: List[Dict[str, Tuple[int, frozenset]]] = []
+        self._bind: Dict[str, Tuple[str, int, frozenset]] = {}
+        self._gdecls: Set[str] = set()
+        self._locals: Set[str] = set()
+        a = fn.node.args
+        for p in list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs):
+            self._locals.add(p.arg)
+        for va in (a.vararg, a.kwarg):
+            if va is not None:
+                self._locals.add(va.arg)
+        for node in FunctionDataflow._walk_pruned(fn.node):
+            if isinstance(node, ast.Global):
+                self._gdecls.update(node.names)
+            elif isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)):
+                self._locals.add(node.id)
+            elif isinstance(node, ast.ExceptHandler) and node.name:
+                self._locals.add(node.name)
+        self._locals -= self._gdecls
+
+    def run(self) -> Tuple[List[list], List[list]]:
+        for stmt in self.fn.node.body:
+            self._scan(stmt)
+        return self.accesses, self.splits
+
+    # -- keys --------------------------------------------------------------
+
+    def _self_key(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self" and self.fn.cls and \
+                not _FIELD_SKIP_RE.search(node.attr):
+            return f"{self.mod}:{self.fn.cls}.{node.attr}"
+        return None
+
+    def _global_key(self, node: ast.Name) -> Optional[str]:
+        nid = node.id
+        if _FIELD_SKIP_RE.search(nid):
+            return None
+        if nid in self._gdecls or (nid in self.global_names
+                                   and nid not in self._locals):
+            return f"{self.mod}.{nid}"
+        return None
+
+    def _extern_key(self, node: ast.AST) -> Optional[str]:
+        """``othermod.NAME`` write target, resolved through this module's
+        import aliases (validated against the target module's tracked
+        globals at plan time — only writes are recorded cross-module, so
+        stdlib attribute noise never enters the fact store)."""
+        if not (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)):
+            return None
+        if _FIELD_SKIP_RE.search(node.attr):
+            return None
+        mod = self.index.modules.get(self.mod)
+        target = mod.imports.get(node.value.id) if mod is not None else None
+        if target is None:
+            return None
+        return f"{target}.{node.attr}"
+
+    # -- recording ---------------------------------------------------------
+
+    def _rec(self, line: int, key: str, mode: str, wconst: str = "") -> None:
+        locks = sorted({l for l, _ in self._held})
+        self.accesses.append([line, key, mode, locks, wconst])
+        if mode == "read":
+            acqs = frozenset(a for _, a in self._held)
+            self._reads.append((key, line, acqs))
+
+    def _note_check_then_act(self, key: str, wline: int,
+                             wacqs: frozenset) -> None:
+        # nearest enclosing if/while test that read this key
+        for frame in reversed(self._checks):
+            info = frame.get(key)
+            if info is None:
+                continue
+            tline, tacqs = info
+            if (tacqs & wacqs) or not (tacqs | wacqs):
+                return              # same acquisition spans both, or R23's job
+            rechecked = any(
+                k == key and line > tline and (acqs & wacqs)
+                for k, line, acqs in self._reads)
+            if not rechecked:       # double-checked locking stays quiet
+                self.splits.append([key, tline, wline, "check-then-act"])
+            return
+
+    def _note_rmw(self, key: str, wline: int, wacqs: frozenset,
+                  value_reads, value_names) -> None:
+        cands = [(line, acqs) for k, line, acqs in value_reads if k == key]
+        for name in value_names:
+            b = self._bind.get(name)
+            if b is not None and b[0] == key:
+                cands.append((b[1], b[2]))
+        for rline, racqs in cands:
+            if (racqs & wacqs) or not (racqs | wacqs):
+                continue
+            self.splits.append([key, rline, wline, "read-modify-write"])
+            return
+
+    def _write_target(self, t: ast.AST, wconst: str,
+                      value_reads, value_names) -> None:
+        wacqs = frozenset(a for _, a in self._held)
+        if isinstance(t, ast.Attribute):
+            key = self._self_key(t) or self._extern_key(t)
+            if key:
+                self._rec(t.lineno, key, "write", wconst)
+                self._note_check_then_act(key, t.lineno, wacqs)
+                self._note_rmw(key, t.lineno, wacqs, value_reads,
+                               value_names)
+                return
+            # chained target like ``self.cfg.max = v``: mutates the object
+            # held in the inner shared attribute
+            inner = None
+            if isinstance(t.value, ast.Attribute):
+                inner = self._self_key(t.value)
+            elif isinstance(t.value, ast.Name):
+                inner = self._global_key(t.value)
+            if inner:
+                self._rec(t.value.lineno, inner, "mutate")
+            else:
+                self._scan(t.value)
+            return
+        if isinstance(t, ast.Subscript):
+            base = t.value
+            key = None
+            if isinstance(base, ast.Attribute):
+                key = self._self_key(base) or self._extern_key(base)
+            elif isinstance(base, ast.Name):
+                key = self._global_key(base)
+            if key:
+                self._rec(base.lineno, key, "mutate")
+                self._note_check_then_act(key, base.lineno, wacqs)
+                self._note_rmw(key, base.lineno, wacqs, value_reads,
+                               value_names)
+                self._scan(t.slice)
+            else:
+                self._scan(base)
+                self._scan(t.slice)
+            return
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._write_target(e, "", value_reads, value_names)
+            return
+        if isinstance(t, ast.Starred):
+            self._write_target(t.value, "", value_reads, value_names)
+            return
+        if isinstance(t, ast.Name):
+            if t.id in self._gdecls and not _FIELD_SKIP_RE.search(t.id):
+                key = f"{self.mod}.{t.id}"
+                self._rec(t.lineno, key, "write", wconst)
+                self._note_check_then_act(key, t.lineno, wacqs)
+                self._note_rmw(key, t.lineno, wacqs, value_reads,
+                               value_names)
+            return
+
+    # -- walk --------------------------------------------------------------
+
+    def _scan(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return                  # nested defs are their own FunctionInfo
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            pushed = 0
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Call):
+                    self._scan(item.context_expr)
+                lid = self.index._lock_identity(item.context_expr, self.fn)
+                if lid:
+                    self._held.append((lid, node.lineno))
+                    pushed += 1
+            for stmt in node.body:
+                self._scan(stmt)
+            del self._held[len(self._held) - pushed:]
+            return
+        if isinstance(node, (ast.If, ast.While)):
+            n0 = len(self._reads)
+            self._scan(node.test)
+            frame: Dict[str, Tuple[int, frozenset]] = {}
+            for k, line, acqs in self._reads[n0:]:
+                frame.setdefault(k, (line, acqs))
+            self._checks.append(frame)
+            for stmt in node.body:
+                self._scan(stmt)
+            for stmt in node.orelse:
+                self._scan(stmt)
+            self._checks.pop()
+            return
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            n0 = len(self._reads)
+            if node.value is not None:
+                self._scan(node.value)
+            value_reads = self._reads[n0:]
+            value_names = {n.id for n in ast.walk(node.value)
+                           if isinstance(n, ast.Name)
+                           and isinstance(n.ctx, ast.Load)} \
+                if node.value is not None else set()
+            wconst = ""
+            if isinstance(node.value, ast.Constant) and \
+                    any(node.value.value is v for v in (True, False, None)):
+                wconst = "flag"
+            targets = list(node.targets) if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                self._write_target(t, wconst, value_reads, value_names)
+            if isinstance(node, ast.Assign) and len(targets) == 1 and \
+                    isinstance(targets[0], ast.Name) and \
+                    targets[0].id not in self._gdecls:
+                if value_reads:
+                    self._bind[targets[0].id] = value_reads[0]
+                else:
+                    self._bind.pop(targets[0].id, None)
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                self._write_target(t, "", [], set())
+            return
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                recv = f.value
+                key = None
+                if isinstance(recv, ast.Attribute):
+                    key = self._self_key(recv)
+                elif isinstance(recv, ast.Name):
+                    key = self._global_key(recv)
+                if key:
+                    mode = "mutate" if f.attr in _MUTATOR_ATTRS else "read"
+                    self._rec(recv.lineno, key, mode)
+                else:
+                    self._scan(recv)
+            else:
+                self._scan(f)
+            for arg in node.args:
+                self._scan(arg)
+            for kw in node.keywords:
+                self._scan(kw.value)
+            return
+        if isinstance(node, ast.Subscript):
+            base = node.value
+            key = None
+            if isinstance(base, ast.Attribute):
+                key = self._self_key(base)
+            elif isinstance(base, ast.Name):
+                key = self._global_key(base)
+            if key:
+                self._rec(base.lineno, key, "read")
+            else:
+                self._scan(base)
+            self._scan(node.slice)
+            return
+        if isinstance(node, ast.Attribute):
+            key = self._self_key(node)
+            if key:
+                mode = "read" if isinstance(node.ctx, ast.Load) else "write"
+                self._rec(node.lineno, key, mode)
+                return
+            self._scan(node.value)
+            return
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load):
+                key = self._global_key(node)
+                if key:
+                    self._rec(node.lineno, key, "read")
+            return
+        for child in ast.iter_child_nodes(node):
+            self._scan(child)
+
+
+def guarded_decls(ctx, module_name: str, index) -> List[list]:
+    """``[key, lock_id, line]`` per ``# raylint: guarded-by(...)``
+    declaration in *ctx*.  A declaration attaches to the assignment on the
+    same line (or the line directly above, like ``allow``); the lock
+    expression resolves exactly like ``ProjectIndex._lock_identity``:
+    ``self._lock`` -> ``Cls._lock``, a bare name -> ``mod.name``, an
+    import-alias attribute -> the defining module's node."""
+    comments: Dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(ctx.source).readline):
+            if tok.type == tokenize.COMMENT:
+                m = _GUARDED_RE.search(tok.string)
+                if m:
+                    comments[tok.start[0]] = m.group(1).strip()
+    except tokenize.TokenError:
+        pass
+    if not comments:
+        return []
+    mod = index.modules.get(module_name)
+
+    def resolve_lock(text: str, clsname: Optional[str]) -> str:
+        if text.startswith("self."):
+            return f"{clsname or '?'}.{text[5:]}"
+        if "." not in text:
+            return f"{module_name}.{text}"
+        parts = text.split(".")
+        if mod is not None and parts[0] in mod.imports and \
+                mod.imports[parts[0]] in index.modules:
+            return ".".join([mod.imports[parts[0]]] + parts[1:])
+        return text
+
+    decls: List[list] = []
+    # comment lines claimed by an inline declaration: the line-above
+    # fallback must not re-attach them to the *next* statement
+    inline_lines: Set[int] = set()
+
+    def attach(stmt, clsname: Optional[str]) -> None:
+        lock_txt = None
+        for ln in range(stmt.lineno,
+                        getattr(stmt, "end_lineno", stmt.lineno) + 1):
+            if ln in comments:
+                lock_txt = comments[ln]
+                inline_lines.add(ln)
+                break
+        if lock_txt is None and stmt.lineno - 1 not in inline_lines:
+            lock_txt = comments.get(stmt.lineno - 1)
+        if lock_txt is None:
+            return
+        lock = resolve_lock(lock_txt, clsname)
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        for t in targets:
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and \
+                    t.value.id == "self" and clsname:
+                decls.append([f"{module_name}:{clsname}.{t.attr}", lock,
+                              stmt.lineno])
+            elif isinstance(t, ast.Name):
+                key = f"{module_name}:{clsname}.{t.id}" if clsname \
+                    else f"{module_name}.{t.id}"
+                decls.append([key, lock, stmt.lineno])
+
+    def walk(node, clsname):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, child.name)
+                continue
+            if isinstance(child, (ast.Assign, ast.AnnAssign)):
+                attach(child, clsname)
+            walk(child, clsname)
+
+    walk(ctx.tree, None)
+    return decls
+
+
+def atomic_attr_keys(ctx, module_name: str, index) -> List[str]:
+    """Keys of attributes/globals assigned from an internally-synchronized
+    constructor (``queue.Queue``, ``threading.Event``,
+    ``itertools.count``, ...) — exempt from the field rules."""
+    out: Set[str] = set()
+
+    def walk(node, clsname):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, child.name)
+                continue
+            if isinstance(child, ast.Assign) and \
+                    isinstance(child.value, ast.Call):
+                dn = _resolved_dotted(child.value.func, ctx) or ""
+                if dn.rsplit(".", 1)[-1] in ATOMIC_TYPE_LEAVES:
+                    for t in child.targets:
+                        if isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id == "self" and clsname:
+                            out.add(f"{module_name}:{clsname}.{t.attr}")
+                        elif isinstance(t, ast.Name) and clsname is None:
+                            out.add(f"{module_name}.{t.id}")
+            walk(child, clsname)
+
+    walk(ctx.tree, None)
+    return sorted(out)

@@ -2300,6 +2300,336 @@ def check_metric_registry(ctx: FileContext) -> Iterator[Finding]:
 
 
 # --------------------------------------------------------------------------
+# R23-R25: field-level thread-safety — whole-program lockset analysis
+#
+# All three rules consume ``ProjectIndex.field_plan()``: per shared
+# attribute (``self._x`` / module global), every access site reachable
+# from >=2 thread roots, with the *effective* lockset there (locks held
+# lexically, unioned with the must-hold intersection over every call
+# path from the thread root).  Under-approximation stance throughout:
+# a lock only counts as held when provably held, a context only exists
+# when the spawn edge resolved — so the rules can under-report but a
+# reported witness is real modulo the documented suppressions.
+
+
+def _field_site(rel: str, line: int) -> str:
+    """lockwatch's ``pkg/file.py:line`` site format, so static witnesses
+    and runtime lockwatch reports correlate by string."""
+    return (f"{os.path.basename(os.path.dirname(rel))}/"
+            f"{os.path.basename(rel)}:{line}")
+
+
+def _ctx_label(plan: "_cg.FieldPlan", cname: str) -> str:
+    """Human name for a thread context: ``main`` or the root's
+    provenance plus its spawn/dispatch site."""
+    root = plan.roots.get(cname)
+    if root is None:
+        return cname
+    rel, line, how = root
+    return f"{how} @ {_field_site(rel, line)}"
+
+
+def _lockset_str(locks: Iterable[str]) -> str:
+    inner = ", ".join(sorted(locks))
+    return "[" + inner + "]" if inner else "[none]"
+
+
+def _happens_before_spawn(plan: "_cg.FieldPlan", access: "_cg.FieldAccess",
+                          other_ctx: str) -> bool:
+    """*access* sits in a function that itself spawns *other_ctx*'s root
+    at a later line: the classic single-writer-before-spawn handoff —
+    Thread.start() publishes everything written before it."""
+    for root, line in plan.spawns_in.get(access.fnq, ()):
+        if root == other_ctx and access.line <= line:
+            return True
+    return False
+
+
+def _guard_decl_for(idx: "_cg.ProjectIndex", plan: "_cg.FieldPlan",
+                    key: str) -> Optional[Tuple[str, str, int]]:
+    """The ``guarded-by`` declaration covering *key*: exact match, or one
+    declared on a related class — the field is assigned (and declared) in
+    a base-class ``__init__``, but accesses from subclass-defined methods
+    key under the subclass (``Counter._values`` vs ``Metric._values``)."""
+    hit = plan.guarded.get(key)
+    if hit is not None or ":" not in key:
+        return hit
+    fld = key.split(":", 1)[1]
+    if "." not in fld:
+        return None
+    kcls, attr = fld.rsplit(".", 1)
+    for dkey in sorted(plan.guarded):
+        if ":" not in dkey:
+            continue
+        dfld = dkey.split(":", 1)[1]
+        if "." not in dfld:
+            continue
+        dcls, dattr = dfld.rsplit(".", 1)
+        if dattr == attr and _classes_related(idx, kcls, dcls):
+            return plan.guarded[dkey]
+    return None
+
+
+def _field_race_witness(plan: "_cg.FieldPlan", w: "_cg.FieldAccess",
+                        o: "_cg.FieldAccess"
+                        ) -> Optional[Tuple[str, str]]:
+    """A (write-context, other-context) pair under which *w* and *o* can
+    interleave with no common lock, or None.  Deterministic: contexts are
+    scanned in sorted order, so the first witness is stable across runs."""
+    for wc in sorted(w.ctxs):
+        for oc in sorted(o.ctxs):
+            if wc == oc:
+                continue
+            if w.ctxs[wc] & o.ctxs[oc]:
+                continue
+            if _happens_before_spawn(plan, w, oc) or \
+                    _happens_before_spawn(plan, o, wc):
+                continue
+            return wc, oc
+    return None
+
+
+@project_rule("R23", "data-race")
+def check_data_race(ctxs: List[FileContext], engine) -> Iterator[Finding]:
+    """Whole-program data race: a shared attribute (``self._x`` or a
+    module global) written in one thread context and read/written in
+    another with an empty lockset intersection between the two sites.
+    Thread contexts are the spawn roots the call graph proves distinct —
+    ``threading.Thread`` targets, executor submits, RPC dispatch arms,
+    ``Thread`` subclass ``run`` methods — plus ``main``.  Suppressed, to
+    keep the rule honest: fields only touched during construction
+    (immutable-after-init), writes that happen before the racing thread
+    is spawned (single-writer handoff), bool/None fast-path flags (torn
+    writes are impossible for a pointer-sized constant), atomic-style
+    containers (``queue.Queue``, ``deque``, ``Event``, ...), and fields
+    carrying a ``guarded-by`` declaration (R25 enforces those).  The
+    witness names both thread roots in lockwatch's site format."""
+    idx = engine.index(ctxs)
+    plan = idx.field_plan()
+    ctx_by_rel = {c.relpath: c for c in ctxs}
+    for key in sorted(plan.accesses):
+        if key in plan.flag_keys or \
+                _guard_decl_for(idx, plan, key) is not None:
+            continue
+        sites = plan.accesses[key]
+        emitted = False
+        for w in sites:
+            if emitted:
+                break
+            if w.mode not in ("write", "mutate"):
+                continue
+            for o in sites:
+                wit = _field_race_witness(plan, w, o)
+                if wit is None:
+                    continue
+                fctx = ctx_by_rel.get(w.rel)
+                if fctx is not None and \
+                        fctx.allowed(w.line, "R23", "data-race"):
+                    break       # justified at the write: next write site
+                wc, oc = wit
+                yield Finding(
+                    "R23", "data-race", w.rel, w.line,
+                    f"data race on {_cg.field_display(key)}: "
+                    f"{w.mode}@{_field_site(w.rel, w.line)} vs "
+                    f"{o.mode}@{_field_site(o.rel, o.line)} with no common "
+                    f"lock (contexts: {_ctx_label(plan, wc)} vs "
+                    f"{_ctx_label(plan, oc)}; locks: "
+                    f"{_lockset_str(w.ctxs[wc])} vs "
+                    f"{_lockset_str(o.ctxs[oc])}) — guard both sites with "
+                    "one lock and declare it with '# raylint: "
+                    "guarded-by(<lock>)', or justify with '# raylint: "
+                    "allow(data-race) <why>'")
+                emitted = True
+                break
+    return
+
+
+@project_rule("R24", "atomicity-split")
+def check_atomicity_split(ctxs: List[FileContext],
+                          engine) -> Iterator[Finding]:
+    """Atomicity split on a shared attribute: a check-then-act
+    (``if self._n < cap: ... self._n += 1`` with the test outside the
+    write's critical section) or a read-modify-write whose read and
+    dependent write hold no common lock — the lock was released between
+    the two halves, so another thread can interleave and the decision
+    acts on stale state.  Only fields that are actually shared are
+    audited (a ``guarded-by`` declaration, or reachability from >=2
+    thread contexts); construction-only code, atomic-style containers,
+    and bool fast-path flags are exempt, and double-checked locking
+    (re-read under the lock that guards the write) stays quiet."""
+    idx = engine.index(ctxs)
+    plan = idx.field_plan()
+    seen: Set[Tuple[str, int, str]] = set()
+    for fnq, key, rline, wline, kind in sorted(plan.splits):
+        if key in plan.atomic_keys or key in plan.flag_keys:
+            continue
+        if fnq in plan.init_only or not plan.contexts.get(fnq):
+            continue
+        fn = idx.functions.get(fnq)
+        if fn is None:
+            continue
+        shared = _guard_decl_for(idx, plan, key) is not None
+        if not shared:
+            names: Set[str] = set()
+            for a in plan.accesses.get(key, ()):
+                names.update(a.ctxs)
+            shared = len(names) >= 2
+        if not shared:
+            continue
+        rel = fn.ctx.relpath
+        ident = (rel, wline, key)
+        if ident in seen:
+            continue
+        seen.add(ident)
+        if fn.ctx.allowed(wline, "R24", "atomicity-split"):
+            continue
+        yield Finding(
+            "R24", "atomicity-split", rel, wline,
+            f"atomicity split on {_cg.field_display(key)} ({kind}): the "
+            f"read at {_field_site(rel, rline)} and the dependent write "
+            f"at {_field_site(rel, wline)} hold no common lock — another "
+            "thread can interleave between check and act; widen the "
+            "critical section to cover both, or justify with "
+            "'# raylint: allow(atomicity-split) <why>'")
+
+
+def _base_leaf_names(idx: "_cg.ProjectIndex", name: str) -> Set[str]:
+    """Transitive base-class leaf names of every class called *name*."""
+    out: Set[str] = set()
+    work = [name]
+    while work:
+        n = work.pop()
+        for cls in idx.classes.values():
+            if cls.name != n:
+                continue
+            for base in cls.bases:
+                leaf = base.rsplit(".", 1)[-1]
+                if leaf not in out:
+                    out.add(leaf)
+                    work.append(leaf)
+    return out
+
+
+def _classes_related(idx: "_cg.ProjectIndex", a: str, b: str) -> bool:
+    return a == b or b in _base_leaf_names(idx, a) \
+        or a in _base_leaf_names(idx, b)
+
+
+def _field_lock_matches(idx: "_cg.ProjectIndex", decl: str,
+                        held: frozenset) -> bool:
+    """The declared lock is provably held: exact identity match, or the
+    same attribute on a related class (a base-class method acquiring
+    ``self._lock`` satisfies a subclass's declaration and vice versa —
+    ``_lock_identity`` names locks after the *defining* class)."""
+    if decl in held:
+        return True
+    dhead, _, dleaf = decl.rpartition(".")
+    if not dhead or "." in dhead:
+        return False        # module-global lock: identity match only
+    for h in held:
+        hhead, _, hleaf = h.rpartition(".")
+        if hleaf == dleaf and hhead and "." not in hhead and \
+                _classes_related(idx, dhead, hhead):
+            return True
+    return False
+
+
+def _guard_lock_display(key: str, lock: str) -> str:
+    """The lock as a developer would write it in the declaration —
+    ``Cls.attr`` back to ``self.attr`` when the class matches the
+    field's, a module-qualified global back to its bare name — so R25
+    messages string-match lockwatch level-2 runtime reports."""
+    head, _, leaf = lock.rpartition(".")
+    fld = _cg.field_display(key)
+    kcls = fld.rsplit(".", 1)[0] if ":" in key and "." in fld else ""
+    if head and head == kcls:
+        return "self." + leaf
+    if "." in head:
+        return leaf
+    return lock
+
+
+@project_rule("R25", "guarded-by")
+def check_guarded_by(ctxs: List[FileContext], engine) -> Iterator[Finding]:
+    """``# raylint: guarded-by(<lock>)`` enforcement, both directions.
+    (a) Every access to a declared field must hold the declared lock —
+    checked per thread context with the interprocedural must-hold
+    lockset, so a caller-held lock satisfies the contract.  (b) A field
+    the analysis proves multi-thread (>=2 contexts, at least one write)
+    that is *consistently* locked must carry a declaration — the
+    implicit convention becomes a machine-checked contract, and
+    ``RAY_TPU_LOCKWATCH=2`` samples the same declarations at runtime,
+    printing violations in this rule's format so static and live
+    findings correlate by string.  Inconsistently-locked fields are
+    R23's jurisdiction, not a missing declaration."""
+    from ray_tpu.devtools import lockwatch as _lw
+    idx = engine.index(ctxs)
+    plan = idx.field_plan()
+    ctx_by_rel = {c.relpath: c for c in ctxs}
+    # (a) declared fields: the named lock must be held at every site
+    # (accesses keyed under a subclass resolve to the base declaration)
+    for key in sorted(plan.accesses):
+        decl = _guard_decl_for(idx, plan, key)
+        if decl is None:
+            continue
+        lock, drel, dline = decl
+        disp = _guard_lock_display(key, lock)
+        for a in plan.accesses.get(key, ()):
+            bad = [cn for cn in sorted(a.ctxs)
+                   if not _field_lock_matches(idx, lock, a.ctxs[cn])]
+            if not bad:
+                continue
+            fctx = ctx_by_rel.get(a.rel)
+            if fctx is not None and \
+                    fctx.allowed(a.line, "R25", "guarded-by"):
+                continue
+            yield Finding(
+                "R25", "guarded-by", a.rel, a.line,
+                _lw.format_guard(_cg.field_display(key), disp)
+                + f" (declared at {drel}:{dline}; context "
+                f"{_ctx_label(plan, bad[0])}, locks "
+                f"{_lockset_str(a.ctxs[bad[0]])}) — acquire the declared "
+                "lock, fix the declaration, or justify with '# raylint: "
+                "allow(guarded-by) <why>'")
+    # (b) proved-shared, consistently-locked fields need a declaration
+    for key in sorted(plan.accesses):
+        if key in plan.atomic_keys or key in plan.flag_keys \
+                or _guard_decl_for(idx, plan, key) is not None:
+            continue
+        sites = plan.accesses[key]
+        names: Set[str] = set()
+        for a in sites:
+            names.update(a.ctxs)
+        if len(names) < 2:
+            continue
+        writes = sorted((a for a in sites if a.mode in ("write", "mutate")),
+                        key=lambda a: (a.rel, a.line))
+        if not writes:
+            continue
+        common: Optional[Set[str]] = None
+        for a in sites:
+            for held in a.ctxs.values():
+                common = set(held) if common is None else (common & held)
+        if not common:
+            continue        # unlocked somewhere: R23 reports the race
+        w = writes[0]
+        fctx = ctx_by_rel.get(w.rel)
+        if fctx is not None and fctx.allowed(w.line, "R25", "guarded-by"):
+            continue
+        disp = _guard_lock_display(key, sorted(common)[0])
+        yield Finding(
+            "R25", "guarded-by", w.rel, w.line,
+            f"shared field {_cg.field_display(key)} is reached from "
+            f"{len(names)} thread contexts "
+            f"({', '.join(_ctx_label(plan, n) for n in sorted(names))}) "
+            f"and is consistently locked under {disp}, but carries no "
+            f"declaration — annotate the field's assignment with "
+            f"'# raylint: guarded-by({disp})' so the convention is "
+            "machine-checked here and sampled live under "
+            "RAY_TPU_LOCKWATCH=2")
+
+
+# --------------------------------------------------------------------------
 # engine
 
 class LintEngine:
@@ -2331,19 +2661,26 @@ class LintEngine:
         # (stitch-fact replay hits, files stitched) after an index build —
         # None when no project rule forced the graph
         self.stitch_stats: Optional[Tuple[int, int]] = None
+        # (field-fact replay hits, files scanned) after a field-plan
+        # build — None when no field rule (R23-R25) forced it
+        self.field_stats: Optional[Tuple[int, int]] = None
         # wall time per project rule id (plus "graph" for the index build)
         self.rule_times: Dict[str, float] = {}
         self.errors: List[str] = []
         self._index: Optional[_cg.ProjectIndex] = None
         # hash-validated per-file stitch facts replayed from the cache
         self._stitch_cache: Dict[str, dict] = {}
+        # hash-validated per-file field-safety facts (R23-R25) replayed
+        # from the cache
+        self._field_cache: Dict[str, dict] = {}
 
     def index(self, ctxs: List[FileContext]) -> _cg.ProjectIndex:
         """Whole-program symbol table / call graph, built once per run and
-        shared by every interprocedural rule (R10-R12, R19-R20)."""
+        shared by every interprocedural rule (R10-R12, R19-R20, R23-R25)."""
         if self._index is None:
             self._index = _cg.ProjectIndex(
-                ctxs, stitch_facts=self._stitch_cache)
+                ctxs, stitch_facts=self._stitch_cache,
+                field_facts=self._field_cache)
             self.stitch_stats = (self._index.stitch_hits,
                                  len(self._index.stitch_facts))
         return self._index
@@ -2400,8 +2737,10 @@ class LintEngine:
         """Content hash of the analysis code itself: any edit to the
         linter, call-graph, or dataflow layers invalidates every entry."""
         if cls._salt is None:
+            from ray_tpu.devtools import lockwatch as _lw
             h = hashlib.sha256(sys.version.encode())
-            for mod_file in (__file__, _cg.__file__, _df.__file__):
+            for mod_file in (__file__, _cg.__file__, _df.__file__,
+                             _lw.__file__):
                 try:
                     with open(mod_file, "rb") as f:
                         h.update(f.read())
@@ -2521,6 +2860,16 @@ class LintEngine:
             rel: ent.get("facts") or {"sends": [], "dispatchers": []}
             for rel, ent in cached_stitch.items()
             if rel in hashes and ent.get("hash") == hashes[rel]}
+        # same replay for the field-safety facts (R23-R25): per-file
+        # access/split/guarded records are pure functions of one file's
+        # source, so a matching content hash makes them valid verbatim
+        cached_fields = (cache.get("fields") if cache is not None else
+                         None) or {}
+        self._field_cache = {
+            rel: ent["facts"]
+            for rel, ent in cached_fields.items()
+            if rel in hashes and ent.get("hash") == hashes[rel]
+            and ent.get("facts") is not None}
         proj_findings: List[Finding] = []
         if self.only_rules is None:
             t0 = time.perf_counter()
@@ -2531,6 +2880,9 @@ class LintEngine:
                 t0 = time.perf_counter()
                 proj_findings.extend(fn(ctxs, self))
                 self.rule_times[rule_id] = time.perf_counter() - t0
+        if self._index is not None and self._index.field_facts:
+            self.field_stats = (self._index.field_hits,
+                                len(self._index.field_facts))
         if cache is not None:
             self.cache_stats = (hits, len(sources), False)
             # merge, don't replace: entries for files outside this run's
@@ -2546,10 +2898,17 @@ class LintEngine:
                                for rel, facts in
                                self._index.stitch_facts.items()
                                if rel in hashes})
+            fields = dict(cached_fields)
+            if self._index is not None:
+                fields.update({rel: {"hash": hashes[rel], "facts": facts}
+                               for rel, facts in
+                               self._index.field_facts.items()
+                               if rel in hashes})
             self._cache_store({
                 "salt": self._engine_salt(),
                 "files": merged,
                 "stitch": stitch,
+                "fields": fields,
                 "project": {
                     "tree_key": tree_key,
                     "findings": [f.to_json()
@@ -2738,8 +3097,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             stitch = "stitch {}/{}".format(*engine.stitch_stats)
         else:
             stitch = "stitch skipped"
+        if warm:
+            fields = "fields replayed"
+        elif engine.field_stats is not None:
+            fields = "fields {}/{}".format(*engine.field_stats)
+        else:
+            fields = "fields skipped"
         print(f"raylint-cache: {hits}/{total} file hits, "
-              f"project {'hit' if warm else 'miss'}, {stitch}",
+              f"project {'hit' if warm else 'miss'}, {stitch}, {fields}",
               file=sys.stderr)
     if engine.rule_times:
         total_t = sum(engine.rule_times.values())

@@ -41,16 +41,19 @@ from __future__ import annotations
 
 import _thread
 import atexit
+import functools
 import itertools
 import json
 import os
+import re
 import sys
 import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
 __all__ = ["install", "uninstall", "installed", "wrap", "Lock", "RLock",
-           "report", "cycles", "reset", "format_cycle"]
+           "report", "cycles", "reset", "format_cycle", "format_guard",
+           "guard", "guard_class", "level"]
 
 
 def format_cycle(kind: str, sites) -> str:
@@ -62,17 +65,45 @@ def format_cycle(kind: str, sites) -> str:
     inversion and one allow/fix covers both."""
     return f"CYCLE ({kind}): " + " -> ".join(sites)
 
+
+def format_guard(field: str, lock: str) -> str:
+    """Canonical one-line rendering of a guarded-by violation.
+
+    Shared by raylint R25's static findings and the level-2 runtime
+    watchdog (``RAY_TPU_LOCKWATCH=2``): both name the field and its
+    declared lock as ``Cls.attr``, so a static finding and a runtime
+    report for the same field correlate by string equality on this
+    prefix."""
+    return f"guarded-by({lock}) violated: {field} accessed " \
+           f"without {lock} held"
+
+
+def level() -> int:
+    """Numeric watchdog level from ``RAY_TPU_LOCKWATCH``: 0 = off,
+    1 = lock-order graph, 2 = graph + guarded-field assertions (any
+    non-integer truthy value reads as 1 for backward compatibility)."""
+    raw = os.environ.get("RAY_TPU_LOCKWATCH", "")
+    if not raw:
+        return 0
+    try:
+        return int(raw)
+    except ValueError:
+        return 1
+
 # raw primitives so the watchdog never traces itself
 _graph_lock = _thread.allocate_lock()
 _tls = threading.local()
 _uid_counter = itertools.count(1)
 
-_edges: Dict[Tuple[str, str], int] = {}            # (site_a, site_b) -> count
-_edge_threads: Dict[Tuple[str, str], str] = {}     # example thread name
-_same_site_pairs: Set[Tuple[int, int]] = set()     # (uid_held, uid_acquired)
-_same_site_of: Dict[Tuple[int, int], str] = {}     # pair -> site
-_long_holds: List[dict] = []
+_edges: Dict[Tuple[str, str], int] = {}            # (site_a, site_b) -> count  # raylint: guarded-by(_graph_lock)
+_edge_threads: Dict[Tuple[str, str], str] = {}     # example thread name  # raylint: guarded-by(_graph_lock)
+_same_site_pairs: Set[Tuple[int, int]] = set()     # (uid_held, uid_acquired)  # raylint: guarded-by(_graph_lock)
+_same_site_of: Dict[Tuple[int, int], str] = {}     # pair -> site  # raylint: guarded-by(_graph_lock)
+_long_holds: List[dict] = []  # raylint: guarded-by(_graph_lock)
 _wrapped_count = 0
+_guard_violations: List[dict] = []                 # level-2 findings
+_guard_seen: Set[Tuple[str, str]] = set()          # (field, site) dedup
+_guard_counter = itertools.count()                 # sampling clock
 
 _orig_lock = None
 _orig_rlock = None
@@ -313,6 +344,170 @@ def rpc_handler_exit(token: "_LockProxy") -> None:
     _note_release(token, full=True)
 
 
+# -- guarded-field watchdog (level 2) ----------------------------------------
+#
+# Runtime mirror of raylint R25: at RAY_TPU_LOCKWATCH=2 the
+# :func:`guard` class decorator turns every field declared with a
+# ``# raylint: guarded-by(...)`` comment into a checking descriptor that
+# samples get/set and asserts the declared lock is held by the accessing
+# thread.  Violations print at exit in :func:`format_guard`'s one-line
+# format — the same string R25 embeds in its static findings — so a live
+# report and a static finding for the same field correlate directly.
+# Below level 2 the decorator is an exact no-op (zero import-time and
+# zero per-access cost).
+
+_GUARD_DECL_RE = re.compile(
+    r"self\.(\w+)\s*(?::[^=#\n]+)?=[^#\n]*#\s*raylint:\s*"
+    r"guarded-by\(([^)]+)\)")
+
+
+def _guard_sample() -> int:
+    """Check 1 in N guarded accesses (``RAY_TPU_LOCKWATCH_SAMPLE``,
+    default 1 = every access)."""
+    try:
+        return max(1, int(os.environ.get("RAY_TPU_LOCKWATCH_SAMPLE", "1")))
+    except ValueError:
+        return 1
+
+
+def _lock_held_here(lock) -> Optional[bool]:
+    """Best-effort: does the *current thread* hold *lock*?  None when the
+    primitive cannot answer.  A raw (unwrapped) Lock cannot attribute its
+    owner, so ``locked()`` under-reports violations rather than inventing
+    one while another thread legitimately holds it."""
+    if isinstance(lock, _RLockProxy):
+        return bool(lock._is_owned())
+    if isinstance(lock, _LockProxy):
+        return any(entry[0] is lock for entry in _held_stack())
+    if hasattr(lock, "_is_owned"):
+        try:
+            return bool(lock._is_owned())
+        except Exception:  # raylint: allow(swallow) foreign lock type; unknown ownership reported as None
+            return None
+    if hasattr(lock, "locked"):
+        try:
+            return bool(lock.locked())
+        except Exception:  # raylint: allow(swallow) foreign lock type; unknown ownership reported as None
+            return None
+    return None
+
+
+class _GuardedField:
+    """Data descriptor over one declared field: get/set store through the
+    instance ``__dict__`` and (sampled) assert the declared lock is held.
+    Checks are armed only after ``__init__`` completes — construction
+    writes touch an instance no other thread can see yet, matching the
+    static rule's fresh-instance exemption."""
+
+    __slots__ = ("_attr", "_field", "_lock_attr", "_lock_global",
+                 "_lock_disp", "_module")
+
+    def __init__(self, cls_name: str, module: str, attr: str,
+                 lock_text: str):
+        self._attr = attr
+        self._field = f"{cls_name}.{attr}"
+        self._module = module
+        lock_text = lock_text.strip()
+        if lock_text.startswith("self."):
+            self._lock_attr: Optional[str] = lock_text[5:]
+            self._lock_global: Optional[str] = None
+            self._lock_disp = f"{cls_name}.{self._lock_attr}"
+        else:
+            self._lock_attr = None
+            self._lock_global = lock_text.rsplit(".", 1)[-1]
+            self._lock_disp = lock_text
+
+    def _resolve_lock(self, obj):
+        if self._lock_attr is not None:
+            return obj.__dict__.get(self._lock_attr)
+        mod = sys.modules.get(self._module)
+        return getattr(mod, self._lock_global, None) \
+            if mod is not None else None
+
+    def _check(self, obj) -> None:
+        if not obj.__dict__.get("_lockwatch_guard_ready"):
+            return
+        if next(_guard_counter) % _guard_sample():
+            return
+        lock = self._resolve_lock(obj)
+        if lock is None:
+            return
+        if _lock_held_here(lock) is not False:
+            return
+        site = _caller_site(3)
+        dedup = (self._field, site)
+        with _graph_lock:
+            if dedup in _guard_seen:
+                return
+            _guard_seen.add(dedup)
+            _guard_violations.append({
+                "field": self._field, "lock": self._lock_disp,
+                "site": site,
+                "thread": threading.current_thread().name})
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        self._check(obj)
+        try:
+            return obj.__dict__[self._attr]
+        except KeyError:
+            raise AttributeError(self._attr) from None
+
+    def __set__(self, obj, value) -> None:
+        self._check(obj)
+        obj.__dict__[self._attr] = value
+
+    def __delete__(self, obj) -> None:
+        obj.__dict__.pop(self._attr, None)
+
+
+def guard_class(cls):
+    """Instrument *cls* unconditionally (unit-test surface — the
+    level-gated entry point is :func:`guard`): every field its source
+    declares with ``# raylint: guarded-by(...)`` becomes a checking
+    :class:`_GuardedField`, and ``__init__`` is wrapped to arm the checks
+    once construction finishes."""
+    import inspect
+    try:
+        src = inspect.getsource(cls)
+    except (OSError, TypeError):
+        return cls
+    decls: Dict[str, str] = {}
+    for line in src.splitlines():
+        m = _GUARD_DECL_RE.search(line)
+        if m:
+            decls.setdefault(m.group(1), m.group(2))
+    if not decls:
+        return cls
+    for attr, lock_text in decls.items():
+        setattr(cls, attr, _GuardedField(cls.__name__, cls.__module__,
+                                         attr, lock_text))
+    orig_init = cls.__init__
+
+    @functools.wraps(orig_init)
+    def _armed_init(self, *args, **kwargs):
+        orig_init(self, *args, **kwargs)
+        self.__dict__["_lockwatch_guard_ready"] = True
+
+    cls.__init__ = _armed_init
+    return cls
+
+
+def guard(cls):
+    """Class decorator: at ``RAY_TPU_LOCKWATCH=2`` instrument the class's
+    guarded-by-declared fields (see :func:`guard_class`); below level 2,
+    return the class untouched."""
+    if level() < 2:
+        return cls
+    return guard_class(cls)
+
+
+def guard_violations() -> List[dict]:
+    with _graph_lock:
+        return list(_guard_violations)
+
+
 def reset() -> None:
     """Clear all recorded observations (keeps installation state)."""
     global _wrapped_count
@@ -322,6 +517,8 @@ def reset() -> None:
         _same_site_pairs.clear()
         _same_site_of.clear()
         _long_holds.clear()
+        _guard_violations.clear()
+        _guard_seen.clear()
         _wrapped_count = 0
 
 
@@ -403,16 +600,22 @@ def report() -> dict:
                  for (a, b), n in sorted(_edges.items())]
         holds = list(_long_holds)
         wrapped = _wrapped_count
+        guards = [dict(v) for v in _guard_violations]
     return {"wrapped_locks": wrapped, "edges": edges, "cycles": cycles(),
-            "long_holds": holds}
+            "long_holds": holds, "guard_violations": guards}
 
 
 def _exit_report() -> None:
     rep = report()
     n_cycles = len(rep["cycles"])
+    n_guard = len(rep["guard_violations"])
     print(f"LOCKWATCH: {rep['wrapped_locks']} locks wrapped, "
           f"{len(rep['edges'])} order edges, {n_cycles} cycles, "
-          f"{len(rep['long_holds'])} long holds", file=sys.stderr)
+          f"{len(rep['long_holds'])} long holds, "
+          f"{n_guard} guard violations", file=sys.stderr)
+    for v in rep["guard_violations"]:
+        print("LOCKWATCH R25 " + format_guard(v["field"], v["lock"])
+              + f" at {v['site']} [{v['thread']}]", file=sys.stderr)
     if n_cycles:
         for cyc in rep["cycles"]:
             print("LOCKWATCH " + format_cycle(cyc["kind"], cyc["sites"]),

@@ -56,8 +56,8 @@ class JobManager:
         self.job_dir = job_dir
         os.makedirs(job_dir, exist_ok=True)
         self._lock = threading.Lock()
-        self._jobs: Dict[str, JobInfo] = {}
-        self._procs: Dict[str, subprocess.Popen] = {}
+        self._jobs: Dict[str, JobInfo] = {}  # raylint: guarded-by(self._lock)
+        self._procs: Dict[str, subprocess.Popen] = {}  # raylint: guarded-by(self._lock)
         self._load_persisted()
 
     # -- persistence (listings survive restarts, job_manager checkpoints) --

@@ -60,12 +60,12 @@ Context = Tuple[str, str]  # (trace_id, span_id)
 
 def register_context_provider(fn: Callable[[], Optional[Context]]) -> None:
     if fn not in _providers:
-        _providers.append(fn)
+        _providers.append(fn)  # raylint: allow(data-race) providers registered during process bootstrap; iteration sees a GIL-atomic list snapshot
 
 
 def set_process_label(label: str) -> None:
     global _pid_label
-    _pid_label = label
+    _pid_label = label  # raylint: allow(data-race) process label set once at bootstrap; plain string store is GIL-atomic
 
 
 def process_label() -> str:

@@ -62,7 +62,14 @@ def disable() -> None:
     ENABLED = False
 
 
-_bounds_cache: Optional[Tuple[float, ...]] = None
+# Dedicated lock: bucket_bounds() runs inside PerfHistogram.__init__,
+# which get() constructs while holding _hists_lock — reusing that lock
+# here would self-deadlock.
+_bounds_lock = threading.Lock()
+_bounds_cache: Optional[Tuple[float, ...]] = None  # raylint: guarded-by(_bounds_lock)
+# Bumped by reset() so an in-flight bucket_bounds() compute that started
+# before the reset cannot publish its now-stale layout over the fresh one.
+_bounds_gen = 0  # raylint: guarded-by(_bounds_lock)
 
 
 def bucket_bounds() -> Tuple[float, ...]:
@@ -70,13 +77,21 @@ def bucket_bounds() -> Tuple[float, ...]:
     once from ``perf_hist_buckets`` so every histogram in the process —
     and, config being uniform, the cluster — shares one bucket layout."""
     global _bounds_cache
-    b = _bounds_cache
-    if b is None:
+    b = _bounds_cache  # raylint: allow(guarded-by) double-checked fast path: immutable tuple publish, losers recompute
+    while b is None:
+        with _bounds_lock:
+            gen = _bounds_gen
         n = max(8, int(_config.get("perf_hist_buckets")))
         # n-1 finite bounds spanning [_MIN_MS, _MAX_MS] geometrically.
         ratio = (_MAX_MS / _MIN_MS) ** (1.0 / (n - 2))
         b = tuple(_MIN_MS * ratio ** i for i in range(n - 1)) + (math.inf,)
-        _bounds_cache = b
+        with _bounds_lock:
+            if _bounds_cache is not None:
+                b = _bounds_cache     # another thread won the publish
+            elif gen == _bounds_gen:
+                _bounds_cache = b
+            else:
+                b = None              # reset() raced the compute: retry
     return b
 
 
@@ -141,12 +156,12 @@ class PerfHistogram:
         return sum(self.merged()[0])
 
 
-_hists: Dict[str, PerfHistogram] = {}
+_hists: Dict[str, PerfHistogram] = {}  # raylint: guarded-by(_hists_lock)
 _hists_lock = threading.Lock()
 
 
 def get(name: str) -> PerfHistogram:
-    h = _hists.get(name)
+    h = _hists.get(name)  # raylint: allow(guarded-by) double-checked fast path: re-checked under the lock below
     if h is None:
         with _hists_lock:
             h = _hists.get(name)
@@ -168,10 +183,12 @@ def observe(name: str, ms: float) -> None:
 def reset() -> None:
     """Drop every histogram and the cached bounds (tests re-enter with a
     different ``perf_hist_buckets``)."""
-    global _bounds_cache
+    global _bounds_cache, _bounds_gen
     with _hists_lock:
         _hists.clear()
-    _bounds_cache = None
+    with _bounds_lock:
+        _bounds_cache = None
+        _bounds_gen += 1
 
 
 # -- quantiles ---------------------------------------------------------------

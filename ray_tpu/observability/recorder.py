@@ -67,17 +67,19 @@ _LASTWORDS_SIZE = 16384
 # names the in-flight task and its trace_id.
 
 _inflight_lock = threading.Lock()
-_inflight: Dict[str, dict] = {}
+_inflight: Dict[str, dict] = {}  # raylint: guarded-by(_inflight_lock)
 
 # Extra per-tick state providers (the distributed runtime registers one
 # reporting node identity / heartbeat-loop liveness). Registration instead
 # of imports keeps this module cycle-free below the runtime.
-_state_providers: List[Callable[[], Optional[dict]]] = []
+_providers_lock = threading.Lock()
+_state_providers: List[Callable[[], Optional[dict]]] = []  # raylint: guarded-by(_providers_lock)
 
 
 def register_state_provider(fn: Callable[[], Optional[dict]]) -> None:
-    if fn not in _state_providers:
-        _state_providers.append(fn)
+    with _providers_lock:
+        if fn not in _state_providers:
+            _state_providers.append(fn)
 
 
 def task_started(task_id: str, name: str, trace_id: str = "",
@@ -101,7 +103,9 @@ def inflight_snapshot() -> Dict[str, dict]:
 
 def _provider_state() -> dict:
     state: dict = {}
-    for fn in list(_state_providers):
+    with _providers_lock:
+        providers = list(_state_providers)
+    for fn in providers:
         try:
             got = fn()
         except Exception:  # noqa: BLE001  # raylint: allow(swallow) spool tick must survive a broken provider
@@ -157,13 +161,13 @@ class FlightRecorder:
                             int(_config.get("flight_recorder_spool_ms")) / 1e3)
         self._segment_bytes = int(_config.get("flight_recorder_segment_bytes"))
         self._tail = int(_config.get("flight_recorder_tail_events"))
-        self._seq = 0
-        self._segment_idx = 0
-        self._segment_file = None
-        self._span_cursor = 0
-        self._log_cursor = 0
-        self._chaos_cursor = 0
-        self._tick_count = 0
+        self._seq = 0  # raylint: guarded-by(self._lock)
+        self._segment_idx = 0  # raylint: guarded-by(self._lock)
+        self._segment_file = None  # raylint: guarded-by(self._lock)
+        self._span_cursor = 0  # raylint: guarded-by(self._lock)
+        self._log_cursor = 0  # raylint: guarded-by(self._lock)
+        self._chaos_cursor = 0  # raylint: guarded-by(self._lock)
+        self._tick_count = 0  # raylint: guarded-by(self._lock)
         self._sealed = False
         self._clean = False
         self._exc_info: Optional[tuple] = None
@@ -171,8 +175,8 @@ class FlightRecorder:
         self._paused = threading.Event()
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
-        self._lw_map = None       # mmap when available
-        self._lw_file = None      # plain-file fallback
+        self._lw_map = None       # mmap when available  # raylint: guarded-by(self._lock)
+        self._lw_file = None      # plain-file fallback  # raylint: guarded-by(self._lock)
         self._fault_file = None
         self._orig_excepthook = None
 
@@ -180,10 +184,13 @@ class FlightRecorder:
 
     def start(self) -> None:
         os.makedirs(self.dir, exist_ok=True)
-        self._open_segment(0)
-        self._open_lastwords()
-        self._install_hooks()
-        self._write_index()
+        # under _lock so the spool thread's view of the segment/lastwords
+        # handles is ordered after this setup
+        with self._lock:
+            self._open_segment(0)
+            self._open_lastwords()
+            self._install_hooks()
+            self._write_index()
         self._thread = threading.Thread(target=self._spool_loop,
                                         name="flight-recorder", daemon=True)
         self._thread.start()
@@ -200,8 +207,9 @@ class FlightRecorder:
     def set_label(self, label: str) -> None:
         """Adopt the process's real identity once known (daemons learn
         their ``node:<hex8>`` tag only after registering)."""
-        self.label = label
-        self._write_index()
+        with self._lock:
+            self.label = label
+            self._write_index()
 
     def close(self, clean: bool = True) -> None:
         """Stop spooling and mark the recording finished. ``clean=True``
@@ -213,8 +221,8 @@ class FlightRecorder:
             self._thread.join(timeout=2.0)
         with self._lock:
             self._spool_once_locked(final=True)
-        self._clean = bool(clean)
-        self._write_index()
+            self._clean = bool(clean)
+            self._write_index()
 
     # -- on-disk plumbing ----------------------------------------------------
 
@@ -277,12 +285,12 @@ class FlightRecorder:
     def _install_hooks(self) -> None:
         import faulthandler
         try:
-            self._fault_file = open(
+            self._fault_file = open(  # raylint: guarded-by(self._lock)
                 os.path.join(self.dir, FAULTLOG_NAME), "w")
             faulthandler.enable(file=self._fault_file)
         except (OSError, RuntimeError):
             self._fault_file = None
-        self._orig_excepthook = sys.excepthook
+        self._orig_excepthook = sys.excepthook  # raylint: allow(data-race) saved before sys.excepthook is swapped in; the installed hook reads it strictly afterwards
         sys.excepthook = self._on_unhandled
         atexit.register(self._on_atexit)
         # chaos `exit` = deterministic SIGKILL stand-in; seal on the way down
@@ -363,7 +371,8 @@ class FlightRecorder:
             _atomic_write(path, bundle)
         except OSError:
             return None
-        self._write_index()
+        with self._lock:
+            self._write_index()
         _bundles_sealed_metric()
         return path
 
@@ -481,26 +490,31 @@ class FlightRecorder:
 
 # -- metrics (lazy; profiling.py pattern) ------------------------------------
 
-_ticks_counter = None
-_bundles_counter = None
+_metrics_lock = threading.Lock()
+_ticks_counter = None  # raylint: guarded-by(_metrics_lock)
+_bundles_counter = None  # raylint: guarded-by(_metrics_lock)
 
 
 def _ticks_metric():
     global _ticks_counter
-    if _ticks_counter is None:
-        from ray_tpu.util.metrics import Counter
-        _ticks_counter = Counter(
-            "flight_recorder_ticks", "spool-thread ticks recorded")
-    _ticks_counter.inc()
+    with _metrics_lock:
+        c = _ticks_counter
+        if c is None:
+            from ray_tpu.util.metrics import Counter
+            c = _ticks_counter = Counter(
+                "flight_recorder_ticks", "spool-thread ticks recorded")
+    c.inc()
 
 
 def _bundles_sealed_metric():
     global _bundles_counter
-    if _bundles_counter is None:
-        from ray_tpu.util.metrics import Counter
-        _bundles_counter = Counter(
-            "flight_recorder_bundles_sealed", "crash bundles sealed")
-    _bundles_counter.inc()
+    with _metrics_lock:
+        c = _bundles_counter
+        if c is None:
+            from ray_tpu.util.metrics import Counter
+            c = _bundles_counter = Counter(
+                "flight_recorder_bundles_sealed", "crash bundles sealed")
+    c.inc()
 
 
 # -- module-level install ----------------------------------------------------
@@ -517,7 +531,7 @@ def install(role: str, label: str = "") -> Optional[FlightRecorder]:
             rec = FlightRecorder(role, label)
             _gc(rec.root)
             rec.start()
-            _recorder = rec
+            _recorder = rec  # raylint: allow(data-race) GIL-atomic unlocked read of the module singleton; install/uninstall serialize under _install_lock
             ENABLED = True
         return _recorder
 

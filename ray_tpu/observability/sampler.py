@@ -42,7 +42,7 @@ _trace_stacks: Dict[int, List[str]] = {}
 
 
 def note_span_enter(trace_id: str) -> None:
-    _trace_stacks.setdefault(threading.get_ident(), []).append(trace_id)
+    _trace_stacks.setdefault(threading.get_ident(), []).append(trace_id)  # raylint: allow(data-race) single dict/list op under the GIL (see module note); the sampler reads a best-effort snapshot
 
 
 def note_span_exit() -> None:
@@ -51,7 +51,7 @@ def note_span_exit() -> None:
     if stack:
         stack.pop()
         if not stack:
-            _trace_stacks.pop(tid, None)
+            _trace_stacks.pop(tid, None)  # raylint: allow(data-race) single dict op under the GIL (see module note); the sampler reads a best-effort snapshot
 
 
 _MAX_DEPTH = 64
@@ -62,12 +62,12 @@ class StackSampler:
 
     def __init__(self, hz: float):
         self.hz = float(hz)
-        self._counts: Dict[Tuple[str, str], int] = {}
+        self._counts: Dict[Tuple[str, str], int] = {}  # raylint: guarded-by(self._lock)
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._started_s = 0.0
-        self._ticks = 0
+        self._ticks = 0  # raylint: guarded-by(self._lock)
 
     # -- lifecycle -------------------------------------------------------
 
@@ -228,7 +228,7 @@ def start(hz: Optional[float] = None) -> Optional[StackSampler]:
         return None
     with _sampler_lock:
         if _sampler is None:
-            _sampler = StackSampler(hz).start()
+            _sampler = StackSampler(hz).start()  # raylint: allow(data-race) get_sampler's unlocked peek is a GIL-atomic read of the singleton
         return _sampler
 
 
@@ -236,7 +236,7 @@ def stop() -> None:
     global _sampler
     with _sampler_lock:
         s = _sampler
-        _sampler = None
+        _sampler = None  # raylint: allow(data-race) get_sampler's unlocked peek is a GIL-atomic read of the singleton
     if s is not None:
         s.stop()
 

@@ -22,8 +22,8 @@ from ray_tpu._private.backoff import BackoffPolicy
 
 class LongPollHost:
     def __init__(self):
-        self._snapshot_ids: Dict[str, int] = {}
-        self._objects: Dict[str, Any] = {}
+        self._snapshot_ids: Dict[str, int] = {}  # raylint: guarded-by(self._cond)
+        self._objects: Dict[str, Any] = {}  # raylint: guarded-by(self._cond)
         self._cond = threading.Condition()
 
     def notify_changed(self, key: str, obj: Any) -> None:

@@ -111,7 +111,7 @@ class _ReplicaBatcher:
         pad = cfg.get("pad_batch_to")
         self._buckets = tuple(sorted(int(b) for b in pad)) if pad else None
         self._lock = threading.Lock()
-        self._queue: List[_BatchSlot] = []
+        self._queue: List[_BatchSlot] = []  # raylint: guarded-by(self._lock)
         self._wakeup = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._stop = False
@@ -145,7 +145,8 @@ class _ReplicaBatcher:
         blow the replica's latency budget."""
         want = self._max
         budget = self._replica._batch_budget_ms()
-        ewma = self._replica._ewma_item_ms
+        with self._replica._lock:
+            ewma = self._replica._ewma_item_ms
         if budget > 0 and ewma > 0:
             want = min(want, max(1, int(budget / ewma)))
         return max(1, want)
@@ -294,7 +295,7 @@ class Replica:
         # router/autoscaler inputs, not optional observability).
         self._hist_queue_wait = perf.PerfHistogram("queue_wait")
         self._hist_execute = perf.PerfHistogram("execute")
-        self._ewma_item_ms = 0.0
+        self._ewma_item_ms = 0.0  # raylint: guarded-by(self._lock)
         self._batch_cfg = dict(batch_config) if batch_config else None
         self._batcher = self._build_batcher()
         if user_config is not None:
@@ -334,9 +335,10 @@ class Replica:
         histogram gets ``n`` samples of ``ms``; the per-item EWMA gets
         ``ms / n`` (the amortized cost that sizes future batches)."""
         per_item = ms / max(n, 1)
-        prev = self._ewma_item_ms
-        self._ewma_item_ms = (per_item if prev == 0.0 else
-                              prev + _ITEM_EWMA_ALPHA * (per_item - prev))
+        with self._lock:
+            prev = self._ewma_item_ms
+            self._ewma_item_ms = (per_item if prev == 0.0 else
+                                  prev + _ITEM_EWMA_ALPHA * (per_item - prev))
         for _ in range(n):
             self._hist_execute.observe(ms)
 
@@ -390,10 +392,11 @@ class Replica:
         with self._lock:
             ongoing = self._ongoing
             total = self._total
+            ewma_ms = self._ewma_item_ms
         # Estimated time-to-drain of work already admitted here: the
         # router's shed signal and a tiebreaker for scoring.
         pending = depth if batcher is not None else ongoing
-        ewma = self._ewma_item_ms
+        ewma = ewma_ms
         return {"replica_tag": self.replica_tag,
                 "num_ongoing_requests": ongoing,
                 "num_total_requests": total,

@@ -76,7 +76,7 @@ class _BatchQueue:
         self._timeout = batch_wait_timeout_s
         self._buckets = tuple(sorted(pad_batch_to)) if pad_batch_to else None
         self._lock = threading.Lock()
-        self._pending: List[_Slot] = []
+        self._pending: List[_Slot] = []  # raylint: guarded-by(self._lock)
         self._instance = None
         self._wakeup = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -84,7 +84,7 @@ class _BatchQueue:
     def submit(self, instance, item) -> Any:
         slot = _Slot(item)
         with self._lock:
-            self._instance = instance
+            self._instance = instance  # raylint: guarded-by(self._lock)
             self._pending.append(slot)
             if self._thread is None:
                 self._thread = threading.Thread(

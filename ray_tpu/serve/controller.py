@@ -32,13 +32,13 @@ def _replica_key(deployment_name: str) -> str:
 
 class ServeController:
     def __init__(self, control_loop_period_s: float = 0.2):
-        self._deployments: Dict[str, DeploymentState] = {}
+        self._deployments: Dict[str, DeploymentState] = {}  # raylint: guarded-by(self._lock)
         self._routes: Dict[str, str] = {}  # route prefix -> deployment name
         self._long_poll = LongPollHost()
         self._lock = threading.RLock()
         self._period = control_loop_period_s
         self._shutdown = threading.Event()
-        self._autoscale_state: Dict[str, float] = {}
+        self._autoscale_state: Dict[str, float] = {}  # raylint: guarded-by(self._lock)
         self._loop_thread = threading.Thread(
             target=self._control_loop, daemon=True, name="serve-control-loop")
         self._loop_thread.start()
@@ -165,6 +165,11 @@ class ServeController:
             return
         if metrics is None:
             metrics = state.collect_metrics()
+        with self._lock:
+            self._autoscale_locked(state, metrics, cfg)
+
+    def _autoscale_locked(self, state: DeploymentState, metrics: dict,
+                          cfg) -> None:
         # Scale from the TARGET count, not the live count: while a
         # scale-up is still starting replicas the live count lags, and
         # computing desired from it over-requests again every tick

@@ -112,7 +112,7 @@ class FunctionTrainable(Trainable):
     _fn: Callable = None  # set by wrap_function subclass
 
     def setup(self, config: Dict[str, Any]):
-        self._results: "queue.Queue" = queue.Queue()
+        self._results: "queue.Queue" = queue.Queue()  # raylint: allow(data-race) assigned in setup() before the runner thread starts; queue.Queue is internally synchronized
         self._continue: "queue.Queue" = queue.Queue()
         self._finished = False
         self._last_metrics: Dict[str, Any] = {}
@@ -125,17 +125,17 @@ class FunctionTrainable(Trainable):
         try:
             self._fn(self.config)
         except BaseException as e:  # noqa: BLE001 - propagated to driver
-            self._results.put(e)
+            self._results.put(e)  # raylint: allow(data-race) queue.Queue is internally synchronized
         finally:
             tune_session._shutdown_session()
-            self._results.put(None)  # sentinel: function returned
+            self._results.put(None)  # raylint: allow(data-race) queue.Queue is internally synchronized (sentinel: function returned)
 
     def _report(self, metrics: Dict[str, Any],
                 checkpoint: Optional[Dict[str, Any]] = None):
         if checkpoint is not None:
             self._last_checkpoint = {"data": checkpoint,
                                      "iteration": self._iteration + 1}
-        self._results.put(dict(metrics))
+        self._results.put(dict(metrics))  # raylint: allow(data-race) queue.Queue is internally synchronized
         self._continue.get()  # block until driver consumed (backpressure)
 
     def _get_checkpoint(self) -> Optional[Dict[str, Any]]:

@@ -109,8 +109,8 @@ class ClientAPI:
         try:
             self._sock.settimeout(None)
             self._send_lock = threading.Lock()
-            self._plock = threading.Lock()
-            self._pending: Dict[int, list] = {}  # seq -> [Event, resp|None]
+            self._pending_lock = threading.Lock()
+            self._pending: Dict[int, list] = {}  # raylint: guarded-by(self._pending_lock)
             self._seq = 0
             self._closed: Optional[Exception] = None
             self._reader = threading.Thread(target=self._read_loop,
@@ -134,13 +134,14 @@ class ClientAPI:
                 if resp is None:
                     raise ConnectionError(
                         "client server closed the connection")
-                with self._plock:
+                with self._pending_lock:
                     slot = self._pending.pop(resp.get("seq"), None)
                 if slot is not None:
                     slot[1] = resp
                     slot[0].set()
         except BaseException as e:  # noqa: BLE001 - teardown path
-            with self._plock:
+            with self._pending_lock:
+                # raylint: allow(data-race) set under _pending_lock before slot events fire; post-wait readers see it via the event's happens-before edge
                 self._closed = e if isinstance(e, Exception) else \
                     ConnectionError(str(e))
                 pending, self._pending = dict(self._pending), {}
@@ -149,7 +150,7 @@ class ClientAPI:
 
     def _call(self, req: dict, timeout: Optional[float] = None):
         slot = [threading.Event(), None]
-        with self._plock:
+        with self._pending_lock:
             if self._closed is not None:
                 raise ConnectionError(
                     f"client connection closed: {self._closed}")
@@ -163,7 +164,7 @@ class ClientAPI:
                 raise TimeoutError(
                     f"no reply to {req.get('op')!r} within {timeout}s")
         finally:
-            with self._plock:
+            with self._pending_lock:
                 self._pending.pop(seq, None)
         resp = slot[1]
         if resp is None:

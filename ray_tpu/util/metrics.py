@@ -46,20 +46,24 @@ _registry = _Registry()
 # dicts, merged into every snapshot/exposition.  The perf plane
 # (observability/perf.py) registers here so its lock-free histograms
 # export without living inside the registry's Metric class hierarchy.
-_extra_sources: List = []
+_sources_lock = threading.Lock()
+_extra_sources: List = []  # raylint: guarded-by(_sources_lock)
 
 
 def register_sample_source(fn) -> None:
     """Register a zero-arg callable returning a list of family dicts
     (``{"name","type","help","samples",...}``) to include in
     :func:`snapshot` and the Prometheus expositions."""
-    if fn not in _extra_sources:
-        _extra_sources.append(fn)
+    with _sources_lock:
+        if fn not in _extra_sources:
+            _extra_sources.append(fn)
 
 
 def _extra_families() -> List[dict]:
+    with _sources_lock:
+        sources = list(_extra_sources)
     out: List[dict] = []
-    for fn in _extra_sources:
+    for fn in sources:
         try:
             out.extend(fn())
         except Exception:  # raylint: allow(swallow) one bad source must not kill the scrape
@@ -94,17 +98,19 @@ class Metric:
         self.description = description
         self.tag_keys = tuple(tag_keys or ())
         self._lock = threading.Lock()
-        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
-        self._default_tags: Dict[str, str] = {}
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}  # raylint: guarded-by(self._lock)
+        self._default_tags: Dict[str, str] = {}  # raylint: guarded-by(self._lock)
         _registry.register(self)
 
     def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
-        self._default_tags = dict(tags)
+        with self._lock:
+            self._default_tags = dict(tags)
         return self
 
     def _key(self, tags: Optional[Dict[str, str]]
              ) -> Tuple[Tuple[str, str], ...]:
-        merged = dict(self._default_tags)
+        with self._lock:
+            merged = dict(self._default_tags)
         if tags:
             unknown = set(tags) - set(self.tag_keys)
             if unknown:
@@ -139,8 +145,9 @@ class Gauge(Metric):
     TYPE = "gauge"
 
     def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = self._key(tags)  # outside the lock: _key re-acquires it
         with self._lock:
-            self._values[self._key(tags)] = float(value)
+            self._values[key] = float(value)
 
 
 class Histogram(Metric):
@@ -154,9 +161,9 @@ class Histogram(Metric):
         super().__init__(name, description, tag_keys)
         self.boundaries = tuple(sorted(boundaries
                                        or _DEFAULT_HISTOGRAM_BOUNDARIES))
-        self._buckets: Dict[Tuple[Tuple[str, str], ...], List[int]] = {}
-        self._sums: Dict[Tuple[Tuple[str, str], ...], float] = {}
-        self._counts: Dict[Tuple[Tuple[str, str], ...], int] = {}
+        self._buckets: Dict[Tuple[Tuple[str, str], ...], List[int]] = {}  # raylint: guarded-by(self._lock)
+        self._sums: Dict[Tuple[Tuple[str, str], ...], float] = {}  # raylint: guarded-by(self._lock)
+        self._counts: Dict[Tuple[Tuple[str, str], ...], int] = {}  # raylint: guarded-by(self._lock)
 
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
         key = self._key(tags)

@@ -56,8 +56,8 @@ class AsyncResult:
                  error_callback: Optional[Callable] = None):
         self._refs = refs
         self._single = single
-        self._callback = callback
-        self._error_callback = error_callback
+        self._callback = callback  # raylint: guarded-by(self._lock)
+        self._error_callback = error_callback  # raylint: guarded-by(self._lock)
         self._result = None
         self._done = False
         self._error: Optional[BaseException] = None
@@ -75,7 +75,7 @@ class AsyncResult:
             with self._lock:
                 if self._done:
                     return
-                self._error = e
+                self._error = e  # raylint: allow(data-race) published under self._lock before _done flips; get() reads only after observing _done
                 self._done = True
                 cb, self._error_callback = self._error_callback, None
             if cb is not None:
@@ -85,7 +85,7 @@ class AsyncResult:
         with self._lock:
             if self._done:
                 return
-            self._result = flat[0] if self._single else flat
+            self._result = flat[0] if self._single else flat  # raylint: allow(data-race) published under self._lock before _done flips; get() reads only after observing _done
             self._done = True
             cb, self._callback = self._callback, None
         if cb is not None:

@@ -26,7 +26,7 @@ _async_runs: Dict[str, threading.Thread] = {}
 def init(storage_base_dir: Optional[str] = None) -> None:
     """Configure workflow storage (default: ~/.ray_tpu/workflows)."""
     global _base_dir
-    _base_dir = storage_base_dir
+    _base_dir = storage_base_dir  # raylint: allow(data-race) configured once at workflow init before any run launches
     if not ray_tpu.is_initialized():
         ray_tpu.init()
 
@@ -58,11 +58,11 @@ def run_async(dag, *, workflow_id: Optional[str] = None) -> str:
         except BaseException:  # raylint: allow(swallow) executor already persisted FAILED in storage
             pass  # recorded in storage as FAILED by the executor
         finally:
-            _async_runs.pop(workflow_id, None)
+            _async_runs.pop(workflow_id, None)  # raylint: allow(data-race) GIL-atomic dict op on the run registry
 
     t = threading.Thread(target=target, daemon=True,
                          name=f"workflow-{workflow_id}")
-    _async_runs[workflow_id] = t
+    _async_runs[workflow_id] = t  # raylint: allow(data-race) GIL-atomic dict op on the run registry
     t.start()
     return workflow_id
 

@@ -6,9 +6,10 @@
 #      shipped fixture corpus round-trips expected.json exactly, and the
 #      machine-readable `--rules` listing is cross-checked against this
 #      header and the ARCHITECTURE.md rule table so neither can drift.
-#   1. raylint — the framework-aware AST linter (R1..R22, including the
+#   1. raylint — the framework-aware AST linter (R1..R25, including the
 #      whole-program call-graph rules, the path-sensitive dataflow
-#      rules, and the cross-process stitched-graph rules) over
+#      rules, the cross-process stitched-graph rules, and the
+#      field-level thread-safety rules R23-R25) over
 #      ray_tpu/, bench.py, bench_micro.py, and tests/; any
 #      non-allowlisted finding fails the gate. tests/ runs under a
 #      scoped allow profile (see below). Emits a SARIF 2.1.0 artifact
@@ -79,15 +80,17 @@ echo "== [stage 1] raylint (ray_tpu bench.py bench_micro.py tests) =="
 t0=$SECONDS
 st=OK
 # tests/ allow profile: test code legitimately pokes checkpoint
-# directories (R9) and simulates rank-divergent schedules on purpose
-# (R12); scoped here so production code can never ride on it.
+# directories (R9), simulates rank-divergent schedules on purpose (R12),
+# registers throwaway metrics (R22), and hammers shared state from
+# deliberately-racing helper threads (R23-R25); scoped here so
+# production code can never ride on it.
 LINT_JSON="$(mktemp /tmp/raytpu_lint.XXXXXX.json)"
 LINT_ERR="$(mktemp /tmp/raytpu_lint.XXXXXX.err)"
 # CI artifact: SARIF 2.1.0 log of every finding (empty `results` on a
 # clean tree), for editor/code-scanning ingestion
 LINT_SARIF="${RAYLINT_SARIF_OUT:-/tmp/raytpu_lint.sarif.json}"
 if python -m ray_tpu.devtools.lint ray_tpu bench.py bench_micro.py tests \
-     --allow-in "tests/:R9,R12,R22" --json --sarif "$LINT_SARIF" \
+     --allow-in "tests/:R9,R12,R22,R23,R24,R25" --json --sarif "$LINT_SARIF" \
      > "$LINT_JSON" 2> "$LINT_ERR"; then
   python - "$LINT_JSON" <<'EOF'
 import json, sys
@@ -109,14 +112,19 @@ EOF
 fi
 cat "$LINT_ERR" >&2
 CACHE_LINE="$(grep -o 'raylint-cache: .*' "$LINT_ERR" | tail -1)"
+# Per-rule wall time for the project rules (plus the shared graph
+# build), straight from the engine — the first place to look when the
+# stage-1 budget check below trips.
+TIMES_LINE="$(grep -o 'raylint-times: .*' "$LINT_ERR" | tail -1)"
 rm -f "$LINT_JSON" "$LINT_ERR"
 stage_done "stage 1 (raylint)" "$t0" "$st"
 STAGE_TIMES+=("stage 1 cache: ${CACHE_LINE#raylint-cache: }")
-# Budget check against the recorded cold-cache baseline (full R1..R22
-# run over the widened file set, incl. the stitch pass, 2026-08): a
-# >50% overshoot means a rule regressed into super-linear work or the
-# cache stopped landing.
-STAGE1_BASELINE_S="${RAYLINT_STAGE1_BASELINE_S:-15}"
+STAGE_TIMES+=("stage 1 rule times: ${TIMES_LINE#raylint-times: }")
+# Budget check against the recorded cold-cache baseline (full R1..R25
+# run over the widened file set, incl. the stitch pass and the R23-R25
+# field plan, 2026-08): a >50% overshoot means a rule regressed into
+# super-linear work or the cache stopped landing.
+STAGE1_BASELINE_S="${RAYLINT_STAGE1_BASELINE_S:-18}"
 st1_el=$(( SECONDS - t0 ))
 if [ "$st1_el" -gt $(( STAGE1_BASELINE_S * 3 / 2 )) ]; then
   echo "WARNING: stage 1 took ${st1_el}s, >50% over its recorded" \

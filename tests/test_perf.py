@@ -406,3 +406,20 @@ def test_cluster_top_json_straggler_and_profile():
     finally:
         ray_tpu.shutdown()
         c.shutdown()
+
+
+def test_fresh_histogram_build_does_not_self_deadlock():
+    """bucket_bounds() runs inside PerfHistogram.__init__, which get()
+    constructs while holding the registry lock — the bounds cache must
+    use its own lock or the first observe after a reset() wedges."""
+    perf.reset()                       # bounds cache cold
+    done = threading.Event()
+
+    def first_observe():
+        perf.get("perf.selftest.fresh").observe(1.0)
+        done.set()
+
+    t = threading.Thread(target=first_observe, daemon=True)
+    t.start()
+    assert done.wait(5.0), "histogram construction deadlocked"
+    assert perf.get("perf.selftest.fresh").count() == 1

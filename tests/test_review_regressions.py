@@ -87,3 +87,107 @@ def test_concurrent_driver_puts_unique(ray_start_regular):
         t.join()
     for tag in range(4):
         assert results[tag] == [(tag, i) for i in range(50)]
+
+
+# -- data-race regressions (raylint R23) -------------------------------------
+# Deterministic two-thread schedules reproducing races the field-level
+# lockset analysis surfaced.  Each failed on the pre-fix code: the
+# interleaving is forced with events/barriers, not sleeps.
+
+
+def test_perf_bounds_reset_race_publishes_fresh_layout():
+    """A ``bucket_bounds()`` compute in flight across a ``reset()`` must
+    not publish its stale layout over the freshly computed one.  Pre-fix
+    the loser thread's unconditional store clobbered ``_bounds_cache``
+    with the old bucket count, and every histogram minted afterwards
+    disagreed with the config."""
+    from ray_tpu._private.config import _config
+    from ray_tpu.observability import perf
+
+    old_n = _config.get("perf_hist_buckets")
+    real_get = _config.get
+    entered = threading.Event()
+    release = threading.Event()
+
+    def slow_get(name):
+        if name == "perf_hist_buckets" and not entered.is_set():
+            entered.set()
+            release.wait(5)
+            return 8            # the stale pre-reset layout
+        return real_get(name)
+
+    perf.reset()
+    out = {}
+
+    def compute():
+        out["bounds"] = perf.bucket_bounds()
+
+    try:
+        _config.get = slow_get
+        t = threading.Thread(target=compute, daemon=True)
+        t.start()
+        assert entered.wait(5), "compute thread never reached the config read"
+        _config.get = real_get
+        _config.set("perf_hist_buckets", 16)
+        perf.reset()            # invalidates the in-flight compute
+        assert len(perf.bucket_bounds()) == 16
+        release.set()
+        t.join(5)
+        assert not t.is_alive()
+        # pre-fix: the resumed thread overwrote the cache with 8 bounds
+        assert len(perf.bucket_bounds()) == 16
+        assert len(out["bounds"]) in (8, 16)  # the loser saw one layout or the other
+    finally:
+        _config.get = real_get
+        release.set()
+        _config.set("perf_hist_buckets", old_n)
+        perf.reset()
+
+
+def test_backoff_retry_counter_minted_once_under_race():
+    """Two first-retry threads racing through ``_count_retry`` must mint
+    ONE ``Counter``.  Pre-fix both saw the ``None`` singleton and each
+    constructed+registered its own — the first thread's increments landed
+    on an orphaned series the exposition never showed.  The barrier in
+    the patched constructor proves both threads were inside construction
+    simultaneously on the racy code; with the creation lock only one
+    ever gets there."""
+    from ray_tpu._private import backoff
+    from ray_tpu.util import metrics
+
+    saved_counter = backoff._retry_counter
+    real_counter_cls = metrics.Counter
+    with metrics._registry._lock:
+        saved_reg = metrics._registry._metrics.pop("backoff_retries_total", None)
+    backoff._retry_counter = None
+
+    made = []
+    barrier = threading.Barrier(2)
+
+    class RacyCounter(real_counter_cls):
+        def __init__(self, *a, **k):
+            made.append(threading.get_ident())
+            try:
+                barrier.wait(0.5)   # pre-fix: both racers meet here
+            except threading.BrokenBarrierError:
+                pass
+            super().__init__(*a, **k)
+
+    try:
+        metrics.Counter = RacyCounter
+        ts = [threading.Thread(target=backoff._count_retry, args=("site-a",))
+              for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(10)
+        assert len(made) == 1, f"counter constructed {len(made)}x under race"
+        assert backoff._retry_counter is not None
+    finally:
+        metrics.Counter = real_counter_cls
+        backoff._retry_counter = saved_counter
+        with metrics._registry._lock:
+            if saved_reg is not None:
+                metrics._registry._metrics["backoff_retries_total"] = saved_reg
+            else:
+                metrics._registry._metrics.pop("backoff_retries_total", None)
