@@ -591,6 +591,83 @@ def bench_drain(mb: int = 32):
         c.shutdown()
 
 
+def bench_churn_goodput():
+    """``goodput_under_churn_pct``: modeled fleet goodput riding out a
+    preemption storm at the proactive-drain threshold hazard (6/hour)
+    with the risk-tuned checkpoint cadence actually produced by
+    ``solve_interval_steps`` for that hazard. The ledger is built from
+    the solver's interval — checkpoint stalls at the solved cadence,
+    plus per-preemption restart downtime and half-an-interval of lost
+    work — then folded through ``merge_payloads``/``goodput_pct``. All
+    inputs are fixed, so the row moves only when the cadence solver or
+    the federation math changes: a solver regression toward too-dense
+    or too-sparse checkpoints drops modeled goodput below the floor
+    (gated bigger-is-better by ``check_against``'s goodput carve-out)."""
+    from ray_tpu.checkpoint import solve_interval_steps
+    from ray_tpu.observability import goodput
+
+    hazard = 6.0          # preempts/hour — the hazard_drain_threshold
+    step_s, ckpt_s, restart_s = 1.0, 2.0, 30.0
+    interval = solve_interval_steps(hazard, step_s, ckpt_s,
+                                    restart_cost_s=restart_s,
+                                    min_steps=1, max_steps=10_000)
+    wall = 3600.0
+    ckpt_stall = wall / (interval * step_s) * ckpt_s
+    # Each preemption costs the restart plus on average half a
+    # checkpoint interval of recomputed work.
+    restart_down = hazard * (restart_s + interval * step_s / 2.0)
+    compute = wall - ckpt_stall - restart_down
+    ledger = {"jobs": {"train": {
+        "wall_s": wall, "compile_count": 1, "recompile_count": 0,
+        "cats": {"compute": compute, "compile": 0.0, "data_wait": 0.0,
+                 "collective_wait": 0.0, "ckpt_stall": ckpt_stall,
+                 "restart_downtime": restart_down, "idle": 0.0}}}}
+    fleet = goodput.merge_payloads([ledger])
+    emit("goodput_under_churn_pct", fleet["train"]["goodput_pct"], "%")
+
+
+def bench_preempt_notice(poll_ms: float = 200.0):
+    """``preempt_notice_to_drain_ms``: the live eviction-notice pipeline.
+    One fresh daemon whose preemption watcher receives a chaos eviction
+    notice on its FIRST poll (``node.preempt@1%1000000=drop``); measured
+    from the node first showing alive in ``list_nodes`` to its state
+    flipping DRAINING — watcher wakeup, notice, ``begin_drain`` (hazard
+    journaling included) and the state-service flip, the whole path the
+    real GCE notice takes. Ceiling row (``_ms``): a regression here
+    means preempted nodes burn their eviction lead time before
+    migration even starts."""
+    import ray_tpu
+    from ray_tpu._private.state_client import StateClient
+    from ray_tpu.cluster_utils import ProcessCluster
+    ray_tpu.shutdown()
+    c = ProcessCluster(num_daemons=0, num_cpus=1)
+    try:
+        c.add_daemon(env={
+            "RAY_TPU_CHAOS": "5:node.preempt@1%1000000=drop",
+            "RAY_TPU_PREEMPT_POLL_MS": str(poll_ms),
+            "RAY_TPU_PREEMPT_LEAD_S": "30",
+        })
+        state = StateClient(c.address)
+        try:
+            t_alive = None
+            ms = 60_000.0   # timeout sentinel: fails the ceiling gate
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                nodes = state.list_nodes()
+                if t_alive is None:
+                    if any(n.alive for n in nodes):
+                        t_alive = time.perf_counter()
+                elif any(n.state == "DRAINING" for n in nodes):
+                    ms = (time.perf_counter() - t_alive) * 1e3
+                    break
+                time.sleep(0.01)
+            emit("preempt_notice_to_drain_ms", ms, "ms")
+        finally:
+            state.close()
+    finally:
+        c.shutdown()
+
+
 def _serve_drive(handle, rate_hz: float, duration_s: float,
                  pool_size: int = 64):
     """Open-loop arrival process: requests fire at fixed intervals
@@ -740,6 +817,7 @@ def run_inproc():
     bench_recorder_overhead("inproc")
     bench_perf_overhead("inproc")
     bench_goodput("inproc")
+    bench_churn_goodput()
     bench_comms("inproc")
     ray_tpu.shutdown()
 
@@ -766,7 +844,8 @@ def check_against(baseline_path: str, tolerance: float) -> int:
     overhead percentages (``_pct``) are inverted and must stay <=
     baseline / tolerance (for ``_pct`` the baseline is the budget itself
     — e.g. the 1% disabled-tracing bound — not a past measurement).
-    Exception: ``goodput_pct`` rows are efficiency *floors* — higher is
+    Exception: goodput percentage rows (``*goodput_pct``,
+    ``goodput_under_churn_pct``) are efficiency *floors* — higher is
     better, like throughput — so they gate as >= baseline * tolerance.
     Metrics missing from either side are skipped (a cluster-less
     environment still gates the inproc set, and TPU-scale target rows
@@ -780,7 +859,7 @@ def check_against(baseline_path: str, tolerance: float) -> int:
         got = measured.get(metric)
         if got is None or base <= 0:
             continue
-        if metric.endswith("goodput_pct"):
+        if metric.endswith(("goodput_pct", "goodput_under_churn_pct")):
             # goodput is the one percentage where bigger is better: it
             # is a fraction of wall-clock doing useful work, not an
             # overhead budget
@@ -833,6 +912,7 @@ def main():
     if args.mode in ("cluster", "both"):
         run_cluster()
         bench_drain()   # graceful-drain migration + zero-loss gate
+        bench_preempt_notice()   # eviction notice -> DRAINING latency
     if args.out:
         with open(args.out, "w") as f:
             json.dump(RESULTS, f, indent=1)

@@ -273,6 +273,51 @@ _config.define("preempt_lead_s", float, 10.0,
                "(eviction lead time promised by the provider)")
 _config.define("preempt_poll_ms", int, 500,
                "preemption watcher poll period in the host daemon")
+_config.define("preempt_probe_failure_threshold", int, 3,
+               "consecutive preempt_probe_url failures before the doctor "
+               "flags the node's watcher as blind (the daemon also "
+               "exports the count as the preempt_probe_failures gauge)")
+
+# -- Preemption-hazard estimator (autoscaler/hazard.py) ---------------------------
+_config.define("hazard_window_s", float, 3600.0,
+               "sliding window over journaled preemption-notice events; "
+               "events older than this stop contributing to hazard and "
+               "are garbage-collected from the state KV")
+_config.define("hazard_halflife_s", float, 900.0,
+               "exponential-decay half-life for event contributions "
+               "inside the window: a notice this old counts half as much "
+               "as one that just landed")
+_config.define("hazard_probe_weight", float, 2.0,
+               "per-node hazard added per consecutive preempt-probe "
+               "failure (an unreachable metadata endpoint means the real "
+               "notice may never be seen, so the node reads as riskier)")
+_config.define("hazard_drain_threshold", float, 6.0,
+               "per-node hazard score (decayed preemptions/hour) above "
+               "which the autoscaler proactively drains the highest-"
+               "hazard node with the full drain_deadline_s budget")
+_config.define("hazard_placement_threshold", float, 2.0,
+               "hazard score above which a node is hinted pending-drain: "
+               "the schedulers treat it as a last-choice placement")
+_config.define("hazard_proactive_drains", bool, True,
+               "let the autoscaler start proactive drains when hazard "
+               "crosses hazard_drain_threshold (off = estimate and hint "
+               "placements only)")
+_config.define("hazard_rate_floor_per_hour", float, 0.0,
+               "assumed fleet preemption rate when no events have been "
+               "journaled yet (the cadence solver's cold-start prior; "
+               "set to the provider's advertised preemption rate)")
+
+# -- Adaptive checkpoint cadence (checkpoint/cadence.py) --------------------------
+_config.define("checkpoint_cadence_min_steps", int, 1,
+               "floor for checkpoint_frequency='auto': never checkpoint "
+               "more often than every report")
+_config.define("checkpoint_cadence_max_steps", int, 200,
+               "ceiling for checkpoint_frequency='auto': checkpoint at "
+               "least this often even when hazard reads zero")
+_config.define("checkpoint_cadence_refresh_steps", int, 10,
+               "reports between cadence re-solves: each refresh re-reads "
+               "the fleet hazard rate and the measured step/checkpoint "
+               "costs, so cadence tracks a hazard change mid-run")
 
 # -- Performance plane (streaming histograms + sampling profiler) ---------------
 _config.define("perf_enabled", bool, True,

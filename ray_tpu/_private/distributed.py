@@ -225,6 +225,9 @@ class DistributedRuntime(Runtime):
 
         # Cluster view: node_id bytes -> (pb.NodeInfo, NodeResources view).
         self._states_memo = None  # (monotonic_ts, [NodeState]) micro-TTL
+        # Autoscaler hazard hints (node_id bytes): last-choice placement
+        # for nodes the preemption estimator expects to drain soon.
+        self._pending_drain_hints: frozenset = frozenset()
         self._view_lock = threading.Lock()
         self._view: Dict[bytes, pb.NodeInfo] = {}  # raylint: guarded-by(self._view_lock)
         self._view_avail: Dict[bytes, NodeResources] = {}  # raylint: guarded-by(self._view_lock)
@@ -864,6 +867,18 @@ class DistributedRuntime(Runtime):
                                   deadline_s=budget)
         except Exception as e:
             logger.debug("drain_node publish failed: %s", e)
+        if "preemption notice" in reason:
+            # Journal the real notice (not proactive hazard drains) so the
+            # autoscaler's hazard estimator learns this node type's
+            # preemption rate (autoscaler/hazard.py KV layout).
+            try:
+                from ray_tpu.autoscaler import hazard as _hazard
+                _hazard.journal_preemption(
+                    self.state, self.local_node.node_id.hex(),
+                    self.local_node.labels.get("autoscaler-node-type",
+                                               "default"), reason)
+            except Exception as e:  # noqa: BLE001
+                logger.debug("preemption journal failed: %s", e)
         t = threading.Thread(target=self._drain_worker,
                              args=(reason, deadline), daemon=True,
                              name="dist-drain")
@@ -1817,6 +1832,20 @@ class DistributedRuntime(Runtime):
                 for nid, info in self._view.items() if not info.alive]
         return self._cluster_states() + dead
 
+    def set_pending_drain(self, node_id_hex: str, flag: bool) -> None:
+        """Autoscaler hazard hint: treat a node as a last-choice placement
+        (see scheduler.NodeState.pending_drain). Driver-local — the hints
+        steer this process's schedulers, which is where the autoscaler's
+        own placement decisions run."""
+        nid = bytes.fromhex(node_id_hex)
+        hints = self._pending_drain_hints
+        if (nid in hints) == flag:
+            return
+        updated = (hints | {nid}) if flag else (hints - {nid})
+        self._pending_drain_hints = updated  # raylint: allow(data-race) immutable frozenset publish; readers see old or new snapshot
+        with self._view_lock:
+            self._states_memo = None  # placement must see the hint  # raylint: allow(data-race) immutable tuple publish; the unlocked micro-TTL read re-validates within 2ms
+
     def _cluster_states(self, include_suspects: bool = False
                         ) -> List[NodeState]:
         now = time.monotonic()
@@ -1842,8 +1871,10 @@ class DistributedRuntime(Runtime):
                 if nr is None:
                     nr = NodeResources(ResourceSet(dict(info.total.amounts)))
                     self._view_avail[nid] = nr
-                states.append(NodeState(NodeID(nid), nr, True,
-                                        draining=info.state == "DRAINING"))
+                states.append(NodeState(
+                    NodeID(nid), nr, True,
+                    draining=info.state == "DRAINING",
+                    pending_drain=nid in self._pending_drain_hints))
             if not include_suspects:
                 self._states_memo = (now, states)  # raylint: allow(data-race) immutable tuple publish; the unlocked micro-TTL read re-validates within 2ms
         return states

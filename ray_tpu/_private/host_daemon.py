@@ -61,7 +61,68 @@ def _install_thread_profiler(out_dir: str):
     atexit.register(dump)
 
 
-def _preempt_signaled(node_tag: str) -> "str | None":
+class _ProbeState:
+    """Failure bookkeeping for the ``preempt_probe_url`` poll.
+
+    A flapping or unreachable metadata endpoint must not be re-probed at
+    the full ``preempt_poll_ms`` cadence (1-second connect timeouts at a
+    500 ms poll period pile up), so consecutive failures pace the next
+    attempt with the shared :class:`BackoffPolicy` (``preempt_poll_ms``
+    base, ``backoff_max_ms`` cap, no jitter — deterministic pacing).
+    The consecutive-failure count is exported as the
+    ``preempt_probe_failures`` gauge and published into the state KV
+    (``preempt`` namespace) so the doctor can flag a blind watcher and
+    the hazard estimator can treat the node as riskier.
+    """
+
+    def __init__(self, runtime=None):
+        from ray_tpu._private.backoff import BackoffPolicy
+        from ray_tpu._private.config import _config
+        from ray_tpu.util import metrics as _metrics
+        poll_s = max(0.1, _config.get("preempt_poll_ms") / 1e3)
+        self._policy = BackoffPolicy(base_s=poll_s, jitter=False,
+                                     label="preempt-probe")
+        self._runtime = runtime
+        self._not_before = 0.0
+        self.failures = 0
+        self._gauge = _metrics.Gauge(
+            "preempt_probe_failures",
+            "consecutive preempt_probe_url failures on this node (a "
+            "blind preemption watcher; the doctor flags it past "
+            "preempt_probe_failure_threshold)")
+        self._gauge.set(0)
+
+    def throttled(self, now: float) -> bool:
+        return now < self._not_before
+
+    def success(self, now: float) -> None:
+        if self.failures:
+            self.failures = 0
+            self._gauge.set(0)
+            self._publish()
+        self._not_before = 0.0
+
+    def failure(self, now: float) -> None:
+        self.failures += 1
+        self._gauge.set(self.failures)
+        self._not_before = now + self._policy.delay_for(self.failures - 1)
+        self._publish()
+
+    def _publish(self) -> None:
+        state = getattr(self._runtime, "state", None)
+        if state is None:
+            return
+        try:
+            from ray_tpu.autoscaler import hazard as _hazard
+            _hazard.publish_probe_health(
+                state, self._runtime.local_node.node_id.hex(),
+                self.failures)
+        except Exception as e:  # noqa: BLE001
+            logging.debug("probe health publish failed: %s", e)
+
+
+def _preempt_signaled(node_tag: str,
+                      probe: "Optional[_ProbeState]" = None) -> "str | None":
     """One poll of the pluggable preemption watcher. Two sources, checked
     in order:
 
@@ -70,7 +131,9 @@ def _preempt_signaled(node_tag: str) -> "str | None":
       signal composes with any other chaos running); and
     - ``preempt_probe_url`` — a GCE-metadata-style HTTP probe for real
       TPU VMs (``.../instance/preempted`` returns TRUE once the eviction
-      is scheduled; anything but NONE/FALSE counts as a notice).
+      is scheduled; anything but NONE/FALSE counts as a notice). When a
+      ``probe`` state is supplied, failed probes back off instead of
+      retrying at every poll, and consecutive failures are exported.
 
     Returns the drain reason, or None when no preemption is pending.
     """
@@ -81,6 +144,9 @@ def _preempt_signaled(node_tag: str) -> "str | None":
     from ray_tpu._private.config import _config
     url = _config.get("preempt_probe_url")
     if url:
+        now = time.monotonic()
+        if probe is not None and probe.throttled(now):
+            return None
         try:
             import urllib.request
             req = urllib.request.Request(
@@ -88,10 +154,14 @@ def _preempt_signaled(node_tag: str) -> "str | None":
             with urllib.request.urlopen(req, timeout=1.0) as resp:
                 body = resp.read(256).decode(
                     "utf-8", "replace").strip().upper()
-            if body not in ("", "NONE", "FALSE"):
-                return f"preemption notice (probe: {body[:40]})"
-        except Exception:  # noqa: BLE001  # raylint: allow(swallow) probe outage must not kill the watcher; next poll retries
-            pass
+        except Exception:  # noqa: BLE001  # raylint: allow(swallow) probe outage must not kill the watcher; the backoff-paced next poll retries
+            if probe is not None:
+                probe.failure(time.monotonic())
+            return None
+        if probe is not None:
+            probe.success(now)
+        if body not in ("", "NONE", "FALSE"):
+            return f"preemption notice (probe: {body[:40]})"
     return None
 
 
@@ -208,6 +278,7 @@ def main(argv=None) -> int:
     from ray_tpu._private.config import _config
     node_tag = runtime.local_node.node_id.hex()[:8]
     preempt_poll_s = max(0.1, _config.get("preempt_poll_ms") / 1e3)
+    probe_state = _ProbeState(runtime)
     next_sweep = time.monotonic() + 2.0
     next_preempt_probe = time.monotonic() + preempt_poll_s
     try:
@@ -219,7 +290,7 @@ def main(argv=None) -> int:
             if (not runtime.draining
                     and time.monotonic() >= next_preempt_probe):
                 next_preempt_probe = time.monotonic() + preempt_poll_s
-                reason = _preempt_signaled(node_tag)
+                reason = _preempt_signaled(node_tag, probe=probe_state)
                 if reason:
                     logging.warning("preemption notice: draining node %s "
                                     "(%s)", node_tag, reason)

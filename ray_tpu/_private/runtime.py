@@ -122,6 +122,9 @@ class Node:
         self.labels = labels or {}
         self.alive = True
         self.draining = False  # lifecycle: still alive, shun new placement
+        # Autoscaler hazard hint: likely to drain soon, last-choice
+        # placement (see scheduler.NodeState.pending_drain).
+        self.pending_drain = False
         self._pool = ThreadPoolExecutor(
             max_workers=_MAX_NODE_THREADS,
             thread_name_prefix=f"node-{self.node_id.hex()[:6]}")
@@ -133,7 +136,8 @@ class Node:
 
     def state(self) -> NodeState:
         return NodeState(self.node_id, self.resources, self.alive,
-                         draining=self.draining)
+                         draining=self.draining,
+                         pending_drain=self.pending_drain)
 
     def kill(self):
         """Simulate host failure: objects lost, resources gone (chaos tests)."""
@@ -334,6 +338,16 @@ class Runtime:
     def node_states(self) -> List[NodeState]:
         with self.lock:
             return [self.nodes[nid].state() for nid in self._node_order]
+
+    def set_pending_drain(self, node_id_hex: str, flag: bool) -> None:
+        """Autoscaler hazard hint: mark a node last-choice for placement
+        (it stays fully schedulable — see NodeState.pending_drain)."""
+        from ray_tpu._private.ids import NodeID
+        with self.lock:
+            node = self.nodes.get(NodeID(bytes.fromhex(node_id_hex)))
+        if node is not None and node.pending_drain != flag:
+            node.pending_drain = flag
+            self._kick()
 
     # ---------------------------------------------------------------- objects
 

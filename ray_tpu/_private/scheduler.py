@@ -97,11 +97,15 @@ class NodeState:
     """Scheduler-visible view of one node."""
 
     def __init__(self, node_id: NodeID, resources: NodeResources, alive: bool = True,
-                 draining: bool = False):
+                 draining: bool = False, pending_drain: bool = False):
         self.node_id = node_id
         self.resources = resources
         self.alive = alive
         self.draining = draining
+        # Hazard hint from the autoscaler's preemption estimator: the
+        # node is still fully schedulable, but a drain is likely soon, so
+        # policies place on it only when no stable node fits.
+        self.pending_drain = pending_drain
 
     @property
     def schedulable(self) -> bool:
@@ -109,6 +113,19 @@ class NodeState:
         in-flight work runs to the drain deadline) but must not receive
         anything new, so every policy filters on this, not ``alive``."""
         return self.alive and not self.draining
+
+
+def _stable_first(nodes: Sequence["NodeState"]) -> Optional[List["NodeState"]]:
+    """The subset of ``nodes`` without a pending-drain hazard hint, or
+    None when the hint splits nothing (all stable / all hazardous).
+
+    Every placement policy tries the stable subset first and falls back
+    to the full set: new work should land on capacity that is expected
+    to survive, but a fully-hazardous fleet must still schedule."""
+    stable = [n for n in nodes if not n.pending_drain]
+    if not stable or len(stable) == len(nodes):
+        return None
+    return stable
 
 
 class Infeasible(Exception):
@@ -126,6 +143,15 @@ class HybridPolicy:
 
     def select(self, nodes: Sequence[NodeState], request: ResourceSet,
                preferred: Optional[NodeID] = None) -> Optional[NodeID]:
+        stable = _stable_first(nodes)
+        if stable is not None:
+            nid = self._select(stable, request, preferred)
+            if nid is not None:
+                return nid
+        return self._select(nodes, request, preferred)
+
+    def _select(self, nodes: Sequence[NodeState], request: ResourceSet,
+                preferred: Optional[NodeID] = None) -> Optional[NodeID]:
         threshold = (self.spread_threshold if self.spread_threshold is not None
                      else _config.get("scheduler_spread_threshold"))
         top_k_frac = (self.top_k_fraction if self.top_k_fraction is not None
@@ -168,6 +194,15 @@ class SpreadPolicy:
 
     def select(self, nodes: Sequence[NodeState], request: ResourceSet,
                preferred: Optional[NodeID] = None) -> Optional[NodeID]:
+        stable = _stable_first(nodes)
+        if stable is not None:
+            nid = self._select(stable, request, preferred)
+            if nid is not None:
+                return nid
+        return self._select(nodes, request, preferred)
+
+    def _select(self, nodes: Sequence[NodeState], request: ResourceSet,
+                preferred: Optional[NodeID] = None) -> Optional[NodeID]:
         lib = _native()
         if lib is not None:
             avail, _total, alive, req, n_nodes, n_res = _flatten(nodes,
@@ -215,6 +250,8 @@ def _bin_pack(nodes: List[NodeState], bundles: Sequence[ResourceSet],
     """Greedy bundle placement over a copy of node availability."""
     avail: Dict[NodeID, ResourceSet] = {
         n.node_id: n.resources.available for n in nodes if n.schedulable}
+    pending: Dict[NodeID, bool] = {
+        n.node_id: n.pending_drain for n in nodes if n.schedulable}
     used_nodes: List[NodeID] = []
     placement: List[NodeID] = []
     order = sorted(range(len(bundles)),
@@ -231,11 +268,15 @@ def _bin_pack(nodes: List[NodeState], bundles: Sequence[ResourceSet],
         if not candidates:
             return None
         if minimize_nodes:
-            # Prefer nodes already holding a bundle (PACK), then most-loaded.
-            candidates.sort(key=lambda nid: (nid not in used_nodes,))
+            # Pending-drain nodes last, then prefer nodes already holding
+            # a bundle (PACK).
+            candidates.sort(key=lambda nid: (pending[nid],
+                                             nid not in used_nodes))
         else:
-            # SPREAD: prefer nodes not yet holding a bundle.
-            candidates.sort(key=lambda nid: (nid in used_nodes,))
+            # SPREAD: pending-drain nodes last, then prefer nodes not yet
+            # holding a bundle.
+            candidates.sort(key=lambda nid: (pending[nid],
+                                             nid in used_nodes))
         chosen = candidates[0]
         avail[chosen] = avail[chosen].subtract(b)
         if chosen not in used_nodes:
@@ -251,7 +292,9 @@ def schedule_bundles(nodes: List[NodeState], bundles: Sequence[ResourceSet],
         total = ResourceSet()
         for b in bundles:
             total = total.add(b)
-        for n in nodes:
+        # Stable nodes first: a strict-pack group on a pending-drain node
+        # would migrate wholesale at the predicted preemption.
+        for n in sorted(nodes, key=lambda n: n.pending_drain):
             if n.schedulable and n.resources.can_fit(total):
                 return [n.node_id] * len(bundles)
         return None

@@ -8,7 +8,7 @@ Parity with ``python/ray/air/config.py`` (``ScalingConfig``, ``RunConfig``,
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Union
 
 
 @dataclass
@@ -37,7 +37,12 @@ class FailureConfig:
 @dataclass
 class CheckpointConfig:
     num_to_keep: Optional[int] = None
-    checkpoint_frequency: int = 0
+    # int: save every Nth reported checkpoint (0/1 = every one).
+    # "auto": risk-tuned cadence — the session solves the Young–Daly
+    # interval from the fleet preemption hazard and measured step /
+    # checkpoint costs (ray_tpu.checkpoint.cadence), re-tuning as the
+    # hazard estimate moves.
+    checkpoint_frequency: Union[int, str] = 0
 
 
 @dataclass

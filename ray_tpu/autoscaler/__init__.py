@@ -11,7 +11,8 @@ nodes of the in-process runtime for tests.
 
 from ray_tpu.autoscaler.autoscaler import (AutoscalerConfig, LoadMetrics,
                                            StandardAutoscaler)
+from ray_tpu.autoscaler.hazard import HazardEstimator
 from ray_tpu.autoscaler.node_provider import (FakeNodeProvider, NodeProvider)
 
 __all__ = ["StandardAutoscaler", "AutoscalerConfig", "LoadMetrics",
-           "NodeProvider", "FakeNodeProvider"]
+           "NodeProvider", "FakeNodeProvider", "HazardEstimator"]

@@ -44,10 +44,16 @@ class LoadMetrics:
         return self._runtime.pending_resource_demands()
 
     def node_utilization(self) -> Dict[str, dict]:
-        """node hex id -> {"total": .., "available": .., "idle": bool}."""
+        """node hex id -> {"total": .., "available": .., "idle": bool}.
+
+        DRAINING/DRAINED nodes are excluded along with dead ones: a
+        draining node that has quiesced *looks* idle, but terminating it
+        mid-drain would turn a graceful migration into a node death; and
+        its capacity is about to leave, so bin-packing unmet demand onto
+        it would mask a needed scale-up."""
         out = {}
         for ns in self._runtime.node_states():
-            if not ns.alive:
+            if not ns.alive or ns.draining:
                 continue
             total = ns.resources.total.to_dict()
             avail = ns.resources.available.to_dict()
@@ -56,6 +62,14 @@ class LoadMetrics:
                 "total": total, "available": avail, "idle": idle}
         return out
 
+    def lifecycle(self) -> Dict[str, dict]:
+        """node hex id -> {"alive": .., "draining": ..} for every node the
+        runtime knows (the gang-replacement scan needs the nodes
+        ``node_utilization`` deliberately hides)."""
+        return {ns.node_id.hex(): {"alive": ns.alive,
+                                   "draining": ns.draining}
+                for ns in self._runtime.node_states()}
+
 
 def _fits(demand: Dict[str, float], capacity: Dict[str, float]) -> bool:
     return all(capacity.get(k, 0.0) >= v for k, v in demand.items() if v > 0)
@@ -63,7 +77,7 @@ def _fits(demand: Dict[str, float], capacity: Dict[str, float]) -> bool:
 
 class StandardAutoscaler:
     def __init__(self, config: AutoscalerConfig, provider: NodeProvider,
-                 runtime=None):
+                 runtime=None, hazard=None):
         if runtime is None:
             from ray_tpu._private import worker as _worker
             runtime = _worker.global_worker().runtime
@@ -78,13 +92,143 @@ class StandardAutoscaler:
         self._thread: Optional[threading.Thread] = None
         self.num_launches = 0
         self.num_terminations = 0
+        # Elastic preemptible fleet: the hazard estimator feeds proactive
+        # drains and pending-drain placement hints; gang replacement
+        # refills every drain. A distributed runtime's state client backs
+        # the estimator with the cluster-wide KV journal; the in-process
+        # runtime gets a local (record()-fed) estimator.
+        if hazard is None:
+            from ray_tpu.autoscaler.hazard import HazardEstimator
+            hazard = HazardEstimator(getattr(runtime, "state", None))
+        self.hazard = hazard
+        self._replaced: set = set()      # provider ids already refilled
+        self.num_replacements = 0
+        self.num_proactive_drains = 0
 
     # -- one reconciliation pass (autoscaler.py:336 update) ---------------
 
     def update(self) -> Dict[str, int]:
+        drained = self._hazard_pass()
+        replaced = self._gang_replace()
         launched = self._scale_up()
         terminated = self._scale_down()
-        return {"launched": launched, "terminated": terminated}
+        return {"launched": launched, "terminated": terminated,
+                "proactively_drained": drained, "replaced": replaced}
+
+    # -- preemption hazard: predict, hint, proactively drain ---------------
+
+    def _provider_runtime_ids(self) -> Dict[str, str]:
+        """provider id -> runtime node hex, for nodes already registered."""
+        out = {}
+        for pid in self.provider.non_terminated_nodes():
+            try:
+                out[pid] = self.provider.runtime_node_id(pid).hex()
+            except (AttributeError, KeyError) as e:
+                logger.debug("autoscaler: node %s has no runtime id yet "
+                             "(%s); skipping hazard scan", pid, e)
+        return out
+
+    def _hazard_pass(self) -> int:
+        """Refresh the estimator, hint high-hazard nodes as last-choice
+        placements, and proactively drain the highest-hazard node once
+        its score crosses ``hazard_drain_threshold`` — ahead of the real
+        notice, so the drain runs with the full ``drain_deadline_s``
+        budget instead of ``preempt_lead_s``."""
+        from ray_tpu._private.config import _config
+        self.hazard.refresh()
+        self.hazard.publish_fleet_rate()
+        lifecycle = self.load_metrics.lifecycle()
+        place_thresh = _config.get("hazard_placement_threshold")
+        drain_thresh = _config.get("hazard_drain_threshold")
+        hint = getattr(self._runtime, "set_pending_drain", None)
+        # At most one proactive drain in flight: the hazard rate is a
+        # per-TYPE signal, so without this guard every node of a hot type
+        # would cross the threshold and the fleet would cascade-drain
+        # itself one pass at a time.
+        draining_now = any(st["alive"] and st["draining"]
+                           for st in lifecycle.values())
+        worst: Optional[tuple] = None   # (score, pid, rid)
+        for pid, rid in self._provider_runtime_ids().items():
+            state = lifecycle.get(rid)
+            if state is None or not state["alive"] or state["draining"]:
+                continue
+            score = self.hazard.node_hazard(self.provider.node_type(pid),
+                                            rid)
+            if hint is not None:
+                hint(rid, score >= place_thresh)
+            if score >= drain_thresh and (worst is None
+                                          or score > worst[0]):
+                worst = (score, pid, rid)
+        if (worst is None or draining_now
+                or not _config.get("hazard_proactive_drains")):
+            return 0
+        score, pid, rid = worst
+        logger.warning("autoscaler: proactive drain of %s (hazard %.2f "
+                       ">= %.2f)", rid[:8], score, drain_thresh)
+        if not self._drain_runtime_node(rid, reason=(
+                f"preemption hazard {score:.2f} (proactive)")):
+            return 0
+        self.num_proactive_drains += 1  # raylint: allow(data-race) single autoscaler update loop is the only writer; counter is monitoring-only
+        return 1
+
+    def _drain_runtime_node(self, rid_hex: str, reason: str) -> bool:
+        """Start a graceful drain with the full drain budget: through the
+        state service on a distributed runtime, or by flipping the node's
+        lifecycle flag on the in-process runtime (which has no drain
+        orchestrator — the node just stops taking new placements)."""
+        from ray_tpu._private.config import _config
+        state = getattr(self._runtime, "state", None)
+        if state is not None:
+            try:
+                state.drain_node(bytes.fromhex(rid_hex), reason,
+                                 deadline_s=_config.get("drain_deadline_s"))
+                return True
+            except Exception as e:  # noqa: BLE001
+                logger.warning("autoscaler: proactive drain of %s failed: "
+                               "%s", rid_hex[:8], e)
+                return False
+        from ray_tpu._private.ids import NodeID
+        node = getattr(self._runtime, "nodes", {}).get(
+            NodeID(bytes.fromhex(rid_hex)))
+        if node is None:
+            return False
+        node.draining = True
+        self._runtime._kick()
+        return True
+
+    # -- gang replacement: every drain is refilled same-type ---------------
+
+    def _gang_replace(self) -> int:
+        """Launch a same-type replacement for every provider node that is
+        draining (or died out from under us) — immediately, not when the
+        drained node's capacity shortfall shows up as unmet demand, so
+        the replacement daemon gang-joins while the drain is still
+        migrating and the job reshards onto a full-size world."""
+        lifecycle = self.load_metrics.lifecycle()
+        ids = self._provider_runtime_ids()
+        stable = sum(1 for pid, rid in ids.items()
+                     if (st := lifecycle.get(rid)) is not None
+                     and st["alive"] and not st["draining"])
+        replaced = 0
+        for pid, rid in ids.items():
+            st = lifecycle.get(rid)
+            if st is None or (st["alive"] and not st["draining"]):
+                continue
+            if pid in self._replaced:
+                continue
+            if stable + replaced >= self.config.max_workers:
+                logger.warning("autoscaler: not replacing draining node "
+                               "%s (at max_workers=%d)", rid[:8],
+                               self.config.max_workers)
+                break
+            ntype = self.provider.node_type(pid)
+            self.provider.create_node(ntype, 1)
+            self._replaced.add(pid)  # raylint: allow(data-race) only touched inside update() — the single monitor loop, or a test driving update() directly with no monitor running
+            replaced += 1
+            logger.info("autoscaler: gang replacement for %s (%s)",
+                        rid[:8], ntype)
+        self.num_replacements += replaced  # raylint: allow(data-race) single autoscaler update loop is the only writer; counter is monitoring-only
+        return replaced
 
     def _unmet_demands(self) -> List[Dict[str, float]]:
         """Demands that no live node could satisfy even when empty."""
@@ -190,5 +334,5 @@ class StandardAutoscaler:
             self._thread.join(timeout=5)
         # Restore fail-fast for infeasible tasks: nothing will grow the
         # cluster anymore, so queued-forever would hang callers.
-        self._runtime.autoscaling_enabled = False
+        self._runtime.autoscaling_enabled = False  # raylint: allow(data-race) monitor thread already joined above; no concurrent reader remains
         self._runtime._kick()

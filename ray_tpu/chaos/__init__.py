@@ -33,7 +33,11 @@ state.reconnect       peer — StateClient._reconnect, before re-dialing
 state.heartbeat       node — daemon heartbeat loop, before each beat
 node.preempt          node — host daemon preemption watcher, per poll; a
                       "drop" return is the eviction notice (deterministic
-                      stand-in for the metadata-server probe)
+                      stand-in for the metadata-server probe). For fleet
+                      churn drills, :func:`preempt_storm_spec` builds the
+                      periodic-trigger storm form
+                      ``node.preempt@{M}%{M}=drop`` from a preemptions/
+                      hour rate and the watcher poll period
 object.push           peer, object — distributed pusher, per chunk
 object.fetch          peer, object — distributed fetch, per source attempt
 transport.stream      peer, consumer (object.fetch|drain.migrate|
@@ -74,7 +78,7 @@ __all__ = [
     "ENABLED", "ChaosError", "ChaosConnectionReset", "FaultRule",
     "FaultSchedule", "parse_spec", "parse_env", "configure", "install",
     "clear", "inject", "schedule", "set_observer", "trace_lines", "trace_text",
-    "register_exit_hook",
+    "register_exit_hook", "preempt_storm_spec",
 ]
 
 logger = logging.getLogger("ray_tpu")
@@ -143,6 +147,27 @@ def inject(point: str, **labels) -> Optional[str]:
     if action is not None:
         obs(point, labels, action)
     return action
+
+
+def preempt_storm_spec(preempts_per_hour: float, poll_ms: float,
+                       node: Optional[str] = None) -> str:
+    """Spec fragment for a deterministic preemption storm.
+
+    Converts a fleet churn rate (``preempts_per_hour``, per node matching
+    the filter) and the preemption watcher's poll period into the periodic
+    trigger form ``node.preempt[@M%M]=drop``: every M-th poll of the
+    watcher returns an eviction notice, so the inter-preemption gap is
+    ``M * poll_ms`` — the closest deterministic stand-in for a Poisson
+    churn process that still replays bit-identically from the seed.
+    Combine with other fragments via ``,`` and activate through
+    ``RAY_TPU_CHAOS=<seed>:<spec>`` (daemons inherit the env).
+    """
+    if preempts_per_hour <= 0.0 or poll_ms <= 0.0:
+        raise ValueError("preempt_storm_spec needs positive rate and poll")
+    polls_per_hour = 3600_000.0 / poll_ms
+    every = max(1, round(polls_per_hour / preempts_per_hour))
+    key = f"[node={node}]" if node else ""
+    return f"node.preempt{key}@{every}%{every}=drop"
 
 
 def trace_lines():

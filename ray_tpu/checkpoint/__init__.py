@@ -5,6 +5,7 @@ See engine.py for the save/commit pipeline and ARCHITECTURE.md
 "Checkpointing & elastic restore" for the on-disk format contract.
 """
 
+from ray_tpu.checkpoint.cadence import CadenceController, solve_interval_steps
 from ray_tpu.checkpoint.engine import (CheckpointEngine, CheckpointRef,
                                        EngineStats, SaveHandle, load)
 from ray_tpu.checkpoint.manifest import (CheckpointCorruption,
@@ -14,6 +15,7 @@ from ray_tpu.checkpoint.manifest import (CheckpointCorruption,
                                          resolve_latest)
 
 __all__ = [
+    "CadenceController", "solve_interval_steps",
     "CheckpointEngine", "CheckpointRef", "EngineStats", "SaveHandle", "load",
     "CheckpointError", "CheckpointCorruption", "CheckpointNotFound",
     "Manifest", "ShardIndex", "list_manifest_names", "read_manifest",

@@ -71,11 +71,18 @@ class ProcessCluster:
                  resources: Optional[Dict[str, float]] = None,
                  data_dir: str = "", heartbeat_timeout_ms: float = 3000,
                  daemon_heartbeat_s: float = 0.5,
-                 tp_cpu_devices: int = 0):
+                 tp_cpu_devices: int = 0,
+                 daemon_env: Optional[Dict[str, str]] = None):
         """``tp_cpu_devices`` > 0 gives every daemon that many virtual CPU
         JAX devices and enables Gloo collectives, so tensor-plane tests can
         run compiled cross-process collectives without TPUs (see
-        collective/tensor_plane.py)."""
+        collective/tensor_plane.py).
+
+        ``daemon_env`` is merged into EVERY daemon's environment —
+        including replacements the autoscaler's node provider launches
+        later — so a cluster-wide chaos schedule (``RAY_TPU_CHAOS``
+        preemption storm) keeps firing on gang-replaced nodes instead of
+        silently ending with the first casualty."""
         import subprocess
         import sys
         import tempfile
@@ -90,7 +97,8 @@ class ProcessCluster:
         self._daemon_args = dict(num_cpus=num_cpus,
                                  resources=resources or {},
                                  heartbeat_s=daemon_heartbeat_s,
-                                 tp_cpu_devices=tp_cpu_devices)
+                                 tp_cpu_devices=tp_cpu_devices,
+                                 env=dict(daemon_env or {}))
         for _ in range(num_daemons):
             self.add_daemon()
 
@@ -119,11 +127,13 @@ class ProcessCluster:
     def add_daemon(self, num_cpus: Optional[float] = None,
                    resources: Optional[Dict[str, float]] = None,
                    num_tpus: float = 0,
-                   env: Optional[Dict[str, str]] = None):
+                   env: Optional[Dict[str, str]] = None,
+                   labels: Optional[Dict[str, str]] = None):
         from ray_tpu._private.node import spawn_daemon
         extra = dict(env or {})  # e.g. RAY_TPU_CHAOS / flight-recorder knobs
         env = ({} if os.environ.get("JAX_PLATFORMS")
                else {"JAX_PLATFORMS": "cpu"})  # test daemons stay CPU
+        env.update(self._daemon_args.get("env") or {})  # cluster-wide
         env.update(extra)
         proc, addr = spawn_daemon(
             self.address,
@@ -133,6 +143,7 @@ class ProcessCluster:
             resources=resources or self._daemon_args["resources"],
             heartbeat_s=self._daemon_args["heartbeat_s"],
             tp_cpu_devices=self._daemon_args.get("tp_cpu_devices") or 0,
+            labels=labels,
             env_overrides=env)
         self.daemons.append({"proc": proc, "address": addr})
         return addr
@@ -202,9 +213,13 @@ class ProcessClusterNodeProvider:
             extra = {k: v for k, v in res.items()
                      if k not in ("CPU", "TPU")}
             with self._lock:
+                # The type label rides on the daemon so hazard journaling
+                # (distributed.begin_drain) and per-type rate estimation
+                # can attribute preemptions to the node type that had them.
                 addr = self._cluster.add_daemon(
                     num_cpus=cpus, resources=extra,
-                    num_tpus=res.get("TPU", 0))
+                    num_tpus=res.get("TPU", 0),
+                    labels={"autoscaler-node-type": node_type})
                 idx = next(i for i, d in enumerate(self._cluster.daemons)
                            if d["address"] == addr)
                 pid = f"proc-{node_type}-{_uuid.uuid4().hex[:6]}"

@@ -83,6 +83,11 @@ def collect(flight_dir: Optional[str] = None,
             except Exception as e:  # noqa: BLE001
                 out["errors"].append(f"drain: {e!r}")
                 cluster["drain"] = None
+            try:
+                cluster["preempt"] = _preempt_signals(head.state)
+            except Exception as e:  # noqa: BLE001
+                out["errors"].append(f"preempt: {e!r}")
+                cluster["preempt"] = None
             out["cluster"] = cluster
         finally:
             head.stop()
@@ -103,6 +108,27 @@ def _drain_progress(state) -> Dict[str, dict]:
         except (ValueError, UnicodeDecodeError):
             continue
     return progress
+
+
+def _preempt_signals(state) -> Dict[str, Any]:
+    """Preemption-plane health from the state KV (``preempt`` namespace,
+    autoscaler/hazard.py layout): per-node consecutive probe failures
+    published by each host daemon's watcher, and the hazard estimator's
+    last published fleet rate."""
+    from ray_tpu.autoscaler import hazard as _hazard
+    probes: Dict[str, int] = {}
+    for key in state.kv_keys(prefix=_hazard.PROBE_PREFIX,
+                             namespace=_hazard.NAMESPACE):
+        val = state.kv_get(key, namespace=_hazard.NAMESPACE)
+        if not val:
+            continue
+        try:
+            probes[key[len(_hazard.PROBE_PREFIX):].decode()] = int(
+                json.loads(val).get("failures") or 0)
+        except (ValueError, UnicodeDecodeError):
+            continue
+    return {"probe_failures": probes,
+            "fleet_rate_per_hour": _hazard.read_fleet_rate(state)}
 
 
 def _node_states(collected: dict) -> Dict[str, str]:
@@ -465,6 +491,16 @@ def diagnose(collected: dict, straggler_factor: float = 3.0,
                              for h in expected_hangs
                              if nid.startswith(h["node"])
                              or h["node"].startswith(nid[:8])]})
+    # A daemon whose preemption probe keeps failing is flying blind: the
+    # real eviction notice may never be seen, so the node would die with
+    # no drain at all.
+    from ray_tpu._private.config import _config
+    preempt = cluster.get("preempt") or {}
+    probe_threshold = _config.get("preempt_probe_failure_threshold")
+    probe_flags = [
+        {"node_id": nid, "consecutive_failures": n}
+        for nid, n in sorted((preempt.get("probe_failures") or {}).items())
+        if n >= probe_threshold]
     local = collected.get("local") or {}
     perf_section = _perf_reports(collected, baseline=perf_baseline)
     goodput_section = _goodput_reports(collected,
@@ -472,7 +508,7 @@ def diagnose(collected: dict, straggler_factor: float = 3.0,
     comms_section = _comms_reports(collected, baseline=comms_baseline,
                                    factor=straggler_factor)
     n_issues = (len(crashes) + len(hangs) + len(stragglers) +
-                len(missing) + len(dead_nodes) +
+                len(missing) + len(dead_nodes) + len(probe_flags) +
                 len(perf_section["drift"]) +
                 len(goodput_section["drift"]) +
                 len(comms_section["skew_flags"]) +
@@ -489,6 +525,8 @@ def diagnose(collected: dict, straggler_factor: float = 3.0,
         "hangs": hangs,
         "stragglers": stragglers,
         "unreachable_hosts": missing,
+        "preempt": preempt,
+        "probe_flags": probe_flags,
         "draining_nodes": draining,
         "drained_nodes": [{"node_id": n.get("node_id", ""),
                            "death_reason": n.get("death_reason", "")}
@@ -556,6 +594,15 @@ def render_text(report: dict) -> str:
                 lines.append(f"    in-flight: {name}")
             for tname in sorted(h.get("stacks") or {}):
                 lines.append(f"    stack sampled: thread {tname}")
+    probe_flags = report.get("probe_flags") or []
+    if probe_flags:
+        lines.append("")
+        lines.append(f"BLIND PREEMPTION WATCHERS ({len(probe_flags)})")
+        for p in probe_flags:
+            lines.append(
+                f"  node {p['node_id'][:8]}: "
+                f"{p['consecutive_failures']} consecutive preempt-probe "
+                "failures — an eviction notice may never be seen")
     draining = report.get("draining_nodes") or []
     if draining:
         lines.append("")

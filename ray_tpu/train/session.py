@@ -55,6 +55,19 @@ class _TrainSession:
         # inter-report interval — the user's step wall time — lands in
         # the train.step histogram.
         self._last_report_s = 0.0
+        # Risk-tuned cadence (checkpoint_frequency="auto"): the solver
+        # needs measured step/ckpt costs, so the session keeps its own
+        # report stamp (perf.ENABLED may be off) and gates engine saves
+        # on seq distance — a modulo check breaks when the interval is
+        # re-solved mid-run.
+        self._cadence = None
+        self._last_saved_seq: Optional[int] = None
+        self._cadence_stamp_s = 0.0
+        if (checkpoint_spec or {}).get("frequency") == "auto":
+            from ray_tpu.checkpoint import CadenceController
+            self._cadence = CadenceController(
+                restart_cost_s=float(
+                    checkpoint_spec.get("restart_cost_s") or 0.0))
 
     def _engine(self):
         if self.checkpoint_engine is None and self.checkpoint_spec:
@@ -69,16 +82,29 @@ class _TrainSession:
         returns once the device->host copy is queued; commit happens on the
         engine's writer thread."""
         self._ckpt_seq += 1
-        freq = max(1, int(self.checkpoint_spec.get("frequency") or 1))
-        if (self._ckpt_seq - 1) % freq != 0:
-            return
+        if self._cadence is not None:
+            # Auto cadence: save when the re-solved interval has elapsed
+            # since the last save (the first reported checkpoint always
+            # anchors — restore needs an early committed manifest).
+            interval = self._cadence.interval_steps()
+            if (self._last_saved_seq is not None
+                    and self._ckpt_seq - self._last_saved_seq < interval):
+                return
+        else:
+            freq = max(1, int(self.checkpoint_spec.get("frequency") or 1))
+            if (self._ckpt_seq - 1) % freq != 0:
+                return
+        self._last_saved_seq = self._ckpt_seq
         tree = checkpoint.to_dict() if hasattr(checkpoint, "to_dict") \
             else checkpoint
         token = self.checkpoint_spec.get("run_token", "run")
+        t0 = time.monotonic() if self._cadence is not None else 0.0
         self._engine().save(
             tree, step=self._ckpt_seq, rank=self.world_rank,
             world_size=self.world_size,
             save_key=f"{token}-{self._ckpt_seq:08d}")
+        if self._cadence is not None:
+            self._cadence.observe_ckpt(time.monotonic() - t0)
 
     def _close_engine(self, had_error: bool) -> None:
         eng = self.checkpoint_engine
@@ -130,6 +156,11 @@ def report(metrics: Dict[str, Any], checkpoint=None) -> None:
     t0 = time.monotonic() if perf.ENABLED else 0.0
     if t0 and s._last_report_s:
         perf.observe("train.step", (t0 - s._last_report_s) * 1e3)
+    if s._cadence is not None:
+        now_c = time.monotonic()
+        if s._cadence_stamp_s:
+            s._cadence.observe_step(now_c - s._cadence_stamp_s)
+        s._cadence_stamp_s = now_c
     if goodput.ENABLED:
         goodput.step_mark()
     if checkpoint is not None:

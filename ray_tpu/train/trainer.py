@@ -50,6 +50,9 @@ class JaxTrainer:
         # worker group and handed out via session.get_dataset_shard
         # (reference: DataParallelTrainer datasets= + DataConfig)
         self._datasets = datasets or {}
+        # Largest observed elastic-restart downtime (s); checkpoint specs
+        # carry it so the "auto" cadence solver prices failures correctly.
+        self._restart_cost_s = 0.0
 
     def _dataset_shards(self):
         if not self._datasets:
@@ -90,9 +93,13 @@ class JaxTrainer:
                                         checkpoint_spec=self._checkpoint_spec(
                                             engine_root))
                 if restart_t0 is not None:
+                    dt = time.monotonic() - restart_t0
+                    # Feeds the "auto" cadence solver on the NEXT spec:
+                    # a failure costs its restart, so pricier restarts
+                    # shift the optimum toward denser checkpoints.
+                    self._restart_cost_s = max(self._restart_cost_s, dt)
                     if goodput.ENABLED:
-                        goodput.account("restart_downtime",
-                                        time.monotonic() - restart_t0)
+                        goodput.account("restart_downtime", dt)
                     restart_t0 = None
                 while True:
                     round_results = executor.get_next_results()
@@ -151,11 +158,15 @@ class JaxTrainer:
         # retention (which keeps the newest commits) and the LATEST
         # fallback scan see one monotonic step sequence instead of a
         # post-crash counter reset shadowed by stale pre-crash manifests.
+        # frequency passes through verbatim — an int cadence, or "auto"
+        # for the risk-tuned Young–Daly solver (checkpoint/cadence.py);
+        # restart_cost_s feeds that solver's failure pricing.
         return {"root": engine_root,
                 "num_to_keep": cfg.num_to_keep,
                 "frequency": cfg.checkpoint_frequency,
                 "base_step": self._committed_step(engine_root),
-                "run_token": uuid.uuid4().hex[:8]}
+                "run_token": uuid.uuid4().hex[:8],
+                "restart_cost_s": self._restart_cost_s}
 
     def _committed_step(self, engine_root: str) -> int:
         from ray_tpu.checkpoint import (CheckpointError, read_manifest,

@@ -130,6 +130,20 @@ echo "== drain drill (preemption notice -> zero-loss workload migration) =="
 JAX_PLATFORMS=cpu \
 python -m pytest tests/test_drain.py -q
 
+echo "== preemption-storm gate (fleet churn: predictive drains + gang replacement) =="
+# Elastic preemptible-fleet gate: a deterministic node.preempt storm
+# (preempt_storm_spec) churns a live ProcessCluster while the autoscaler
+# proactively drains and gang-replaces nodes and an elastic train job
+# rides the churn on auto (risk-tuned) checkpoint cadence. Asserts >= 2
+# real preemptions, zero task loss, strictly-increasing checkpoint steps
+# across restores, merged fleet goodput above the floor, and a passing
+# doctor --goodput-baseline. The storm drill is tier-2 (slow marker) and
+# self-skips where the C++ state service can't build; the fast layer
+# (hazard math, cadence solver, drain-aware scale-down, probe backoff)
+# runs everywhere.
+JAX_PLATFORMS=cpu \
+python -m pytest tests/test_churn.py -q
+
 echo "== bench regression gate (bench_micro --check vs tracked baseline) =="
 # Throughput must stay within --tolerance of BENCH_MICRO.json; latency
 # (_us) metrics are inverted. Cluster metrics are skipped automatically

@@ -1,0 +1,563 @@
+"""Elastic preemptible-fleet orchestration: predictive drains, risk-tuned
+checkpoint cadence, and gang replacement.
+
+Three layers:
+
+- unit coverage that runs everywhere: the hazard math (decayed rates,
+  probe penalties, window pruning), the Young–Daly cadence solver and its
+  re-tuning controller, the session's distance-gated "auto" save path,
+  drain-aware load metrics / scale-down, pending-drain last-choice
+  placement, preempt-probe backoff, the storm-spec grammar helper, and
+  doctor blind-watcher triage;
+- in-process integration: a seeded hazard estimator drives one proactive
+  drain and its same-type gang replacement through a full
+  ``StandardAutoscaler.update`` pass;
+- the ProcessCluster fleet-churn drill (slow; run by the
+  run_sanitizers.sh preemption-storm gate): a seeded ``node.preempt``
+  storm cycles real daemons while an elastic train job checkpoints on
+  auto cadence — zero task loss, monotone checkpoint steps, journaled
+  preemptions feeding proactive drains and replacements, and the merged
+  goodput gate holding above its floor.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import _config
+from ray_tpu.autoscaler import (AutoscalerConfig, FakeNodeProvider,
+                                HazardEstimator, StandardAutoscaler)
+from ray_tpu.autoscaler import hazard
+from ray_tpu.checkpoint import CadenceController, solve_interval_steps
+from ray_tpu.cluster_utils import ProcessCluster
+
+
+def _require_state_service():
+    """ProcessCluster needs the C++ state service (protoc + g++)."""
+    from ray_tpu._native.build import build_state_service
+    try:
+        build_state_service()
+    except Exception as e:
+        pytest.skip(f"state service unavailable: {e}")
+
+
+# -- unit: hazard math -------------------------------------------------------
+
+def test_decayed_rate_monotone_in_count_and_freshness():
+    h, w = 900.0, 3600.0
+    one_fresh = hazard.decayed_rate_per_hour([0.0], h, w)
+    # one fresh event at halflife h reads ~3600*ln2/h events/hour
+    assert one_fresh == pytest.approx(3600.0 * 0.6931 / h, rel=1e-3)
+    assert hazard.decayed_rate_per_hour([0.0, 0.0], h, w) > one_fresh
+    assert hazard.decayed_rate_per_hour([600.0], h, w) < one_fresh
+    # events past the window (or from the future) contribute nothing
+    assert hazard.decayed_rate_per_hour([w + 1.0, -5.0], h, w) == 0.0
+
+
+def test_node_hazard_probe_penalty():
+    base = hazard.node_hazard_score(3.0, probe_failures=0, probe_weight=2.0)
+    blind = hazard.node_hazard_score(3.0, probe_failures=4, probe_weight=2.0)
+    assert base == pytest.approx(3.0)
+    assert blind == pytest.approx(3.0 + 8.0)
+    # negative failure counts never LOWER the score
+    assert hazard.node_hazard_score(3.0, -2, 2.0) == pytest.approx(3.0)
+
+
+def test_estimator_prunes_events_past_window():
+    est = HazardEstimator()
+    now = 1_000_000.0
+    est.record("tpu-v5e", "aa" * 16, ts=now - 10.0)
+    est.record("tpu-v5e", "bb" * 16, ts=now - _config.get("hazard_window_s")
+               - 100.0)  # stale: outside the window
+    est.refresh(now=now)
+    assert len(est._events) == 1
+    assert est.type_rate("tpu-v5e", now=now) > 0.0
+    assert est.type_rate("other-type", now=now) == 0.0
+    # node hazard folds the probe penalty on top of the type rate
+    est._probe_failures["aa" * 16] = 3
+    assert est.node_hazard("tpu-v5e", "aa" * 16, now=now) > \
+        est.node_hazard("tpu-v5e", "cc" * 16, now=now)
+
+
+def test_fleet_rate_floor_applies_to_cold_fleet():
+    est = HazardEstimator()
+    floor_was = _config.get("hazard_rate_floor_per_hour")
+    _config.set("hazard_rate_floor_per_hour", 1.5)
+    try:
+        assert est.fleet_rate(now=0.0) == pytest.approx(1.5)
+    finally:
+        _config.set("hazard_rate_floor_per_hour", floor_was)
+
+
+# -- unit: cadence solver ----------------------------------------------------
+
+def test_cadence_risk_up_means_denser_checkpoints():
+    """ISSUE contract "risk up => cadence up": a hotter fleet checkpoints
+    MORE often, i.e. fewer steps between checkpoints."""
+    calm = solve_interval_steps(1.0, 1.0, 0.5, min_steps=1, max_steps=1000)
+    hot = solve_interval_steps(10.0, 1.0, 0.5, min_steps=1, max_steps=1000)
+    assert hot < calm
+
+
+def test_cadence_step_cost_up_means_fewer_steps_per_interval():
+    """"step-cost up => cadence down" in steps: the same optimal wall
+    interval spans fewer (slower) steps."""
+    fast = solve_interval_steps(10.0, 1.0, 0.5, min_steps=1, max_steps=1000)
+    slow = solve_interval_steps(10.0, 5.0, 0.5, min_steps=1, max_steps=1000)
+    assert slow < fast
+
+
+def test_cadence_ckpt_cost_and_restart_cost_shift_the_optimum():
+    cheap = solve_interval_steps(10.0, 1.0, 0.5, min_steps=1, max_steps=1000)
+    pricey = solve_interval_steps(10.0, 1.0, 5.0, min_steps=1, max_steps=1000)
+    assert pricey > cheap  # costly checkpoints => stretch the interval
+    # a costly restart eats into the useful MTBF => checkpoint sooner
+    slow_restart = solve_interval_steps(10.0, 1.0, 0.5, restart_cost_s=300.0,
+                                        min_steps=1, max_steps=1000)
+    assert slow_restart < cheap
+
+
+def test_cadence_degenerate_inputs_hit_the_ceiling_and_clamps():
+    assert solve_interval_steps(0.0, 1.0, 0.5, min_steps=1,
+                                max_steps=77) == 77
+    assert solve_interval_steps(5.0, 0.0, 0.5, min_steps=1,
+                                max_steps=77) == 77
+    # clamped to [min, max] whatever the math says
+    assert solve_interval_steps(10_000.0, 10.0, 1e-9, min_steps=4,
+                                max_steps=77) == 4
+    assert solve_interval_steps(1e-9, 1e-3, 100.0, min_steps=4,
+                                max_steps=77) == 77
+
+
+def test_cadence_controller_retunes_when_hazard_changes():
+    """The drill's mid-run contract in miniature: the controller re-solves
+    every refresh window, so a hazard jump visibly shrinks the interval."""
+    rate = {"v": 1.0}
+    ctl = CadenceController(hazard_source=lambda: rate["v"], refresh_steps=4,
+                            min_steps=1, max_steps=1000)
+    for _ in range(4):
+        ctl.observe_step(1.0)
+    ctl.observe_ckpt(0.5)
+    calm = ctl.interval_steps()
+    assert ctl.last_hazard_per_hour == pytest.approx(1.0)
+    # inside the refresh window the cached interval holds
+    rate["v"] = 50.0
+    ctl.observe_step(1.0)
+    assert ctl.interval_steps() == calm
+    # once the window elapses the new hazard re-tunes the cadence
+    for _ in range(4):
+        ctl.observe_step(1.0)
+    hot = ctl.interval_steps()
+    assert hot < calm
+    assert ctl.last_hazard_per_hour == pytest.approx(50.0)
+
+
+# -- unit: session "auto" save gating ---------------------------------------
+
+class _FixedCadence:
+    def __init__(self, interval):
+        self.interval = interval
+        self.ckpt_obs = 0
+
+    def interval_steps(self):
+        return self.interval
+
+    def observe_ckpt(self, seconds):
+        self.ckpt_obs += 1
+
+
+class _RecordingEngine:
+    def __init__(self):
+        self.steps = []
+
+    def save(self, tree, step, rank, world_size, save_key):
+        self.steps.append(step)
+
+
+def test_session_auto_frequency_gates_saves_by_distance():
+    """frequency="auto" gates engine saves on seq distance from the last
+    save (modulo breaks when the interval re-solves mid-run); the first
+    reported checkpoint always anchors."""
+    from ray_tpu.train.session import _TrainSession
+    s = _TrainSession(world_rank=0, world_size=1,
+                      checkpoint_spec={"root": "/tmp/unused",
+                                       "frequency": "auto",
+                                       "run_token": "t"})
+    assert s._cadence is not None  # "auto" spec builds a controller
+    s._cadence = _FixedCadence(3)
+    s.checkpoint_engine = eng = _RecordingEngine()
+    for _ in range(9):
+        s._engine_save({"x": 1})
+    assert eng.steps == [1, 4, 7]
+    assert s._cadence.ckpt_obs == 3  # each real save feeds the EWMA
+
+
+def test_session_int_frequency_path_unchanged():
+    from ray_tpu.train.session import _TrainSession
+    s = _TrainSession(world_rank=0, world_size=1,
+                      checkpoint_spec={"root": "/tmp/unused", "frequency": 2,
+                                       "run_token": "t"})
+    assert s._cadence is None
+    s.checkpoint_engine = eng = _RecordingEngine()
+    for _ in range(6):
+        s._engine_save({"x": 1})
+    assert eng.steps == [1, 3, 5]
+
+
+# -- unit: drain-aware load metrics & scale-down -----------------------------
+
+@pytest.fixture
+def small_cluster():
+    ray_tpu.shutdown()
+    w = ray_tpu.init(num_cpus=1)
+    yield w
+    ray_tpu.shutdown()
+
+
+def test_load_metrics_hide_draining_capacity(small_cluster):
+    from ray_tpu._private.resources import ResourceSet
+    from ray_tpu.autoscaler.autoscaler import LoadMetrics
+    rt = small_cluster.runtime
+    node = rt.add_node(ResourceSet({"CPU": 4.0}))
+    lm = LoadMetrics(rt)
+    assert node.node_id.hex() in lm.node_utilization()
+    node.draining = True
+    rt._kick()
+    # a quiesced draining node LOOKS idle — it must vanish from the
+    # utilization view (else scale-down terminates it mid-drain and
+    # bin-packing counts capacity that is about to leave)...
+    assert node.node_id.hex() not in lm.node_utilization()
+    # ...but stays visible to the lifecycle scan gang replacement uses
+    assert lm.lifecycle()[node.node_id.hex()]["draining"] is True
+
+
+def test_scale_down_never_terminates_a_draining_node(small_cluster):
+    rt = small_cluster.runtime
+    provider = FakeNodeProvider(rt, {"cpu-4": {"CPU": 4}})
+    autoscaler = StandardAutoscaler(
+        AutoscalerConfig(node_types={"cpu-4": {"CPU": 4}}, max_workers=3,
+                         idle_timeout_s=0.1), provider, rt)
+    draining_pid, victim_pid = provider.create_node("cpu-4", 2)
+    draining_node = provider._nodes[draining_pid]
+    draining_node.draining = True
+    rt._kick()
+    autoscaler._replaced.add(draining_pid)  # isolate from gang replacement
+    autoscaler.update()                     # records idle-since
+    time.sleep(0.15)
+    autoscaler.update()
+    # the idle node went; the (equally quiet) draining node survived
+    assert victim_pid not in provider.non_terminated_nodes()
+    assert draining_pid in provider.non_terminated_nodes()
+    assert draining_node.alive and draining_node.draining
+
+
+# -- unit: pending-drain last-choice placement -------------------------------
+
+def _node_state(tag, pending=False, draining=False):
+    from ray_tpu._private.ids import NodeID
+    from ray_tpu._private.resources import NodeResources, ResourceSet
+    from ray_tpu._private.scheduler import NodeState
+    nr = NodeResources(ResourceSet({"CPU": 4.0}))
+    return NodeState(NodeID(bytes([tag]) * 16), nr, True,
+                     draining=draining, pending_drain=pending)
+
+
+def test_pending_drain_is_last_choice_not_excluded():
+    from ray_tpu._private.resources import ResourceSet
+    from ray_tpu._private.scheduler import HybridPolicy, SpreadPolicy
+    req = ResourceSet({"CPU": 1.0})
+    stable, risky = _node_state(1), _node_state(2, pending=True)
+    for _ in range(8):
+        assert HybridPolicy(seed=0).select([risky, stable],
+                                           req) == stable.node_id
+        assert SpreadPolicy().select([risky, stable], req) == stable.node_id
+    # unlike DRAINING, a pending-drain node still schedules when it is
+    # the only option — it is a hint, not a lifecycle state
+    assert HybridPolicy(seed=0).select([risky], req) == risky.node_id
+    assert SpreadPolicy().select([risky], req) == risky.node_id
+
+
+def test_runtime_pending_drain_hint_roundtrip(small_cluster):
+    rt = small_cluster.runtime
+    nid = rt.node_states()[0].node_id.hex()
+    rt.set_pending_drain(nid, True)
+    (ns,) = [s for s in rt.node_states() if s.node_id.hex() == nid]
+    assert ns.pending_drain and ns.schedulable
+    rt.set_pending_drain(nid, False)
+    (ns,) = [s for s in rt.node_states() if s.node_id.hex() == nid]
+    assert not ns.pending_drain
+
+
+# -- unit: preempt-probe backoff ---------------------------------------------
+
+def test_probe_state_backoff_paces_and_resets():
+    from ray_tpu._private.host_daemon import _ProbeState
+    p = _ProbeState(runtime=None)
+    now = 100.0
+    assert not p.throttled(now)
+    p.failure(now)
+    assert p.failures == 1 and p.throttled(now + 0.01)
+    gap1 = p._not_before - now
+    t2 = p._not_before
+    p.failure(t2)
+    gap2 = p._not_before - t2
+    assert p.failures == 2 and gap2 >= gap1  # deterministic growth
+    # paces from the poll period up to the shared backoff cap
+    assert gap1 >= _config.get("preempt_poll_ms") / 1e3 - 1e-9
+    p.success(1e9)
+    assert p.failures == 0 and not p.throttled(1e9)
+
+
+def test_preempt_signaled_backs_off_failing_probe():
+    from ray_tpu._private.host_daemon import _ProbeState, _preempt_signaled
+    url_was = _config.get("preempt_probe_url")
+    _config.set("preempt_probe_url", "http://127.0.0.1:9/preempted")
+    try:
+        probe = _ProbeState(runtime=None)
+        assert _preempt_signaled("unit00", probe=probe) is None
+        assert probe.failures == 1
+        # the immediate next poll is throttled: no second connect attempt
+        assert _preempt_signaled("unit00", probe=probe) is None
+        assert probe.failures == 1
+    finally:
+        _config.set("preempt_probe_url", url_was)
+
+
+def test_doctor_flags_blind_preemption_watcher():
+    from ray_tpu import doctor
+    nid = "ab" * 16
+    threshold = _config.get("preempt_probe_failure_threshold")
+    collected = {
+        "ts": 1.0, "errors": [], "sealed_now": [],
+        "local": {"root": "/tmp/x", "recordings": [], "bundles": []},
+        "cluster": {
+            "nodes": {"nodes": []},
+            "preempt": {"probe_failures": {nid: threshold,
+                                           "cd" * 16: threshold - 1},
+                        "fleet_rate_per_hour": 2.5},
+        },
+    }
+    rep = doctor.diagnose(collected)
+    (flag,) = rep["probe_flags"]          # only the node AT threshold
+    assert flag["node_id"] == nid
+    assert flag["consecutive_failures"] == threshold
+    assert rep["num_issues"] >= 1
+    text = doctor.render_text(rep)
+    assert "BLIND PREEMPTION WATCHERS (1)" in text
+
+
+# -- unit: storm grammar helper ----------------------------------------------
+
+def test_preempt_storm_spec_grammar():
+    from ray_tpu import chaos
+    # 720/hour at a 500ms poll => a notice every 10th poll
+    spec = chaos.preempt_storm_spec(720.0, 500.0)
+    assert spec == "node.preempt@10%10=drop"
+    sched = chaos.parse_spec(3, spec)
+    fired = [i + 1 for i in range(35)
+             if sched.fire("node.preempt", {"node": "x"}) == "drop"]
+    assert fired == [10, 20, 30]
+    assert "[node=w1]" in chaos.preempt_storm_spec(720.0, 500.0, node="w1")
+    with pytest.raises(ValueError):
+        chaos.preempt_storm_spec(0.0, 500.0)
+
+
+# -- integration: proactive drain + gang replacement (in-process) ------------
+
+def test_proactive_drain_and_gang_replacement(small_cluster):
+    rt = small_cluster.runtime
+    provider = FakeNodeProvider(rt, {"cpu-2": {"CPU": 2}})
+    est = HazardEstimator()
+    # three fresh journaled preemptions of this type push its rate past
+    # hazard_drain_threshold (3 * 3600*ln2/900 ~ 8.3 >= 6.0)
+    for _ in range(3):
+        est.record("cpu-2", "ee" * 16)
+    autoscaler = StandardAutoscaler(
+        AutoscalerConfig(node_types={"cpu-2": {"CPU": 2}}, max_workers=4,
+                         idle_timeout_s=3600), provider, rt, hazard=est)
+    pid_a, pid_b = provider.create_node("cpu-2", 2)
+    result = autoscaler.update()
+    # exactly ONE node proactively drained (worst-first, not the fleet),
+    # and its same-type replacement launched in the same pass
+    assert result["proactively_drained"] == 1
+    assert result["replaced"] == 1
+    draining = [provider._nodes[p] for p in (pid_a, pid_b)
+                if provider._nodes[p].draining]
+    assert len(draining) == 1
+    assert len(provider.non_terminated_nodes()) == 3
+    # the surviving high-hazard node carries the last-choice hint
+    survivor = next(provider._nodes[p] for p in (pid_a, pid_b)
+                    if not provider._nodes[p].draining)
+    assert survivor.pending_drain
+    # the in-flight drain gates further proactive drains (no cascade),
+    # and the replacement is not replaced again
+    result2 = autoscaler.update()
+    assert result2["proactively_drained"] == 0
+    assert result2["replaced"] == 0
+    assert autoscaler.num_proactive_drains == 1
+    assert autoscaler.num_replacements == 1
+
+
+def test_journal_roundtrip_feeds_estimator(tmp_path):
+    """journal_preemption -> KV -> refresh() -> type_rate, including GC of
+    events past the window — against a dict-backed fake state client."""
+
+    class FakeState:
+        def __init__(self):
+            self.kv = {}
+
+        def kv_put(self, key, value, namespace=b""):
+            self.kv[(namespace, bytes(key))] = bytes(value)
+
+        def kv_get(self, key, namespace=b""):
+            return self.kv.get((namespace, bytes(key)))
+
+        def kv_del(self, key, namespace=b""):
+            self.kv.pop((namespace, bytes(key)), None)
+
+        def kv_keys(self, prefix=b"", namespace=b""):
+            return [k for (ns, k) in self.kv
+                    if ns == namespace and k.startswith(prefix)]
+
+    state = FakeState()
+    now = time.time()
+    hazard.journal_preemption(state, "aa" * 16, "tpu-v5e",
+                              "preemption notice (chaos)", ts=now - 5.0)
+    hazard.journal_preemption(state, "bb" * 16, "tpu-v5e",
+                              "preemption notice (chaos)",
+                              ts=now - _config.get("hazard_window_s") - 60.0)
+    hazard.publish_probe_health(state, "aa" * 16, 4)
+    est = HazardEstimator(state)
+    est.refresh(now=now)
+    assert est.type_rate("tpu-v5e", now=now) > 0.0
+    # the stale event was GC'd out of the KV, not just skipped
+    assert len([k for k in state.kv if k[1].startswith(b"event:")]) == 1
+    assert est._probe_failures["aa" * 16] == 4
+    # publish + read back the fleet rate the cadence solver consumes
+    rate = est.publish_fleet_rate(now=now)
+    assert hazard.read_fleet_rate(state) == pytest.approx(rate)
+
+
+# -- ProcessCluster fleet-churn drill ----------------------------------------
+
+@pytest.mark.slow
+def test_fleet_churn_storm_drill(tmp_path):
+    """The gated goodput-under-churn drill (run_sanitizers.sh): a seeded
+    node.preempt storm cycles every worker daemon (~every 10s of life)
+    while the autoscaler journals the notices, proactively drains, and
+    gang-replaces — and an elastic train job on auto cadence rides the
+    churn to completion with monotone committed checkpoint steps."""
+    from ray_tpu import chaos, doctor
+    from ray_tpu.air.config import (CheckpointConfig, FailureConfig,
+                                    RunConfig, ScalingConfig)
+    from ray_tpu.dashboard.head import DashboardHead
+    from ray_tpu.observability import goodput
+    from ray_tpu.train import JaxTrainer, session
+    _require_state_service()
+    ray_tpu.shutdown()
+    # one notice every 20th watcher poll (~10s at the 500ms default) on
+    # every worker daemon, replacements included (daemon_env rides along)
+    spec = chaos.preempt_storm_spec(360.0, 500.0)
+    assert spec == "node.preempt@20%20=drop"
+    c = ProcessCluster(num_daemons=0, num_cpus=2,
+                       daemon_env={"RAY_TPU_CHAOS": f"11:{spec}",
+                                   "RAY_TPU_PREEMPT_LEAD_S": "20"})
+    provider = c.node_provider({"worker": {"CPU": 2}})
+    provider.create_node("worker", 2)
+    autoscaler = None
+    try:
+        ray_tpu.init(address=c.address)
+        rt = ray_tpu._private.worker.global_worker().runtime
+        autoscaler = StandardAutoscaler(
+            AutoscalerConfig(node_types={"worker": {"CPU": 2}},
+                             max_workers=4, idle_timeout_s=3600,
+                             update_interval_s=0.5), provider, rt)
+        autoscaler.start()
+
+        # -- phase 1: task plane under churn — zero loss ------------------
+        @ray_tpu.remote(max_retries=5)
+        def slow(i):
+            time.sleep(0.3)
+            return i
+
+        refs = [slow.remote(i) for i in range(40)]
+        assert sorted(ray_tpu.get(refs, timeout=240)) == list(range(40)), \
+            "tasks lost to the preemption storm"
+
+        # -- phase 2: the storm was journaled and acted on ----------------
+        deadline = time.monotonic() + 120
+        events = []
+        while time.monotonic() < deadline:
+            events = [k for k in rt.state.kv_keys(
+                prefix=hazard.EVENT_PREFIX, namespace=hazard.NAMESPACE)]
+            if len(events) >= 2 and autoscaler.num_replacements >= 1:
+                break
+            time.sleep(1.0)
+        assert len(events) >= 2, "storm preemptions never journaled"
+        assert autoscaler.num_replacements >= 1, \
+            "no gang replacement launched"
+        fleet_rate = hazard.read_fleet_rate(rt.state)
+        assert fleet_rate is not None and fleet_rate > 0.0, \
+            "hazard estimator never published a fleet rate"
+
+        # -- phase 3: elastic train job, auto cadence ---------------------
+        def loop(config):
+            from ray_tpu.air.checkpoint import Checkpoint
+            start = 0
+            ckpt = session.get_checkpoint()
+            if ckpt is not None:
+                start = ckpt.to_dict().get("step", 0)
+            for step in range(start, 30):
+                time.sleep(0.05)
+                session.report({"step": step},
+                               checkpoint=Checkpoint.from_dict(
+                                   {"step": step + 1}))
+
+        trainer = JaxTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(
+                name="churn", storage_path=str(tmp_path),
+                failure_config=FailureConfig(max_failures=-1),
+                checkpoint_config=CheckpointConfig(
+                    checkpoint_frequency="auto")),
+            collective_backend=None)
+        result = trainer.fit()
+        assert result.error is None, result.error
+        assert result.metrics.get("step") == 29
+
+        # monotone committed checkpoint steps: the auto cadence + carried
+        # base_step never let a post-restart counter shadow older commits
+        from ray_tpu.checkpoint import list_manifest_names, read_manifest
+        root = os.path.join(str(tmp_path), "churn", "checkpoints")
+        steps = [read_manifest(root, n).step
+                 for n in list_manifest_names(root)]
+        assert steps, "auto cadence committed no checkpoints"
+        assert steps == sorted(steps) and len(set(steps)) == len(steps), \
+            f"checkpoint steps not monotone: {steps}"
+
+        # -- phase 4: merged goodput gate above the floor -----------------
+        head = DashboardHead(c.address)
+        try:
+            merged = head._goodput()["jobs"].get(goodput.DEFAULT_JOB)
+            assert merged is not None, "no goodput ledger federated"
+            assert merged["goodput_pct"] > 1.0, merged
+            snaps, _missing = head._metric_snapshots()
+            collected = {"ts": time.time(), "errors": [],
+                         "cluster": {"metrics": {"snapshots": snaps}}}
+            report = doctor.diagnose(
+                collected,
+                goodput_baseline={goodput.DEFAULT_JOB:
+                                  {"goodput_pct": 1.0, "tolerance": 1.0}})
+            assert report["goodput"]["drift"] == [], \
+                report["goodput"]["drift"]
+        finally:
+            head.stop()
+    finally:
+        if autoscaler is not None:
+            autoscaler.stop()
+        ray_tpu.shutdown()
+        c.shutdown()
