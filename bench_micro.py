@@ -393,16 +393,19 @@ def bench_comms(prefix: str):
     big = np.ones(1 << 20, np.float32)        # 4 MiB per rank
     tiny = np.ones(8, np.float32)
 
-    def rounds(n, gname, arr):
+    def rounds(n, gname, arr, config=None, out=None):
         errs = []
 
         def worker(rank):
             try:
                 if not col.is_group_initialized(gname):
                     col.init_collective_group(2, rank, backend="cpu",
-                                              group_name=gname)
+                                              group_name=gname,
+                                              config=config)
                 for _ in range(n):
-                    col.allreduce(arr, gname)
+                    res = col.allreduce(arr, gname)
+                if out is not None and rank == 0:
+                    out.append(res)
             except Exception as e:  # noqa: BLE001 — surfaced below
                 errs.append(e)
 
@@ -423,6 +426,30 @@ def bench_comms(prefix: str):
     big_us = rounds(16, "bench_comms", big)
     rec = comms.snapshot()["groups"]["bench_comms"]["ops"]["allreduce"]
     emit(f"{prefix}_allreduce_f32_gbps", rec["algbw_gbps"], "GB/s")
+
+    # Quantized tier (ROADMAP item 3): the same two-rank drill on a q8
+    # group.  The gbps row is LOGICAL bytes/sec — compression only pays
+    # off if shipping ~0.27x the bytes makes the op *faster* than the
+    # f32 floor on the same logical tensor (check_against also gates the
+    # q8 row against the f32 baseline cross-metric).  The wire-ratio and
+    # round-trip-error rows are the honesty companions: ledger-verified
+    # compression and a gated accuracy ceiling, so a quant-kernel
+    # regression cannot buy speed with silent error.
+    from ray_tpu.collective.types import CollectiveConfig
+    qcfg = CollectiveConfig(compression="q8", quant_block_bytes=256)
+    qarr = np.random.default_rng(7).standard_normal(1 << 20) \
+        .astype(np.float32)
+    qout = []
+    rounds(4, "bench_comms_q8", qarr, config=qcfg)        # warm
+    comms.reset()
+    rounds(16, "bench_comms_q8", qarr, config=qcfg, out=qout)
+    qrec = comms.snapshot()["groups"]["bench_comms_q8"]["ops"]["allreduce"]
+    emit(f"{prefix}_allreduce_q8_gbps", qrec["logical_gbps"], "GB/s")
+    emit("allreduce_q8_wire_ratio", qrec["compression_ratio"], "x")
+    ref = qarr * 2.0
+    emit("quant_allreduce_rel_err",
+         float(np.abs(np.asarray(qout[-1]) - ref).mean()
+               / np.abs(ref).mean()), "x")
 
     # Best-of-N on each side: runtime background threads (heartbeats,
     # samplers) only ever inflate a sample, so the min of each side
@@ -865,6 +892,13 @@ def check_against(baseline_path: str, tolerance: float) -> int:
             # overhead budget
             ok = got >= base * tolerance
             bound = f">= {base * tolerance:.2f}"
+        elif metric.endswith(("_ratio", "_rel_err")):
+            # deterministic budget ceilings (compression ratio, quant
+            # round-trip error): the baseline IS the bound, untoleranced
+            # — these rows are not timing-noisy, so slack would only
+            # let a quant regression buy speed with silent error
+            ok = got <= base
+            bound = f"<= {base:.4f}"
         elif metric.endswith(("_us", "_ms", "_pct")):
             ok = got <= base / tolerance
             bound = f"<= {base / tolerance:.2f}"
@@ -876,6 +910,20 @@ def check_against(baseline_path: str, tolerance: float) -> int:
               f"(need {bound}) {status}", flush=True)
         if not ok:
             failures.append(metric)
+    # Cross-metric rule: the quantized tier must beat the *f32 floor* on
+    # logical bytes/sec, not merely its own past self — a q8 path slower
+    # than uncompressed f32 is a pure accuracy loss and must fail the
+    # gate even if the q8 baseline row drifted down with it.
+    q8 = measured.get("inproc_allreduce_q8_gbps")
+    f32_floor = baseline.get("inproc_allreduce_f32_gbps")
+    if q8 is not None and f32_floor and f32_floor > 0:
+        ok = q8 >= f32_floor * tolerance
+        status = "ok" if ok else "REGRESSION"
+        print(f"[check] inproc_allreduce_q8_gbps: {q8:.2f} vs f32 floor "
+              f"{f32_floor:.2f} (need >= {f32_floor * tolerance:.2f}) "
+              f"{status}", flush=True)
+        if not ok:
+            failures.append("inproc_allreduce_q8_gbps_vs_f32_floor")
     if failures:
         print(f"[check] {len(failures)} regression(s): "
               f"{', '.join(failures)}", flush=True)

@@ -184,10 +184,10 @@ def _inject_builtin_methods(cls: type) -> type:
         cls.__ray_ready__ = lambda self: True
     if not hasattr(cls, "__ray_collective_init__"):
         def _collective_init(self, world_size, rank, backend, group_name,
-                             devices=None):
+                             devices=None, config=None):
             from ray_tpu.collective import init_collective_group
             init_collective_group(world_size, rank, backend, group_name,
-                                  devices)
+                                  devices, config)
             return rank
         cls.__ray_collective_init__ = _collective_init
     if not hasattr(cls, "__ray_terminate__"):

@@ -61,6 +61,13 @@ collective.op         group, op, rank — collective API entry
                       rank-filtered "delay" makes that rank arrive late
                       at the rendezvous, which the comms plane's
                       arrival-skew attribution must name
+collective.quant      group, op, rank — compression tier
+                      (collective/quantization.py), before one rank
+                      block-quantizes its payload; "error" makes a
+                      quantized op fail loudly (the rendezvous propagates
+                      it to every rank) and a rank-filtered "delay"
+                      stretches exactly the compression step, which the
+                      ``collective.quantize`` perf histogram must show
 ====================  =====================================================
 """
 

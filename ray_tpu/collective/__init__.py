@@ -5,12 +5,12 @@ from ray_tpu.collective.collective import (allgather, allreduce, barrier,
                                            get_rank, init_collective_group,
                                            is_group_initialized, recv, reduce,
                                            reducescatter, send, synchronize)
-from ray_tpu.collective.types import Backend, ReduceOp
+from ray_tpu.collective.types import Backend, CollectiveConfig, ReduceOp
 
 __all__ = [
     "init_collective_group", "create_collective_group",
     "destroy_collective_group", "is_group_initialized", "get_rank",
     "get_collective_group_size", "allreduce", "allgather", "reducescatter",
     "broadcast", "reduce", "send", "recv", "barrier", "synchronize",
-    "Backend", "ReduceOp",
+    "Backend", "CollectiveConfig", "ReduceOp",
 ]

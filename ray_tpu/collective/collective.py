@@ -20,7 +20,7 @@ from ray_tpu.collective.collective_group.cpu_group import (CPUGroup,
                                                            CPUGroupShared)
 from ray_tpu.collective.collective_group.xla_group import (XLAGroup,
                                                            XLAGroupShared)
-from ray_tpu.collective.types import Backend, ReduceOp
+from ray_tpu.collective.types import Backend, CollectiveConfig, ReduceOp
 
 _registry_lock = threading.Lock()
 _shared_groups: Dict[str, Any] = {}        # group_name -> Shared state  # raylint: guarded-by(_registry_lock)
@@ -51,10 +51,24 @@ class GroupManager:
             _local_groups.groups = {}
         return _local_groups.groups
 
+    @staticmethod
+    def _resolve_config(config: Optional[CollectiveConfig]) -> CollectiveConfig:
+        """Explicit config wins; otherwise the process-wide knobs decide
+        (``collective_compression`` / ``quant_block_bytes``), so a whole
+        deployment can flip to q8 wire without touching call sites."""
+        if config is not None:
+            return config
+        from ray_tpu._private.config import _config
+        return CollectiveConfig(
+            compression=str(_config.get("collective_compression")),
+            quant_block_bytes=int(_config.get("quant_block_bytes")))
+
     @classmethod
     def create_group(cls, backend: str, world_size: int, rank: int,
-                     group_name: str, devices: Optional[List] = None):
+                     group_name: str, devices: Optional[List] = None,
+                     config: Optional[CollectiveConfig] = None):
         backend = Backend(backend)
+        config = cls._resolve_config(config)
         if backend == Backend.XLA and devices is None and _spans_processes():
             # Rank-per-process group: ranks live in different daemon
             # processes, rendezvous through the state-service KV and the
@@ -73,7 +87,7 @@ class GroupManager:
                         f"one worker per host daemon, or pass devices= "
                         f"for an intra-process group.")
                 _process_joined.add(group_name)
-            g = XLAProcessGroup(world_size, rank, group_name)
+            g = XLAProcessGroup(world_size, rank, group_name, config=config)
             cls._groups()[group_name] = g
             return g
         with _registry_lock:
@@ -101,7 +115,7 @@ class GroupManager:
                         f"{existing_backend!r}, requested {backend!r}")
             shared.join_count += 1
         group_cls = XLAGroup if isinstance(shared, XLAGroupShared) else CPUGroup
-        g = group_cls(world_size, rank, group_name, shared)
+        g = group_cls(world_size, rank, group_name, shared, config=config)
         cls._groups()[group_name] = g
         return g
 
@@ -131,27 +145,29 @@ def is_group_initialized(group_name: str = "default") -> bool:
 
 def init_collective_group(world_size: int, rank: int, backend: str = "xla",
                           group_name: str = "default",
-                          devices: Optional[List] = None):
+                          devices: Optional[List] = None,
+                          config: Optional[CollectiveConfig] = None):
     """Join a collective group from inside an actor/task (collective.py:120)."""
     if world_size <= 0 or not (0 <= rank < world_size):
         raise ValueError(f"invalid world_size={world_size} rank={rank}")
     if is_group_initialized(group_name):
         raise RuntimeError(f"group {group_name!r} already initialized here")
     return GroupManager.create_group(backend, world_size, rank, group_name,
-                                     devices)
+                                     devices, config)
 
 
 def create_collective_group(actors: List, world_size: int,
                             ranks: List[int], backend: str = "xla",
                             group_name: str = "default",
-                            devices: Optional[List] = None):
+                            devices: Optional[List] = None,
+                            config: Optional[CollectiveConfig] = None):
     """Driver-side declarative setup (collective.py:151-212): instructs each
     actor to join the group with its assigned rank."""
     from ray_tpu._private import worker as _worker
     if len(actors) != world_size or sorted(ranks) != list(range(world_size)):
         raise ValueError("actors/ranks must cover 0..world_size-1")
     refs = [actor.__ray_collective_init__.remote(world_size, rank, backend,
-                                                 group_name, devices)
+                                                 group_name, devices, config)
             for actor, rank in zip(actors, ranks)]
     return _worker.get(refs)
 
@@ -244,8 +260,14 @@ def _collective_wait(fn):
         if nbytes is None:
             nbytes = getattr(result, "nbytes", 0) or 0
         dtype = getattr(obj, "dtype", None) or getattr(result, "dtype", "")
+        # Compressed ops leave the bytes that actually crossed the wire
+        # on the group object (payload + scales); None means wire ==
+        # logical and the ledger keeps a 1.0 compression ratio.
+        g = GroupManager.get_group(group)
+        wire = getattr(g, "_last_wire", None) if g is not None else None
         comms.record_op(group, op_name, int(nbytes), _dtype_str(dtype), dur,
-                        world_size=get_collective_group_size(group))
+                        world_size=get_collective_group_size(group),
+                        wire_bytes=wire)
         if perf.ENABLED:
             perf.observe("collective.op", dur * 1e3)
         return result

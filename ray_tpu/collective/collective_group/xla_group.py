@@ -32,6 +32,7 @@ import numpy as np
 from ray_tpu._private.jax_compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ray_tpu.collective import quantization
 from ray_tpu.collective.types import ReduceOp
 from ray_tpu.observability import comms, perf
 
@@ -139,41 +140,94 @@ class _Rendezvous:
 
 class XLAGroup:
     def __init__(self, world_size: int, rank: int, group_name: str,
-                 shared: "XLAGroupShared"):
+                 shared: "XLAGroupShared", config=None):
         self.world_size = world_size
         self.rank = rank
         self.group_name = group_name
+        self.config = config
         self._shared = shared
+        #: wire bytes of the last op when compressed (None = wire ==
+        #: logical); read back by the collective API's ledger seam
+        self._last_wire = None
 
     # -- ops ------------------------------------------------------------------
 
+    def _hierarchical(self) -> bool:
+        cfg = self.config
+        return (cfg is not None and cfg.ranks_per_host > 1
+                and self.world_size % cfg.ranks_per_host == 0
+                and self.world_size != cfg.ranks_per_host)
+
+    def _compressed(self, arr, kind: str, op: ReduceOp):
+        """Quantized allreduce/reducescatter: the payload is compressed at
+        the host seam (the compression tier models the expensive DCN hop;
+        intra-host ICI programs stay full precision)."""
+        cfg = self.config
+        meta = quantization.qmeta(cfg, arr)
+        if kind == "allreduce" and self._hierarchical():
+            res = self._shared.collective(
+                self.rank, arr, (kind, op, "hier", cfg.ranks_per_host),
+                qmeta=meta, qconfig=cfg)
+            self._last_wire = res.get("wire")
+            return res[self.rank]
+        try:
+            q = quantization.quantize(arr, cfg, group=self.group_name,
+                                      op=kind, rank=self.rank)
+        except Exception as e:
+            # Still arrive at the rendezvous: the fault sentinel makes the
+            # shared compute raise this error for EVERY rank (fail loudly)
+            # instead of stranding the peers until their timeout.
+            self._shared.collective(
+                self.rank,
+                quantization.QuantFault(e, tuple(arr.shape),
+                                        np.dtype(arr.dtype)),
+                (kind, op), qmeta=meta, qconfig=cfg)
+            raise
+        self._last_wire = q.wire_bytes
+        return self._shared.collective(self.rank, q, (kind, op),
+                                       qmeta=meta, qconfig=cfg)[self.rank]
+
     def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        self._last_wire = None
+        arr = np.asarray(tensor)
+        if quantization.active(self.config, arr):
+            return self._compressed(arr, "allreduce", op)
         results = self._shared.collective(self.rank, tensor, ("allreduce", op))
         return results[self.rank]
 
     def reduce(self, tensor, root_rank: int = 0, op: ReduceOp = ReduceOp.SUM):
+        self._last_wire = None
         results = self._shared.collective(self.rank, tensor, ("reduce", op, root_rank))
         return results[self.rank]
 
     def broadcast(self, tensor, root_rank: int = 0):
+        self._last_wire = None
         results = self._shared.collective(self.rank, tensor, ("broadcast", root_rank))
         return results[self.rank]
 
     def allgather(self, tensor):
+        self._last_wire = None
         results = self._shared.collective(self.rank, tensor, ("allgather",))
         return results[self.rank]
 
     def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        self._last_wire = None
+        arr = np.asarray(tensor)
+        if quantization.active(self.config, arr):
+            return self._compressed(arr, "reducescatter", op)
         results = self._shared.collective(self.rank, tensor, ("reducescatter", op))
         return results[self.rank]
 
     def barrier(self):
+        self._last_wire = None
         self._shared.collective(self.rank, jnp.zeros((), jnp.int32), ("barrier",))
 
     def send(self, tensor, dst_rank: int):
+        self._last_wire = None
         self._shared.p2p_send(self.rank, dst_rank, tensor)
 
     def recv(self, src_rank: int):
+        self._last_wire = None
         return self._shared.p2p_recv(self.rank, src_rank)
 
     def destroy(self):
@@ -208,20 +262,61 @@ class XLAGroupShared:
             self._compiled[key] = fn
         return fn
 
-    def collective(self, rank: int, tensor, op_desc: tuple) -> Dict[int, Any]:
-        tensor = jnp.asarray(tensor)
+    def collective(self, rank: int, tensor, op_desc: tuple,
+                   qmeta: tuple = ("none", 0),
+                   qconfig=None) -> Dict[Any, Any]:
+        if isinstance(tensor, (quantization.Quantized,
+                               quantization.QuantFault)):
+            shape, dtype = tensor.shape, tensor.dtype
+        else:
+            tensor = jnp.asarray(tensor)
+            shape, dtype = tuple(tensor.shape), tensor.dtype
         # Raw-tuple fingerprint: (op_desc, shape, dtype) compares by
         # value; stringifying enum/dtype per op costs more than the rest
         # of the ledger combined, so it only happens in the divergence
         # error message (the cross-process path, which must publish
-        # JSON-safe fingerprints, uses comms.fingerprint instead).
-        fp = ((op_desc, tuple(tensor.shape), tensor.dtype)
-              if comms.ENABLED else None)
+        # JSON-safe fingerprints, uses comms.fingerprint instead). The
+        # trailing (scheme, block_elems) pair makes mixed-compression
+        # ranks diverge loudly instead of mixing payload types.
+        fp = ((op_desc, shape, dtype) + tuple(qmeta)) \
+            if comms.ENABLED else None
 
-        def compute(slots: Dict[int, Any]) -> Dict[int, Any]:
+        def compute(slots: Dict[int, Any]) -> Dict[Any, Any]:
+            for v in slots.values():
+                if isinstance(v, quantization.QuantFault):
+                    raise v.error
+            if "hier" in op_desc or isinstance(
+                    slots[0], quantization.Quantized):
+                return self._run_quantized_op(slots, op_desc, qconfig)
             return self._run_group_op(slots, op_desc)
 
         return self._rdv.run(rank, tensor, compute, fingerprint=fp)
+
+    def _run_quantized_op(self, slots: Dict[int, Any], op_desc: tuple,
+                          qconfig) -> Dict[Any, Any]:
+        """Compressed allreduce/reducescatter, staged on the host: the
+        dequant-fused reduction happens at f32 in the quant kernels (the
+        compression tier targets the expensive inter-host hop, so the
+        intra-host ICI mesh programs are deliberately not part of it)."""
+        kind = op_desc[0]
+        op = op_desc[1]
+        reduce_np = (None if op == ReduceOp.SUM
+                     else (lambda xs: np.asarray(_REDUCE_NP[op](
+                         jnp.asarray(xs), axis=0))))
+        vals = [slots[r] for r in range(self.world_size)]
+        if "hier" in op_desc:
+            red, wire = quantization.hierarchical_allreduce(
+                vals, qconfig, reduce_np,
+                group=self.label or "default", op_name=kind)
+            out: Dict[Any, Any] = {r: jnp.asarray(red)
+                                   for r in range(self.world_size)}
+            out["wire"] = wire
+            return out
+        red = jnp.asarray(quantization.reduce_quantized(vals, reduce_np))
+        if kind == "allreduce":
+            return {r: red for r in range(self.world_size)}
+        chunks = jnp.split(red, self.world_size, axis=0)
+        return {r: chunks[r] for r in range(self.world_size)}
 
     # -- the single fused program for the whole group -------------------------
 

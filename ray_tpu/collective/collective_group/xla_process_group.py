@@ -38,6 +38,7 @@ logger = logging.getLogger("ray_tpu")
 
 P2P_NS = b"tplane-p2p"
 COMMS_NS = b"tplane-comms"
+QUANT_NS = b"tplane-quant"
 
 
 def _np_dtype(name: str):
@@ -62,11 +63,16 @@ class XLAProcessGroup:
 
     def __init__(self, world_size: int, rank: int, group_name: str,
                  num_cpu_devices: Optional[int] = None, epoch: int = 0,
-                 runtime=None):
+                 runtime=None, config=None):
         from ray_tpu.collective.tensor_plane import init_tensor_plane
         self.world_size = world_size
         self.rank = rank
         self.group_name = group_name
+        self.config = config
+        #: wire bytes of the last op when compressed (None = wire ==
+        #: logical); read back by the collective API's ledger seam
+        self._last_wire = None
+        self._q_seq = 0  # quantized-exchange sequence (uniform across ranks)
         init_tensor_plane(group_name, world_size, rank, epoch=epoch,
                           num_cpu_devices=num_cpu_devices, runtime=runtime)
         by_proc: Dict[int, Any] = {}
@@ -133,7 +139,8 @@ class XLAProcessGroup:
 
     # -- comms plane (fingerprint exchange + arrival skew over the KV) --------
 
-    def _comms_pre(self, op: str, x) -> Optional[tuple]:
+    def _comms_pre(self, op: str, x,
+                   qmeta: tuple = ("none", 0)) -> Optional[tuple]:
         """Publish this rank's (op, shape, dtype) fingerprint + arrival
         stamp for the next collective and cross-check rank 0's before
         launching.  A divergent rank raises CollectiveDivergenceError
@@ -149,7 +156,8 @@ class XLAProcessGroup:
             return None
         import json
         from ray_tpu._private import clocksync
-        fp = comms.fingerprint(op, x.shape, x.dtype)
+        fp = comms.fingerprint(op, x.shape, x.dtype,
+                               scheme=qmeta[0], block=qmeta[1])
         ctx = (seq, time.monotonic())
         try:
             kv = self._kv()
@@ -157,7 +165,7 @@ class XLAProcessGroup:
             return ctx  # no state service: phase timings only
         base = f"{self.group_name}/fp/{seq}"
         # Stamps ride the server timebase so skew compares across hosts.
-        rec = json.dumps({"fp": [fp[0], list(fp[1]), fp[2]],
+        rec = json.dumps({"fp": [fp[0], list(fp[1]), fp[2], fp[3], fp[4]],
                           "t": clocksync.to_server_s(time.time())})
         try:
             kv.kv_put(f"{base}/{self.rank}".encode(), rec.encode(),
@@ -175,7 +183,11 @@ class XLAProcessGroup:
                     return ctx
                 if raw is not None:
                     other = json.loads(raw.decode())["fp"]
-                    theirs = (other[0], tuple(other[1]), other[2])
+                    # pre-compression peers publish 3 fields; treat the
+                    # missing scheme/block as uncompressed
+                    theirs = (other[0], tuple(other[1]), other[2],
+                              other[3] if len(other) > 3 else "none",
+                              int(other[4]) if len(other) > 4 else 0)
                     comms.check_fingerprints({0: theirs, self.rank: fp},
                                              group=self.group_name, seq=seq)
                     break
@@ -222,10 +234,78 @@ class XLAProcessGroup:
                                   {r: t - first for r, t in stamps.items()},
                                   self.world_size)
 
+    # -- quantized inter-host exchange (the DCN/TCP seam) ---------------------
+
+    def _quant_active(self, arr) -> bool:
+        from ray_tpu.collective import quantization
+        return quantization.active(self.config, arr)
+
+    def _quantized_reduce(self, arr: np.ndarray, op: ReduceOp,
+                          kind: str) -> np.ndarray:
+        """Full reduction over the KV/TCP rendezvous with *quantized*
+        payloads — the inter-host hop of the hierarchy. Each process has
+        already reduced across its local devices at full precision inside
+        the jitted intra-host programs (the ICI hop); what crosses hosts
+        here is the block-quantized partial plus per-block scales, and the
+        accumulate happens at f32 after dequantization.
+
+        Ranks publish ``{group}/q/{seq}/{rank}`` and collect all peers;
+        a rank's ``seq-1`` key is deleted only after it has collected
+        every peer's ``seq`` key (everyone publishing seq means everyone
+        finished seq-1, so the old generation is safe to drop)."""
+        import pickle
+        from ray_tpu.collective import quantization
+        from ray_tpu.collective.collective_group.cpu_group import \
+            _reduce_np_for
+        q = quantization.quantize(arr, self.config, group=self.group_name,
+                                  op=kind, rank=self.rank)
+        self._last_wire = q.wire_bytes
+        kv = self._kv()
+        seq = self._q_seq
+        self._q_seq += 1
+        base = f"{self.group_name}/q/{seq}"
+        kv.kv_put(f"{base}/{self.rank}".encode(), pickle.dumps(q),
+                  overwrite=True, namespace=QUANT_NS)
+        payloads: Dict[int, Any] = {self.rank: q}
+        deadline = time.monotonic() + 120.0
+        while len(payloads) < self.world_size:
+            for r in range(self.world_size):
+                if r in payloads:
+                    continue
+                raw = kv.kv_get(f"{base}/{r}".encode(), namespace=QUANT_NS)
+                if raw is not None:
+                    payloads[r] = pickle.loads(raw)
+            if len(payloads) < self.world_size:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"quantized {kind} rendezvous timed out at rank "
+                        f"{self.rank} ({len(payloads)}/{self.world_size} "
+                        f"payloads)")
+                # raylint: allow(bare-retry) deadline-bounded KV poll for peer payloads, not a failure retry
+                time.sleep(0.005)
+        if seq > 0:
+            try:
+                kv.kv_del(f"{self.group_name}/q/{seq - 1}/{self.rank}"
+                          .encode(), namespace=QUANT_NS)
+            except Exception as e:
+                logger.debug("quantized payload cleanup failed: %s", e)
+        return quantization.reduce_quantized(
+            [payloads[r] for r in range(self.world_size)],
+            _reduce_np_for(op))
+
     # -- ops (every process must call, same order) ---------------------------
 
     def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        self._last_wire = None
         x = jnp.asarray(tensor)
+        if self._quant_active(x):
+            from ray_tpu.collective import quantization
+            meta = quantization.qmeta(self.config, x)
+            ctx = self._comms_pre(f"allreduce:{op}", x, qmeta=meta)
+            val = jnp.asarray(
+                self._quantized_reduce(np.asarray(x), op, "allreduce"))
+            self._comms_post(ctx)
+            return val
         ctx = self._comms_pre(f"allreduce:{op}", x)
         out = self._program("allreduce", op, 0)(self._stacked(x))
         val = self._local_value(out)
@@ -233,6 +313,7 @@ class XLAProcessGroup:
         return val
 
     def reduce(self, tensor, root_rank: int = 0, op: ReduceOp = ReduceOp.SUM):
+        self._last_wire = None
         x = jnp.asarray(tensor)
         ctx = self._comms_pre(f"reduce:{op}:{root_rank}", x)
         out = self._local_value(
@@ -241,6 +322,7 @@ class XLAProcessGroup:
         return out if self.rank == root_rank else x
 
     def broadcast(self, tensor, root_rank: int = 0):
+        self._last_wire = None
         x = jnp.asarray(tensor)
         ctx = self._comms_pre(f"broadcast:{root_rank}", x)
         out = self._program("broadcast", None, root_rank)(self._stacked(x))
@@ -249,6 +331,7 @@ class XLAProcessGroup:
         return val
 
     def allgather(self, tensor):
+        self._last_wire = None
         x = jnp.asarray(tensor)
         ctx = self._comms_pre("allgather", x)
         out = self._program("allgather", None, 0)(self._stacked(x))
@@ -260,12 +343,22 @@ class XLAProcessGroup:
         """Each rank contributes a tensor whose leading dim divides into
         ``world_size`` chunks; rank r receives chunk r of the reduction
         (same contract as the in-process groups, test_collective.py:78)."""
+        self._last_wire = None
         x = jnp.asarray(tensor)
         if x.shape[0] % self.world_size:
             raise ValueError(
                 f"reducescatter leading dim {x.shape[0]} not divisible by "
                 f"world size {self.world_size}")
         chunk = x.shape[0] // self.world_size
+        if self._quant_active(x):
+            from ray_tpu.collective import quantization
+            meta = quantization.qmeta(self.config, x)
+            ctx = self._comms_pre(f"reducescatter:{op}", x, qmeta=meta)
+            red = self._quantized_reduce(np.asarray(x), op, "reducescatter")
+            val = jnp.asarray(
+                red[self.rank * chunk:(self.rank + 1) * chunk])
+            self._comms_post(ctx)
+            return val
         ctx = self._comms_pre(f"reducescatter:{op}", x)
         chunks = x.reshape((self.world_size, chunk) + x.shape[1:])
         arr = self._stacked(chunks)  # (world, world, chunk...)
@@ -314,6 +407,7 @@ class XLAProcessGroup:
             return None
 
     def send(self, tensor, dst_rank: int):
+        self._last_wire = None
         seq = self._p2p_seq.get(("s", dst_rank), 0)
         self._p2p_seq[("s", dst_rank)] = seq + 1
         arr = np.ascontiguousarray(np.asarray(tensor))
@@ -340,6 +434,7 @@ class XLAProcessGroup:
                           namespace=P2P_NS)
 
     def recv(self, src_rank: int, timeout_s: float = 30.0):
+        self._last_wire = None
         import pickle
         seq = self._p2p_seq.get(("r", src_rank), 0)
         self._p2p_seq[("r", src_rank)] = seq + 1

@@ -1140,10 +1140,14 @@ def check_collective_divergence(ctxs: List[FileContext],
     *some* ranks reach deadlocks the others.  Flagged shapes: a collective
     under a branch on rank/world-size state that the other arm does not
     match; a collective after a rank-dependent early exit; a collective
-    inside a rank-dependent loop; and a collective inside an ``except``
+    inside a rank-dependent loop; a collective inside an ``except``
     handler (locally-divergent exception state — one rank's fault must
-    not desync the collective schedule).  Uniform-by-construction
-    branches are justified with
+    not desync the collective schedule); and a ``CollectiveConfig``
+    built from rank-dependent state — the (compression scheme, block
+    size) pair folds into every rank's rendezvous fingerprint, so a
+    per-rank config raises CollectiveDivergenceError at the group's
+    first op rather than corrupting a half-quantized reduction.
+    Uniform-by-construction shapes are justified with
     ``# raylint: allow(collective-divergence) <why>``."""
     idx = engine.index(ctxs)
     direct: Dict[str, List[Tuple[int, str]]] = {}
@@ -1259,11 +1263,37 @@ def check_collective_divergence(ctxs: List[FileContext],
                 continue  # separate FunctionInfo / scope
         return
 
+    def flag_config(fn, node, dep):
+        if fn.ctx.allowed(node.lineno, "R12", "collective-divergence"):
+            return
+        key = (fn.ctx.relpath, node.lineno, "CollectiveConfig")
+        if key in findings:
+            return
+        findings[key] = Finding(
+            "R12", "collective-divergence", fn.ctx.relpath, node.lineno,
+            f"CollectiveConfig built from rank-dependent state ('{dep}') "
+            f"— the (compression scheme, block size) pair folds into "
+            f"every rank's rendezvous fingerprint, so per-rank configs "
+            f"raise CollectiveDivergenceError at the group's first op; "
+            f"build ONE config for the whole group or justify with "
+            f"'# raylint: allow(collective-divergence) <why>'")
+
     for q in sorted(idx.functions):
         fn = idx.functions[q]
         if fn.synthetic:
             continue              # arm statements belong to the dispatcher
         walk_stmts(fn, list(fn.node.body), None)
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = _dotted(node.func) or ""
+            if dn.rsplit(".", 1)[-1] != "CollectiveConfig":
+                continue
+            for sub in list(node.args) + [kw.value for kw in node.keywords]:
+                dep = _rank_dependent(sub)
+                if dep:
+                    flag_config(fn, node, dep)
+                    break
     for key in sorted(findings):
         yield findings[key]
 

@@ -390,9 +390,12 @@ def _comms_reports(collected: dict, baseline: Optional[dict] = None,
     gate: ``*_gbps`` budgets are floors on the merged algorithm
     bandwidth (the ``allreduce_f32_gbps``-style gate the quantized-
     collective roadmap item compares against), ``skew_p95_ms`` and
-    ``mismatches`` are ceilings.  Unknown groups in the baseline are
-    ignored (a gate for a group that never ran is not a drift).  Flags
-    and drift all count as issues."""
+    ``mismatches`` are ceilings, and ``"<op>_wire_ratio"`` budgets are
+    ceilings on the merged wire/logical compression ratio — a quantized
+    group drifting back toward 1.0 means compression silently stopped
+    paying for itself.  Unknown groups in the baseline are ignored (a
+    gate for a group that never ran is not a drift).  Flags and drift
+    all count as issues."""
     from ray_tpu.observability import comms as comms_mod
     cluster = collected.get("cluster") or {}
     snaps = (cluster.get("metrics") or {}).get("snapshots") or {}
@@ -423,6 +426,17 @@ def _comms_reports(collected: dict, baseline: Optional[dict] = None,
                     drift.append({"group": group, "metric": key,
                                   "got_gbps": round(got, 3),
                                   "baseline_gbps": float(base),
+                                  "tolerance": tolerance})
+            elif key.endswith("_wire_ratio"):
+                op = key[:-len("_wire_ratio")]
+                o = ((rec.get("ops") or {}).get(op) or {})
+                nbytes = float(o.get("bytes") or 0.0)
+                wire = float(o.get("wire_bytes", nbytes) or nbytes)
+                got = (wire / nbytes) if nbytes else 1.0
+                if got > float(base) * tolerance:
+                    drift.append({"group": group, "metric": key,
+                                  "got_ratio": round(got, 4),
+                                  "baseline_ratio": float(base),
                                   "tolerance": tolerance})
             elif key == "skew_p95_ms":
                 ranks = report.get(group) or {}

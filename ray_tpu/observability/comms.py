@@ -8,9 +8,13 @@ ledger behind ``/api/comms``, ``ray-tpu top --comms`` and the doctor's
 COMMS section:
 
 - **Op ledger** — every collective op through the public API records
-  (group, seq, op, bytes, dtype, duration) and derives algorithm /
-  bus bandwidth NCCL-tests-style (busbw = algbw x 2(n-1)/n for
-  allreduce, (n-1)/n for allgather/reducescatter, 1 otherwise).
+  (group, seq, op, bytes, wire_bytes, dtype, duration) and derives
+  algorithm / bus bandwidth NCCL-tests-style (busbw = algbw x 2(n-1)/n
+  for allreduce, (n-1)/n for allgather/reducescatter, 1 otherwise).
+  ``bytes`` is the logical tensor size; ``wire_bytes`` is what crossed
+  the link (quantized payload + scales for compressed groups), and
+  algbw/busbw rate the wire while ``logical_gbps`` /
+  ``compression_ratio`` keep the application-side view honest.
 
 - **Arrival-skew attribution** — every rank stamps its arrival at the
   rendezvous; the last arrival converts the stamps into per-rank
@@ -89,9 +93,16 @@ class CollectiveDivergenceError(RuntimeError):
             f"must issue the same collective in the same order)")
 
 
-def fingerprint(op: Any, shape: Sequence[int], dtype: Any) -> Tuple:
-    """(op, shape, dtype) identity of one rank's collective submission."""
-    return (str(op), tuple(int(s) for s in shape), str(dtype))
+def fingerprint(op: Any, shape: Sequence[int], dtype: Any,
+                scheme: Any = "none", block: int = 0) -> Tuple:
+    """(op, shape, dtype, scheme, block) identity of one rank's collective
+    submission. ``scheme``/``block`` are the compression identity
+    (``CollectiveConfig``): a rank quantizing q8 payloads into a
+    rendezvous where another rank submits f32 is a divergence exactly
+    like an op or shape mismatch — the reduction would silently mix
+    payload types — so both schemes are named in the raised error."""
+    return (str(op), tuple(int(s) for s in shape), str(dtype),
+            str(scheme), int(block))
 
 
 def check_fingerprints(fps: Dict[int, Tuple], group: str = "default",
@@ -145,9 +156,18 @@ def _count_mismatch(group: str) -> None:
 
 def record_op(group: str, op: str, nbytes: int, dtype: str,
               seconds: float, world_size: int = 0,
-              seq: Optional[int] = None) -> None:
+              seq: Optional[int] = None,
+              wire_bytes: Optional[int] = None) -> None:
     """One completed collective into the op ledger (bandwidths are
-    derived at snapshot time from the summed bytes/seconds)."""
+    derived at snapshot time from the summed bytes/seconds).
+
+    ``nbytes`` is the *logical* tensor size; ``wire_bytes`` is what
+    actually crossed the link when the op shipped compressed payloads
+    (quantized blocks + scales). None means wire == logical. Keeping
+    both is what makes the ledger honest for compressed collectives:
+    algbw/busbw derive from wire bytes (real link usage), while the
+    logical rate and the wire/logical compression ratio are derived
+    alongside so ``top --comms`` can show all three."""
     if not ENABLED:
         return
     with _lock:
@@ -159,9 +179,12 @@ def record_op(group: str, op: str, nbytes: int, dtype: str,
         g["seq"] = max(g["seq"], int(seq) + 1)
         rec = g["ops"].get(op)
         if rec is None:
-            rec = g["ops"][op] = {"count": 0, "bytes": 0, "seconds": 0.0}
+            rec = g["ops"][op] = {"count": 0, "bytes": 0, "wire_bytes": 0,
+                                  "seconds": 0.0}
         rec["count"] += 1
         rec["bytes"] += int(nbytes)
+        rec["wire_bytes"] += int(nbytes if wire_bytes is None
+                                 else wire_bytes)
         rec["seconds"] += float(seconds)
         _recent.append([group, int(seq), op, int(nbytes), str(dtype),
                         float(seconds) * 1e3])
@@ -220,11 +243,19 @@ def _derive_ops(ops: Dict[str, Dict[str, Any]],
     for op, rec in ops.items():
         secs = float(rec.get("seconds", 0.0))
         nbytes = int(rec.get("bytes", 0))
-        algbw = (nbytes / secs / 1e9) if secs > 0 else 0.0
+        # pre-compression records carry no wire column: wire == logical
+        wire = int(rec.get("wire_bytes", nbytes) or nbytes)
+        algbw = (wire / secs / 1e9) if secs > 0 else 0.0
         factor = _BUSBW.get(op, lambda n: 1.0)(world)
         out[op] = {"count": int(rec.get("count", 0)), "bytes": nbytes,
-                   "seconds": secs, "algbw_gbps": algbw,
-                   "busbw_gbps": algbw * factor}
+                   "wire_bytes": wire, "seconds": secs,
+                   # algbw/busbw rate the LINK (wire bytes); logical_gbps
+                   # rates the application-visible tensor throughput —
+                   # for compressed ops it exceeds algbw by 1/ratio
+                   "algbw_gbps": algbw, "busbw_gbps": algbw * factor,
+                   "logical_gbps": (nbytes / secs / 1e9) if secs > 0
+                   else 0.0,
+                   "compression_ratio": (wire / nbytes) if nbytes else 1.0}
     return out
 
 
@@ -298,9 +329,13 @@ def merge_payloads(payloads: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
                 if not isinstance(rec, dict):
                     continue
                 t = m["ops"].setdefault(op, {"count": 0, "bytes": 0,
+                                             "wire_bytes": 0,
                                              "seconds": 0.0})
                 t["count"] += int(rec.get("count") or 0)
                 t["bytes"] += int(rec.get("bytes") or 0)
+                # nodes predating the wire column report wire == logical
+                t["wire_bytes"] += int(rec.get("wire_bytes")
+                                       or rec.get("bytes") or 0)
                 t["seconds"] += float(rec.get("seconds") or 0.0)
             for rank, rec in (g.get("ranks") or {}).items():
                 if not isinstance(rec, dict):

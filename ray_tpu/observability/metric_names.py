@@ -70,6 +70,8 @@ PERF_HISTOGRAMS = frozenset({
     "collective.op",       # full API-layer op duration (collective.py seam)
     "collective.launch",   # last-arrival compute / compiled-program run
     "collective.collect",  # per-rank blocked time from arrival to result
+    "collective.quantize",  # per-rank block-quantization cost (compression
+                            # tier, collective/quantization.py)
 })
 
 # Comms-plane sample families.  Not literal-checked by a lint rule the

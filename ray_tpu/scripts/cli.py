@@ -212,19 +212,25 @@ def _render_goodput(payload) -> str:
 
 def _render_comms(payload) -> str:
     """Render an ``/api/comms`` payload: the per-group op ledger (count,
-    bytes, algbw/busbw), the per-rank arrival-skew table with laggards
-    marked, then the peer link matrix with outliers marked."""
+    bytes, algbw/busbw over *wire* bytes, and the wire/logical
+    compression ratio — 1.00 for uncompressed groups, ~0.27 for q8),
+    the per-rank arrival-skew table with laggards marked, then the peer
+    link matrix with outliers marked."""
     from ray_tpu.observability import comms as comms_mod
-    lines = ["%-14s %-14s %7s %10s %10s %10s" % (
-        "GROUP", "OP", "COUNT", "MB", "ALGBW_GB/S", "BUSBW_GB/S")]
+    lines = ["%-14s %-14s %7s %10s %10s %10s %6s" % (
+        "GROUP", "OP", "COUNT", "MB", "ALGBW_GB/S", "BUSBW_GB/S", "RATIO")]
     groups = payload.get("groups") or {}
     for gname, rec in sorted(groups.items()):
         for op, o in sorted((rec.get("ops") or {}).items()):
-            lines.append("%-14s %-14s %7d %10.1f %10.2f %10.2f" % (
-                gname, op, int(o.get("count", 0)),
-                float(o.get("bytes", 0)) / 1e6,
+            nbytes = float(o.get("bytes", 0))
+            wire = float(o.get("wire_bytes", nbytes) or nbytes)
+            ratio = o.get("compression_ratio")
+            if ratio is None:
+                ratio = (wire / nbytes) if nbytes else 1.0
+            lines.append("%-14s %-14s %7d %10.1f %10.2f %10.2f %6.2f" % (
+                gname, op, int(o.get("count", 0)), nbytes / 1e6,
                 float(o.get("algbw_gbps", 0.0)),
-                float(o.get("busbw_gbps", 0.0))))
+                float(o.get("busbw_gbps", 0.0)), float(ratio)))
         if rec.get("mismatches"):
             lines.append(f"  {gname}: {rec['mismatches']} fingerprint "
                          "mismatch(es) — divergent collective submissions")

@@ -109,6 +109,17 @@ RAY_TPU_CHAOS="20260806:collective.op@2%5=delay(0.01);rpc.client.send@3%7=delay(
 JAX_PLATFORMS=cpu \
 python -m pytest tests/test_comms.py -q
 
+echo "== quantized-collectives gate (compression tier under delay-only chaos) =="
+# The compression tier must stay numerically correct when the quantize
+# step itself gets slow: a fixed delay-only schedule on collective.quant
+# (plus the op seam) stretches exactly the per-rank block-quantization
+# step, so rendezvous arrivals skew and the quantized tests — round-trip
+# error bounds, hierarchical==flat equivalence, wire-ratio ledger books,
+# mixed-scheme divergence, the chaos fail-loudly drill — must all hold.
+RAY_TPU_CHAOS="20260807:collective.quant@2%3=delay(0.01);collective.op@3%5=delay(0.005)" \
+JAX_PLATFORMS=cpu \
+python -m pytest tests/test_collective.py tests/test_quantization.py -q
+
 echo "== forensics gate (crash bundles sealed + doctor reads them back) =="
 # Hard-death drill: the forensics suite kills processes mid-task — via a
 # deterministic chaos exit schedule (hooks run) and via raw SIGKILL (no

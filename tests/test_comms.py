@@ -420,6 +420,26 @@ def test_doctor_counts_mismatches_as_drift():
     assert [d["metric"] for d in rep["drift"]] == ["mismatches"]
 
 
+def test_doctor_wire_ratio_budget_gates_compression():
+    """``"<op>_wire_ratio"`` baseline budgets are ceilings on the merged
+    wire/logical ratio: a quantized group drifting back toward 1.0 means
+    compression silently stopped paying for itself."""
+    from ray_tpu import doctor
+    comms.record_op("gq", "allreduce", 1 << 20, "float32", 0.004,
+                    world_size=2, wire_bytes=(1 << 20) * 68 // 256)
+    collected = {"ts": time.time(), "errors": [],
+                 "cluster": {"metrics": {"snapshots": {
+                     "head": comms.families()}}}}
+    loose = doctor._comms_reports(
+        collected, baseline={"gq": {"allreduce_wire_ratio": 0.30}})
+    assert loose["drift"] == []
+    tight = doctor._comms_reports(
+        collected, baseline={"gq": {"allreduce_wire_ratio": 0.10}})
+    assert [d["metric"] for d in tight["drift"]] == ["allreduce_wire_ratio"]
+    assert tight["drift"][0]["got_ratio"] == pytest.approx(68 / 256,
+                                                           abs=1e-3)
+
+
 # -- tensor-plane epoch gauge ------------------------------------------------
 
 def test_tensor_plane_mark_sets_epoch_gauge():
