@@ -27,7 +27,9 @@ try/except and its result is emitted as a JSON progress line the moment it
 is measured, so a tunnel outage or crash mid-run loses only the sections
 not yet reached. The FINAL stdout line is always the combined headline
 JSON (the one the driver parses), carrying whatever was captured plus a
-``backend_available`` marker — and the process exits 0 regardless.
+``backend_available`` marker and its machine-parsed negation
+``probe_failed: true`` when the TPU backend was lost — and the process
+exits 0 regardless.
 CPU-pinned sections (PPO) run BEFORE the backend probe so a dead tunnel
 never starves them. The probe window is wall-clock bounded:
 ``BENCH_PROBE_DEADLINE_S`` (default 300) with ``BENCH_PROBE_DELAY_S``
@@ -376,6 +378,7 @@ def main():
         "device_kind": kind,
         "peak_bf16_tflops": None if peak is None else round(peak / 1e12, 1),
         "backend_available": backend_ok,
+        "probe_failed": not backend_ok,
         "errors": {k: v["error"] for k, v in results.items()
                    if "error" in v} or None,
         "extras": {
@@ -399,6 +402,7 @@ if __name__ == "__main__":
         _emit({"metric": "resnet50_train_images_per_sec_per_chip",
                "value": None, "unit": "images/sec", "vs_baseline": None,
                "mfu_pct": None, "backend_available": False,
+               "probe_failed": True,
                "errors": {"harness": f"{type(exc).__name__}: {exc}"},
                "extras": {}})
     sys.exit(0)

@@ -653,6 +653,23 @@ def bench_churn_goodput():
     emit("goodput_under_churn_pct", fleet["train"]["goodput_pct"], "%")
 
 
+def bench_autopilot():
+    """``autopilot_goodput_gain_pct``: the deterministic A/B drill from
+    ``ray_tpu/autopilot/drill.py`` — the same synthetic workload run
+    under the same fixed seeded chaos schedule (a starved reader plus a
+    skewed collective rank) with the controller OFF and ON, both arms
+    folded through the real goodput ledger. The row is the ON−OFF
+    goodput delta in percentage points; every input is fixed and the
+    clock is virtual, so it moves only when the policy/actuator/guard
+    loop changes. Gated bigger-is-better (a floor > 0) by
+    ``check_against``'s goodput carve-out: an autopilot that stops
+    helping fails the gate."""
+    from ray_tpu.autopilot import drill
+
+    ab = drill.run_ab()
+    emit("autopilot_goodput_gain_pct", ab["gain_pct"], "pct-points")
+
+
 def bench_preempt_notice(poll_ms: float = 200.0):
     """``preempt_notice_to_drain_ms``: the live eviction-notice pipeline.
     One fresh daemon whose preemption watcher receives a chaos eviction
@@ -845,6 +862,7 @@ def run_inproc():
     bench_perf_overhead("inproc")
     bench_goodput("inproc")
     bench_churn_goodput()
+    bench_autopilot()
     bench_comms("inproc")
     ray_tpu.shutdown()
 
@@ -872,8 +890,9 @@ def check_against(baseline_path: str, tolerance: float) -> int:
     baseline / tolerance (for ``_pct`` the baseline is the budget itself
     — e.g. the 1% disabled-tracing bound — not a past measurement).
     Exception: goodput percentage rows (``*goodput_pct``,
-    ``goodput_under_churn_pct``) are efficiency *floors* — higher is
-    better, like throughput — so they gate as >= baseline * tolerance.
+    ``goodput_under_churn_pct``, ``autopilot_goodput_gain_pct``) are
+    efficiency *floors* — higher is better, like throughput — so they
+    gate as >= baseline * tolerance.
     Metrics missing from either side are skipped (a cluster-less
     environment still gates the inproc set, and TPU-scale target rows
     like ``tpu_serve_qps`` stay dormant until a run on real TPU emits
@@ -886,7 +905,8 @@ def check_against(baseline_path: str, tolerance: float) -> int:
         got = measured.get(metric)
         if got is None or base <= 0:
             continue
-        if metric.endswith(("goodput_pct", "goodput_under_churn_pct")):
+        if metric.endswith(("goodput_pct", "goodput_under_churn_pct",
+                            "autopilot_goodput_gain_pct")):
             # goodput is the one percentage where bigger is better: it
             # is a fraction of wall-clock doing useful work, not an
             # overhead budget

@@ -381,3 +381,57 @@ _config.define("serve_autoscale_ewma_alpha", float, 0.3,
                "EWMA weight for the SLO autoscaler's federated queue-wait "
                "p95 sensor: higher reacts faster to latency spikes, lower "
                "rides through transients without scaling")
+
+# -- Autopilot (closed-loop cluster retuning, ray_tpu/autopilot/) ----------------
+_config.define("autopilot_enabled", bool, False,
+               "host the autopilot controller in the dashboard head: every "
+               "tick it reads the merged perf/goodput/comms planes and "
+               "retunes the autopilot-owned knobs through the guardrailed "
+               "actuator layer, journaling every decision to the state KV "
+               "('autopilot' namespace) for ray_tpu.doctor --explain")
+_config.define("autopilot_tick_s", float, 5.0,
+               "controller tick period; poke() wakes it early when a plane "
+               "merge sees something worth reacting to")
+_config.define("autopilot_watch_ticks", int, 3,
+               "ticks each actuated knob stays under its post-change SLO "
+               "watch before the change is considered kept")
+_config.define("autopilot_revert_pct", float, 5.0,
+               "SLO regression tolerance during the watch window: the knob "
+               "auto-reverts (journaled) when the guarded metric moves "
+               "worse than this percentage from its pre-change baseline")
+_config.define("autopilot_decision_ttl_s", float, 600.0,
+               "seconds a journaled decision claims its knob; an expired "
+               "claim retires quietly so the policy can re-examine the "
+               "knob against fresh telemetry")
+_config.define("autopilot_flap_window_s", float, 600.0,
+               "oscillation guard window: a knob actuated >= 3 times "
+               "inside it is frozen for the remainder (and flagged by "
+               "the doctor)")
+_config.define("autopilot_max_changes_per_tick", int, 2,
+               "actuation budget per controller tick: bounds the blast "
+               "radius of any single snapshot's worth of proposals")
+_config.define("autopilot_rel_err_budget", float, 5e-3,
+               "relative-error budget the collective policy may spend on "
+               "wire compression: only schemes whose measured block-quant "
+               "error fits are ever proposed (q8 ~ 1.5e-3, fp8 ~ 1.2e-2)")
+_config.define("autopilot_busbw_floor_gbps", float, 4.0,
+               "busbw floor below which the collective policy treats a "
+               "reduction as link-bound and escalates the wire scheme "
+               "(then the two-level hierarchy)")
+
+# -- Autopilot-owned actuation targets -------------------------------------------
+_config.define("collective_ranks_per_host", int, 0,
+               "default CollectiveConfig.ranks_per_host for groups created "
+               "without an explicit config: >1 decomposes allreduce into "
+               "intra-host reduce + inter-host exchange + intra-host "
+               "broadcast; 0/1 single-level (autopilot-owned)")
+_config.define("data_prefetch_batches", int, 0,
+               "default prefetch depth for Dataset.iter_batches when the "
+               "caller passes prefetch_batches=0: batches assembled ahead "
+               "on a background thread (autopilot-owned; retuned from the "
+               "goodput ledger's data_wait attribution)")
+_config.define("checkpoint_cadence_autopilot_steps", int, 0,
+               "cluster-level checkpoint cadence override solved by the "
+               "autopilot's hazard loop; >0 wins over the local "
+               "CadenceController solve (still clamped to the cadence "
+               "min/max bounds), 0 defers to local control")

@@ -210,9 +210,15 @@ class _DataStreamPool:
         n = streams_per_peer()
         if n <= 0:
             return []
+        extra: List[RpcClient] = []
         with self._lock:
             pool = [c for c in self._streams.get(address, ())
                     if not c.closed]
+            # the knob is live (the autopilot retunes it from the link
+            # matrix): a shrink closes the surplus lanes instead of
+            # pinning the old width for the peer's lifetime
+            if len(pool) > n:
+                extra, pool = pool[n:], pool[:n]
             while len(pool) < n:
                 try:
                     pool.append(RpcClient(
@@ -220,7 +226,9 @@ class _DataStreamPool:
                 except (OSError, RpcConnectionError):
                     break  # peer unreachable: callers use what exists
             self._streams[address] = pool
-            return list(pool)
+        for c in extra:  # close outside the lock: close() can block
+            c.close()
+        return list(pool)
 
     def drop(self, address: str) -> None:
         with self._lock:

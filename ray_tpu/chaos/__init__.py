@@ -68,6 +68,15 @@ collective.quant      group, op, rank — compression tier
                       it to every rank) and a rank-filtered "delay"
                       stretches exactly the compression step, which the
                       ``collective.quantize`` perf histogram must show
+autopilot.apply       knob — actuator layer (autopilot/actuators.py),
+                      after the bounds clamp and before the knob write
+                      lands; "error" must leave the previous value
+                      intact and journal a ``failed`` decision
+drill.reader          (no labels) — autopilot A/B drill synthetic input
+                      pipeline; a "drop" return starves the reader for
+                      one step (fixed schedule, both arms)
+drill.collective      rank — autopilot A/B drill synthetic collective;
+                      a rank-filtered "drop" return adds arrival skew
 ====================  =====================================================
 """
 

@@ -156,7 +156,22 @@ class CadenceController:
 
     def interval_steps(self) -> int:
         """Current steps-between-checkpoints; re-solves when the refresh
-        window elapsed (or on first use)."""
+        window elapsed (or on first use).  The autopilot's cluster-level
+        override wins when set: its cadence policy solves the same
+        Young-Daly optimum from the *fleet* hazard feed and actuates it
+        through the journaled actuator layer, so a cluster whose hazard
+        just spiked retunes every session at once — still clamped to
+        the operator's cadence bounds here."""
+        override = int(_config.get("checkpoint_cadence_autopilot_steps"))
+        if override > 0:
+            lo = max(1, int(self._min
+                            if self._min is not None
+                            else _config.get("checkpoint_cadence_min_steps")))
+            hi = max(lo, int(self._max
+                             if self._max is not None
+                             else _config.get("checkpoint_cadence_max_steps")))
+            self.last_interval = max(lo, min(hi, override))
+            return self.last_interval
         if (self.last_interval is not None
                 and self._steps_since_solve < max(1, self._refresh)):
             return self.last_interval

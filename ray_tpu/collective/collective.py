@@ -54,14 +54,17 @@ class GroupManager:
     @staticmethod
     def _resolve_config(config: Optional[CollectiveConfig]) -> CollectiveConfig:
         """Explicit config wins; otherwise the process-wide knobs decide
-        (``collective_compression`` / ``quant_block_bytes``), so a whole
-        deployment can flip to q8 wire without touching call sites."""
+        (``collective_compression`` / ``quant_block_bytes`` /
+        ``collective_ranks_per_host``), so a whole deployment can flip to
+        q8 wire — or the autopilot's collective policy can flip it from
+        ledgered busbw — without touching call sites."""
         if config is not None:
             return config
         from ray_tpu._private.config import _config
         return CollectiveConfig(
             compression=str(_config.get("collective_compression")),
-            quant_block_bytes=int(_config.get("quant_block_bytes")))
+            quant_block_bytes=int(_config.get("quant_block_bytes")),
+            ranks_per_host=int(_config.get("collective_ranks_per_host")))
 
     @classmethod
     def create_group(cls, backend: str, world_size: int, rank: int,

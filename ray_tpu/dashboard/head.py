@@ -174,6 +174,13 @@ class DashboardHead:
         self._thread: Optional[threading.Thread] = None
         self._host, self._want_port = host, port
         self.port: Optional[int] = None
+        #: the cluster's closed-loop controller (ray_tpu/autopilot/),
+        #: hosted here next to the plane merges it consumes; started by
+        #: start() when autopilot_enabled — after the serve thread is
+        #: already up, so the handoff needs a real guard
+        self._autopilot_lock = threading.Lock()
+        # raylint: guarded-by(self._autopilot_lock)
+        self.autopilot = None
 
     # -- API payloads ----------------------------------------------------
     def _cluster(self) -> dict:
@@ -401,6 +408,53 @@ class DashboardHead:
                 "link_flags": comms_mod.link_flags(merged["links"]),
                 "nodes": nodes, "missing_hosts": missing}
 
+    # -- autopilot -------------------------------------------------------
+    def _autopilot_snapshot(self) -> dict:
+        """The controller's tick input: the same three plane merges the
+        dashboard already serves, taken in one sweep."""
+        return {"perf": self._perf(), "goodput": self._goodput(),
+                "comms": self._comms()}
+
+    def _start_autopilot(self) -> None:
+        from ray_tpu._private.config import _config
+        if not _config.get("autopilot_enabled"):
+            return
+        from ray_tpu.autopilot import actuators as _actuators
+        from ray_tpu.autopilot.controller import Autopilot
+        from ray_tpu.autopilot.journal import Journal
+
+        def _hazard():
+            from ray_tpu.autoscaler import hazard as _hz
+            return _hz.read_fleet_rate(self.state)
+
+        with self._autopilot_lock:
+            if self.autopilot is not None:
+                return
+            _actuators.register_config_actuators()
+            self.autopilot = Autopilot(
+                self._autopilot_snapshot,
+                journal=Journal(state=self.state),
+                hazard_fn=_hazard)
+            self.autopilot.start()
+        logger.info("autopilot: controller started in dashboard head")
+
+    def _autopilot_payload(self) -> dict:
+        with self._autopilot_lock:
+            ap = self.autopilot
+        if ap is None:
+            from ray_tpu.autopilot import journal as _journal
+            # controller not hosted here: serve the journal from the KV
+            # so a read-only head can still explain the knobs
+            try:
+                tail = _journal.read_from_state(self.state)[-50:]
+            except Exception as e:  # noqa: BLE001 — state KV may be gone
+                logger.debug("autopilot journal read failed: %s", e)
+                tail = []
+            return {"ts": time.time(), "enabled": False, "journal": tail}
+        status = ap.status()
+        status.update({"ts": time.time(), "enabled": True})
+        return status
+
     def _profile_snapshots(self, host: str = "") -> "tuple[dict, list]":
         """({host_label: cumulative profile}, missing) — the head's own
         sampler plus each alive daemon's (NODE_DEBUG include_stacks
@@ -559,6 +613,8 @@ class DashboardHead:
                         self._json(head._goodput())
                     elif route == "/api/comms":
                         self._json(head._comms())
+                    elif route == "/api/autopilot":
+                        self._json(head._autopilot_payload())
                     elif route == "/api/profile":
                         self._json(head._profile(
                             q.get("host", [""])[0],
@@ -587,9 +643,17 @@ class DashboardHead:
             target=self._httpd.serve_forever, daemon=True,
             name="dashboard-head")
         self._thread.start()
+        self._start_autopilot()
         return self.port
 
     def stop(self):
+        with self._autopilot_lock:
+            ap, self.autopilot = self.autopilot, None
+        if ap is not None:
+            try:
+                ap.stop()
+            except Exception as e:  # noqa: BLE001
+                logger.debug("autopilot stop failed: %s", e)
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

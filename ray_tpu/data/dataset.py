@@ -411,8 +411,15 @@ class Dataset:
         background thread (reference ``iter_batches(prefetch_batches=)``):
         host-side batch assembly overlaps the consumer's device step — the
         input-pipeline overlap that keeps a TPU step from waiting on
-        pandas."""
+        pandas.  At the default ``0`` the ``data_prefetch_batches`` knob
+        decides, so the autopilot's prefetch policy can deepen the
+        pipeline cluster-wide from the ledger's ``data_wait`` share
+        without touching call sites; pass a negative depth to force the
+        synchronous path regardless of the knob."""
         fmt = "pandas" if batch_format == "default" else batch_format
+        if prefetch_batches == 0:
+            from ray_tpu._private.config import _config
+            prefetch_batches = int(_config.get("data_prefetch_batches"))
 
         def gen():
             rows_iter = self.iter_rows()

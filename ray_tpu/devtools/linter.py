@@ -2330,6 +2330,72 @@ def check_metric_registry(ctx: FileContext) -> Iterator[Finding]:
 
 
 # --------------------------------------------------------------------------
+# R26: actuator bypass — autopilot-owned knobs move only through apply()
+
+_AUTOPILOT_KNOBS: Optional[frozenset] = None
+
+
+def _autopilot_owned_knobs() -> frozenset:
+    """Knob names from ``ray_tpu/autopilot/knobs.py`` (OWNED_KNOBS).
+    Same exec-don't-import contract as :func:`_metric_registry`: the
+    registry module is import-free by design, so the linter reads the
+    ownership table without dragging the runtime in."""
+    global _AUTOPILOT_KNOBS
+    if _AUTOPILOT_KNOBS is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            os.pardir, "autopilot", "knobs.py")
+        ns: Dict[str, object] = {}
+        with open(path, encoding="utf-8") as f:
+            exec(compile(f.read(), path, "exec"), ns)  # noqa: S102
+        _AUTOPILOT_KNOBS = frozenset(ns["OWNED_KNOBS"])
+    return _AUTOPILOT_KNOBS
+
+
+@rule("R26", "actuator-bypass")
+def check_actuator_bypass(ctx: FileContext) -> Iterator[Finding]:
+    """A runtime ``_config.set("<knob>", ...)`` write to an
+    autopilot-owned knob (``ray_tpu/autopilot/knobs.py``) outside the
+    guardrailed ``autopilot.actuators.apply()`` path.  Such a write
+    forks control of the knob: the controller's journal no longer
+    explains the value, its SLO watch/revert guarantee silently does
+    not cover the foreign write, and the next policy pass may fight it.
+    Tests that pin owned knobs run under the scoped allow profile in
+    ``run_static_analysis.sh``; dynamic knob names are out of scope."""
+    rel = ctx.relpath.replace("\\", "/")
+    if "autopilot" in rel.split("/"):
+        return  # the actuator layer is the single allowlisted write path
+    owned = _autopilot_owned_knobs()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "set"):
+            continue
+        is_registry = (isinstance(node.func.value, ast.Name)
+                       and _config_receiver(node.func.value.id, ctx))
+        if not is_registry:
+            is_registry = (_resolved_call_target(node, ctx)
+                           == "ray_tpu._private.config._config.set")
+        if not is_registry:
+            continue
+        arg = node.args[0]
+        if not isinstance(arg, ast.Constant) or \
+                not isinstance(arg.value, str):
+            continue  # dynamic knob name: statically unverifiable
+        if arg.value not in owned:
+            continue
+        if ctx.allowed(node.lineno, "R26", "actuator-bypass"):
+            continue
+        yield Finding(
+            "R26", "actuator-bypass", ctx.relpath, node.lineno,
+            f"'{arg.value}' is autopilot-owned (ray_tpu/autopilot/"
+            f"knobs.py): a direct _config.set bypasses the guardrailed "
+            f"actuator layer — no journal record, no bounds clamp, no "
+            f"SLO watch/revert; go through ray_tpu.autopilot.actuators"
+            f".apply()")
+
+
+# --------------------------------------------------------------------------
 # R23-R25: field-level thread-safety — whole-program lockset analysis
 #
 # All three rules consume ``ProjectIndex.field_plan()``: per shared

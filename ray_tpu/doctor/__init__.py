@@ -30,7 +30,8 @@ import sys
 import time
 from typing import Any, Dict, List, Optional
 
-__all__ = ["collect", "diagnose", "render_text", "main"]
+__all__ = ["collect", "diagnose", "explain_knob", "render_explain",
+           "render_text", "main"]
 
 
 def collect(flight_dir: Optional[str] = None,
@@ -88,6 +89,11 @@ def collect(flight_dir: Optional[str] = None,
             except Exception as e:  # noqa: BLE001
                 out["errors"].append(f"preempt: {e!r}")
                 cluster["preempt"] = None
+            try:
+                cluster["autopilot"] = _autopilot_journal(head.state)
+            except Exception as e:  # noqa: BLE001
+                out["errors"].append(f"autopilot: {e!r}")
+                cluster["autopilot"] = None
             out["cluster"] = cluster
         finally:
             head.stop()
@@ -129,6 +135,23 @@ def _preempt_signals(state) -> Dict[str, Any]:
             continue
     return {"probe_failures": probes,
             "fleet_rate_per_hour": _hazard.read_fleet_rate(state)}
+
+
+def _autopilot_journal(state) -> Dict[str, Any]:
+    """The autopilot's decision journal replayed from the state KV
+    (``autopilot`` namespace, journal.py layout): every knob change the
+    controller made, with the evidence snapshot, guardrail bounds and
+    old->new values it journaled at decision time.  This is what
+    ``--explain <knob>`` renders."""
+    from ray_tpu._private.config import _config
+    from ray_tpu.autopilot import journal as _journal
+    records = _journal.read_from_state(state)
+    window_s = float(_config.get("autopilot_flap_window_s"))
+    return {
+        "decisions": records,
+        "flapping": _journal.flap_counts(records, window_s),
+        "flap_window_s": window_s,
+    }
 
 
 def _node_states(collected: dict) -> Dict[str, str]:
@@ -521,8 +544,24 @@ def diagnose(collected: dict, straggler_factor: float = 3.0,
                                        baseline=goodput_baseline)
     comms_section = _comms_reports(collected, baseline=comms_baseline,
                                    factor=straggler_factor)
+    # A flapping knob means the autopilot and the telemetry disagree
+    # every few ticks — the controller froze it, and the operator should
+    # know which policy is oscillating.
+    autopilot_raw = cluster.get("autopilot") or {}
+    decisions = autopilot_raw.get("decisions") or []
+    flap_flags = [{"knob": k, "actuations": n}
+                  for k, n in sorted(
+                      (autopilot_raw.get("flapping") or {}).items())]
+    reverts = [d for d in decisions if d.get("action") == "reverted"]
+    autopilot_section = {
+        "decisions": decisions,
+        "reverts": reverts,
+        "flap_flags": flap_flags,
+        "flap_window_s": autopilot_raw.get("flap_window_s"),
+    }
     n_issues = (len(crashes) + len(hangs) + len(stragglers) +
                 len(missing) + len(dead_nodes) + len(probe_flags) +
+                len(flap_flags) +
                 len(perf_section["drift"]) +
                 len(goodput_section["drift"]) +
                 len(comms_section["skew_flags"]) +
@@ -535,6 +574,7 @@ def diagnose(collected: dict, straggler_factor: float = 3.0,
         "perf": perf_section,
         "goodput": goodput_section,
         "comms": comms_section,
+        "autopilot": autopilot_section,
         "crashes": crashes,
         "hangs": hangs,
         "stragglers": stragglers,
@@ -745,6 +785,26 @@ def render_text(report: dict) -> str:
                 lines.append(
                     f"  {d['group']}.{d['metric']}: {d['got']} > "
                     f"{d['baseline']} x{d['tolerance']}")
+    ap = report.get("autopilot") or {}
+    decisions = ap.get("decisions") or []
+    if decisions or ap.get("flap_flags"):
+        lines.append("")
+        lines.append(f"AUTOPILOT ({len(decisions)} journaled "
+                     "decision(s))")
+        for d in decisions[-10:]:
+            lines.append(
+                f"  {d.get('action', '?'):8s} "
+                f"{d.get('knob', '?')}: {d.get('old')} -> {d.get('new')}"
+                + (f"  ({d.get('reason')})" if d.get("reason") else ""))
+        reverts = ap.get("reverts") or []
+        if reverts:
+            lines.append(f"  {len(reverts)} change(s) auto-reverted on "
+                         "SLO regression (see --explain <knob>)")
+        for fl in ap.get("flap_flags") or []:
+            lines.append(
+                f"  FLAPPING {fl['knob']}: {fl['actuations']} actuations "
+                f"inside {ap.get('flap_window_s', 0):.0f}s — frozen by "
+                "the controller; policy and telemetry disagree")
     missing = report.get("unreachable_hosts") or []
     if missing:
         lines.append("")
@@ -771,6 +831,64 @@ def render_text(report: dict) -> str:
                      "or unreachable hosts")
     else:
         lines.append(f"verdict: {report.get('num_issues')} issue(s) found")
+    return "\n".join(lines) + "\n"
+
+
+def explain_knob(report: dict, knob: str) -> dict:
+    """Why does ``knob`` have its value?  Replays the autopilot journal
+    for one knob: every decision with its evidence snapshot, the
+    guardrail bounds in force, which changes were clamped or reverted,
+    and whether the knob is currently flap-frozen."""
+    ap = report.get("autopilot") or {}
+    decisions = [d for d in (ap.get("decisions") or [])
+                 if d.get("knob") == knob]
+    flapping = next((fl for fl in (ap.get("flap_flags") or [])
+                     if fl.get("knob") == knob), None)
+    return {
+        "knob": knob,
+        "decisions": decisions,
+        "reverts": [d for d in decisions
+                    if d.get("action") == "reverted"],
+        "current": decisions[-1].get("new") if decisions else None,
+        "flapping": flapping,
+        "flap_window_s": ap.get("flap_window_s"),
+    }
+
+
+def render_explain(explain: dict) -> str:
+    """Human-readable decision history for one knob."""
+    knob = explain.get("knob", "?")
+    decisions = explain.get("decisions") or []
+    lines = [f"ray_tpu doctor --explain {knob}"]
+    if not decisions:
+        lines.append("  no journaled decisions — the autopilot never "
+                     "touched this knob (or the journal expired)")
+        return "\n".join(lines) + "\n"
+    lines.append(f"  current value: {explain.get('current')}  "
+                 f"({len(decisions)} decision(s), "
+                 f"{len(explain.get('reverts') or [])} revert(s))")
+    if explain.get("flapping"):
+        fl = explain["flapping"]
+        lines.append(
+            f"  FLAPPING: {fl['actuations']} actuations inside "
+            f"{explain.get('flap_window_s', 0):.0f}s — frozen by the "
+            "controller; the policy and the telemetry disagree")
+    for d in decisions:
+        ts = d.get("ts")
+        stamp = (time.strftime("%H:%M:%S", time.localtime(float(ts)))
+                 if ts else "?")
+        lines.append(f"  [{stamp}] {d.get('action', '?')}: "
+                     f"{d.get('old')} -> {d.get('new')}")
+        if d.get("reason"):
+            lines.append(f"    why: {d['reason']}")
+        if d.get("bounds"):
+            lines.append(f"    guardrail bounds: {d['bounds']}")
+        ev = d.get("evidence") or {}
+        if ev:
+            body = ", ".join(f"{k}={ev[k]}" for k in sorted(ev))
+            lines.append(f"    evidence: {body}")
+        if d.get("ttl_s"):
+            lines.append(f"    claim TTL: {float(d['ttl_s']):.0f}s")
     return "\n".join(lines) + "\n"
 
 
@@ -809,6 +927,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "({job: {goodput_pct: floor, "
                              "restart_downtime_s: ceiling, tolerance: "
                              "1.0}}); budget violations count as issues")
+    parser.add_argument("--explain", default=None, metavar="KNOB",
+                        help="render the autopilot's decision journal "
+                             "for one knob: evidence, guardrail bounds, "
+                             "reverts and flap state (with --json the "
+                             "explanation is embedded under 'explain')")
     parser.add_argument("--comms-baseline", default=None,
                         help="JSON file of per-group comms budgets "
                              "({group: {allreduce_gbps: floor, "
@@ -840,12 +963,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     except Exception as e:  # noqa: BLE001
         print(f"doctor: collection failed: {e!r}", file=sys.stderr)
         return 2
+    explain = None
+    if args.explain:
+        explain = explain_knob(report, args.explain)
+        report["explain"] = explain
     if args.out:
         from ray_tpu.checkpoint.manifest import atomic_write_bytes
         atomic_write_bytes(args.out,
                            json.dumps(report, indent=2).encode())
     if args.json:
         print(json.dumps(report, indent=2))
+    elif explain is not None:
+        print(render_explain(explain), end="")
     else:
         print(render_text(report), end="")
     return 0

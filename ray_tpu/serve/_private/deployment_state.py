@@ -85,6 +85,22 @@ class DeploymentState:
         self.deleting = True
         self.target_replicas = 0
 
+    def retune_batch(self, **cfg: Any) -> None:
+        """Push a batch-config delta (linger, cap, pad buckets) to every
+        live replica AND into the target config, so replicas started
+        later inherit the retuned shape.  This is the serve actuator's
+        write path — the autopilot tunes linger here from the federated
+        ``serve.queue_wait`` p95, journaled like every other knob."""
+        for key, value in cfg.items():
+            if hasattr(self.config, key):
+                setattr(self.config, key, value)
+        for info in self.replicas:
+            try:
+                ray_tpu.get(info.handle.set_batch_config.remote(dict(cfg)))
+            except Exception as e:  # noqa: BLE001 — next reconcile replaces
+                logger.warning("batch retune of %s failed: %s",
+                               info.tag, e)
+
     # -- reconciliation ---------------------------------------------------
 
     def _start_replica(self) -> ReplicaInfo:

@@ -106,9 +106,14 @@ class _ReplicaBatcher:
 
     def __init__(self, replica: "Replica", cfg: dict):
         self._replica = replica
+        # the batch shape is retune()-able live (autopilot serve policy),
+        # so the flush loop reads it under the same lock as the queue
+        # raylint: guarded-by(self._lock)
         self._max = max(1, int(cfg.get("max_batch_size", 1)))
+        # raylint: guarded-by(self._lock)
         self._wait_s = float(cfg.get("batch_wait_timeout_s", 0.005))
         pad = cfg.get("pad_batch_to")
+        # raylint: guarded-by(self._lock)
         self._buckets = tuple(sorted(int(b) for b in pad)) if pad else None
         self._lock = threading.Lock()
         self._queue: List[_BatchSlot] = []  # raylint: guarded-by(self._lock)
@@ -119,6 +124,21 @@ class _ReplicaBatcher:
     def depth(self) -> int:
         with self._lock:
             return len(self._queue)
+
+    def retune(self, cfg: dict) -> None:
+        """Live-update the batch shape (autopilot serve policy): the
+        next flush cycle reads the new linger/cap; requests already
+        parked keep their slots — nothing is dropped on a retune."""
+        with self._lock:
+            if "max_batch_size" in cfg:
+                self._max = max(1, int(cfg["max_batch_size"]))
+            if "batch_wait_timeout_s" in cfg:
+                self._wait_s = max(0.0, float(cfg["batch_wait_timeout_s"]))
+            if "pad_batch_to" in cfg:
+                pad = cfg["pad_batch_to"]
+                self._buckets = (tuple(sorted(int(b) for b in pad))
+                                 if pad else None)
+        self._wakeup.set()
 
     def submit(self, item) -> Any:
         slot = _BatchSlot(item)
@@ -143,7 +163,8 @@ class _ReplicaBatcher:
         """Latency-guarded batch-size cap: never form a batch whose
         EWMA-predicted execution time (items × per-item estimate) would
         blow the replica's latency budget."""
-        want = self._max
+        with self._lock:
+            want = self._max
         budget = self._replica._batch_budget_ms()
         with self._replica._lock:
             ewma = self._replica._ewma_item_ms
@@ -165,12 +186,13 @@ class _ReplicaBatcher:
                     depth = len(self._queue)
                     oldest = (self._queue[0].t_enqueue
                               if self._queue else None)
+                    wait_s = self._wait_s
                 if oldest is None:
                     break
                 if (depth >= cap
-                        or time.monotonic() - oldest >= self._wait_s):
+                        or time.monotonic() - oldest >= wait_s):
                     break
-                time.sleep(min(0.0005, max(self._wait_s / 10.0, 1e-4)))
+                time.sleep(min(0.0005, max(wait_s / 10.0, 1e-4)))
             deadline_ms = float(_config.get("serve_queue_deadline_ms"))
             expired: List[_BatchSlot] = []
             with self._lock:
@@ -204,7 +226,9 @@ class _ReplicaBatcher:
 
     def _call(self, items: List[Any]) -> List[Any]:
         n = len(items)
-        padded = pad_items(list(items), self._buckets)
+        with self._lock:
+            buckets = self._buckets
+        padded = pad_items(list(items), buckets)
         results = list(self._replica._invoke_batch(padded))[:n]
         if len(results) != n:
             raise ValueError(
@@ -347,6 +371,18 @@ class Replica:
             reconfigure = getattr(self._callable, "reconfigure", None)
             if reconfigure is not None:
                 reconfigure(user_config)
+
+    def set_batch_config(self, cfg: dict) -> None:
+        """Merge a batch-config delta into the live batcher (the
+        controller's ``retune_deployment_batch`` fan-out target)."""
+        merged = dict(self._batch_cfg or {})
+        merged.update(cfg or {})
+        self._batch_cfg = merged
+        batcher = self._batcher
+        if batcher is not None:
+            batcher.retune(merged)
+        elif int(merged.get("max_batch_size", 1)) > 1:
+            self._batcher = self._build_batcher()
 
     def handle_request(self, method_name: str, args, kwargs) -> Any:
         with self._lock:

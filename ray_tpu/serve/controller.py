@@ -63,8 +63,10 @@ class ServeController:
                     ROUTE_TABLE_KEY, dict(self._routes))
             state.reconcile()
             self._notify_replicas(state)
+        self._register_autopilot_actuators(name, config)
 
     def delete_deployment(self, name: str) -> None:
+        self._unregister_autopilot_actuators(name)
         with self._lock:
             state = self._deployments.get(name)
             if state is None:
@@ -78,6 +80,61 @@ class ServeController:
             self._routes = {p: d for p, d in self._routes.items()
                             if d != name}
             self._long_poll.notify_changed(ROUTE_TABLE_KEY, dict(self._routes))
+
+    def retune_deployment_batch(self, name: str, **cfg: Any) -> None:
+        """Live batch retune (autopilot serve actuator target): pushes
+        the delta to every running replica and into the target config."""
+        with self._lock:
+            state = self._deployments.get(name)
+            if state is None:
+                raise KeyError(f"No deployment named {name!r}")
+            state.retune_batch(**cfg)
+
+    # -- autopilot actuators ----------------------------------------------
+
+    def _register_autopilot_actuators(self, name: str, config) -> None:
+        """Expose the deployment's micro-batch shape to the autopilot:
+        ``serve.<name>.linger_ms`` (the batch linger window, actuated
+        from the federated queue_wait p95) and
+        ``serve.<name>.max_batch_size``.  Only batched deployments are
+        exposed, and only when the controller is autopilot-enabled —
+        unregistered knobs are invisible to the policy layer."""
+        if getattr(config, "max_batch_size", 1) <= 1 \
+                or not _config.get("autopilot_enabled"):
+            return
+        from ray_tpu.autopilot import actuators as _actuators
+
+        def _get_linger(n=name):
+            with self._lock:
+                state = self._deployments.get(n)
+                return (float(state.config.batch_wait_timeout_s) * 1e3
+                        if state else 0.0)
+
+        def _set_linger(ms, n=name):
+            self.retune_deployment_batch(
+                n, batch_wait_timeout_s=float(ms) / 1e3)
+
+        def _get_max(n=name):
+            with self._lock:
+                state = self._deployments.get(n)
+                return int(state.config.max_batch_size) if state else 1
+
+        def _set_max(v, n=name):
+            self.retune_deployment_batch(n, max_batch_size=int(v))
+
+        reg = _actuators.registry()
+        reg.register(_actuators.Actuator(
+            name=f"serve.{name}.linger_ms", get=_get_linger,
+            set=_set_linger, kind="float", lo=1.0, hi=1000.0))
+        reg.register(_actuators.Actuator(
+            name=f"serve.{name}.max_batch_size", get=_get_max,
+            set=_set_max, kind="int", lo=1, hi=1024))
+
+    def _unregister_autopilot_actuators(self, name: str) -> None:
+        from ray_tpu.autopilot import actuators as _actuators
+        reg = _actuators.registry()
+        reg.unregister(f"serve.{name}.linger_ms")
+        reg.unregister(f"serve.{name}.max_batch_size")
 
     def _membership_info(self, state: DeploymentState,
                          metrics: Optional[dict] = None) -> dict:

@@ -155,6 +155,30 @@ echo "== preemption-storm gate (fleet churn: predictive drains + gang replacemen
 JAX_PLATFORMS=cpu \
 python -m pytest tests/test_churn.py -q
 
+echo "== autopilot gate (closed-loop retune: guardrails + A/B drill) =="
+# The autopilot must (a) pass its guardrail suite — bounds clamp,
+# journaled SLO revert, flap freeze, chaos-faulted actuation leaving the
+# previous value intact — and (b) win its A/B acceptance drill: the same
+# 24-step virtual workload under the same fixed seeded chaos schedule
+# (a starved reader + a skewed collective rank), with the controller off
+# and on, merged through the real goodput ledger. The gain must be
+# strictly positive and every knob change journaled with evidence;
+# bench_micro gates the same number as autopilot_goodput_gain_pct below.
+JAX_PLATFORMS=cpu \
+python -m pytest tests/test_autopilot.py -q
+JAX_PLATFORMS=cpu \
+python - <<'EOF'
+from ray_tpu.autopilot import drill
+ab = drill.run_ab()
+print(f"autopilot A/B drill: off {ab['off']['goodput_pct']:.2f}% -> "
+      f"on {ab['on']['goodput_pct']:.2f}% "
+      f"(gain {ab['gain_pct']:+.2f} points, "
+      f"{len(ab['on']['journal'])} journaled decisions)")
+assert ab["gain_pct"] > 0, "autopilot arm did not win the A/B drill"
+assert all(r["evidence"] for r in ab["on"]["journal"]), \
+    "unevidenced journal record"
+EOF
+
 echo "== bench regression gate (bench_micro --check vs tracked baseline) =="
 # Throughput must stay within --tolerance of BENCH_MICRO.json; latency
 # (_us) metrics are inverted. Cluster metrics are skipped automatically

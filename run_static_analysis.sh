@@ -6,7 +6,7 @@
 #      shipped fixture corpus round-trips expected.json exactly, and the
 #      machine-readable `--rules` listing is cross-checked against this
 #      header and the ARCHITECTURE.md rule table so neither can drift.
-#   1. raylint — the framework-aware AST linter (R1..R25, including the
+#   1. raylint — the framework-aware AST linter (R1..R26, including the
 #      whole-program call-graph rules, the path-sensitive dataflow
 #      rules, the cross-process stitched-graph rules, and the
 #      field-level thread-safety rules R23-R25) over
@@ -81,8 +81,9 @@ t0=$SECONDS
 st=OK
 # tests/ allow profile: test code legitimately pokes checkpoint
 # directories (R9), simulates rank-divergent schedules on purpose (R12),
-# registers throwaway metrics (R22), and hammers shared state from
-# deliberately-racing helper threads (R23-R25); scoped here so
+# registers throwaway metrics (R22), hammers shared state from
+# deliberately-racing helper threads (R23-R25), and pins autopilot-owned
+# knobs to build deterministic scenarios (R26); scoped here so
 # production code can never ride on it.
 LINT_JSON="$(mktemp /tmp/raytpu_lint.XXXXXX.json)"
 LINT_ERR="$(mktemp /tmp/raytpu_lint.XXXXXX.err)"
@@ -90,7 +91,7 @@ LINT_ERR="$(mktemp /tmp/raytpu_lint.XXXXXX.err)"
 # clean tree), for editor/code-scanning ingestion
 LINT_SARIF="${RAYLINT_SARIF_OUT:-/tmp/raytpu_lint.sarif.json}"
 if python -m ray_tpu.devtools.lint ray_tpu bench.py bench_micro.py tests \
-     --allow-in "tests/:R9,R12,R22,R23,R24,R25" --json --sarif "$LINT_SARIF" \
+     --allow-in "tests/:R9,R12,R22,R23,R24,R25,R26" --json --sarif "$LINT_SARIF" \
      > "$LINT_JSON" 2> "$LINT_ERR"; then
   python - "$LINT_JSON" <<'EOF'
 import json, sys
@@ -120,7 +121,7 @@ rm -f "$LINT_JSON" "$LINT_ERR"
 stage_done "stage 1 (raylint)" "$t0" "$st"
 STAGE_TIMES+=("stage 1 cache: ${CACHE_LINE#raylint-cache: }")
 STAGE_TIMES+=("stage 1 rule times: ${TIMES_LINE#raylint-times: }")
-# Budget check against the recorded cold-cache baseline (full R1..R25
+# Budget check against the recorded cold-cache baseline (full R1..R26
 # run over the widened file set, incl. the stitch pass and the R23-R25
 # field plan, 2026-08): a >50% overshoot means a rule regressed into
 # super-linear work or the cache stopped landing.

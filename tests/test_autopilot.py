@@ -1,0 +1,444 @@
+"""Autopilot: policies, the guardrailed actuator layer, the SLO
+watch/revert loop, the decision journal, the doctor's --explain surface,
+and the A/B acceptance drill.
+
+Everything here runs against private actuator registries and dict-backed
+knob stores (never the process ``_config``), with virtual clocks — the
+same isolation the drill uses — so the suite is deterministic and leaves
+no knob moved behind it.
+"""
+
+import json
+
+import pytest
+
+from ray_tpu import chaos
+from ray_tpu._private.config import _config
+from ray_tpu.autopilot import actuators, drill, journal as journal_mod
+from ray_tpu.autopilot import policies
+from ray_tpu.autopilot.controller import Autopilot, slo_value
+from ray_tpu.autopilot.journal import (APPLIED, CLAMPED, FAILED, REJECTED,
+                                       REVERTED, Decision, Journal,
+                                       flap_counts, read_from_state)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def make_registry(store=None):
+    store = store if store is not None else dict(drill.DRILL_KNOBS)
+    reg = actuators.ActuatorRegistry()
+    actuators.register_config_actuators(reg=reg, store=store)
+    return reg, store
+
+
+def goodput_snapshot(compute, data_wait, wall=100.0):
+    """Minimal controller snapshot: one ledger job, no comms/perf."""
+    return {"goodput": {"jobs": {"train": {
+        "wall_s": wall,
+        "cats": {"compute": compute, "data_wait": data_wait}}}}}
+
+
+# -- actuator layer ---------------------------------------------------------
+
+def test_apply_clamps_to_bounds():
+    reg, store = make_registry()
+    j = Journal(clock=FakeClock())
+    spec = drill.DRILL_KNOBS
+    assert spec["data_streams_per_peer"] == 1
+    dec = actuators.apply("data_streams_per_peer", 10_000, {"why": "test"},
+                          journal=j, reg=reg)
+    hi = reg.get("data_streams_per_peer").hi
+    assert store["data_streams_per_peer"] == hi
+    assert dec.action == CLAMPED
+    assert dec.new == hi
+    assert dec.bounds == [reg.get("data_streams_per_peer").lo, hi]
+    # and below the floor clamps up
+    dec = actuators.apply("data_streams_per_peer", -3, {}, journal=j,
+                          reg=reg)
+    assert store["data_streams_per_peer"] == \
+        reg.get("data_streams_per_peer").lo
+    assert dec.action == CLAMPED
+
+
+def test_apply_rejects_bad_enum_and_unknown_knob():
+    reg, store = make_registry()
+    j = Journal(clock=FakeClock())
+    with pytest.raises(ValueError):
+        actuators.apply("collective_compression", "zstd", {}, journal=j,
+                        reg=reg)
+    assert store["collective_compression"] == "none"  # untouched
+    with pytest.raises(KeyError):
+        actuators.apply("no_such_knob", 1, {}, journal=j, reg=reg)
+    assert [d.action for d in j.records()] == [REJECTED, REJECTED]
+
+
+def test_apply_noop_is_not_journaled():
+    reg, store = make_registry()
+    j = Journal(clock=FakeClock())
+    assert actuators.apply("data_prefetch_batches",
+                           store["data_prefetch_batches"], {},
+                           journal=j, reg=reg) is None
+    assert j.records() == []
+
+
+def test_apply_chaos_fault_leaves_previous_value_intact():
+    """An injected fault at the actuation choke point must restore the
+    old value and journal ``failed`` — a half-applied decision can
+    never survive."""
+    reg, store = make_registry()
+    j = Journal(clock=FakeClock())
+    prev_schedule = chaos.schedule()
+    chaos.configure(7, "autopilot.apply@1=error")
+    try:
+        with pytest.raises(RuntimeError):
+            actuators.apply("data_streams_per_peer", 4, {"src": "chaos"},
+                            journal=j, reg=reg)
+        assert store["data_streams_per_peer"] == 1  # previous value intact
+        recs = j.records()
+        assert [d.action for d in recs] == [FAILED]
+        assert recs[0].old == 1 and recs[0].new == 4
+        # the @1 trigger fired once: the retry lands clean
+        dec = actuators.apply("data_streams_per_peer", 4, {"src": "retry"},
+                              journal=j, reg=reg)
+        assert dec.action == APPLIED
+        assert store["data_streams_per_peer"] == 4
+    finally:
+        if prev_schedule is not None:
+            chaos.install(prev_schedule)
+        else:
+            chaos.clear()
+
+
+# -- controller: watch, revert, freeze --------------------------------------
+
+def test_slo_regression_triggers_journaled_revert():
+    """Synthetic regression: the prefetch policy fires, the next tick's
+    telemetry shows goodput down >revert_pct vs the pre-change baseline,
+    and the controller rolls the knob back within that one watch tick."""
+    reg, store = make_registry()
+    clock = FakeClock()
+    j = Journal(clock=clock)
+    pilot = Autopilot(lambda: {}, journal=j, reg=reg, clock=clock)
+
+    # data_wait is 20% of wall: prefetch_policy proposes 0 -> 2
+    decisions = pilot.tick(goodput_snapshot(compute=80.0, data_wait=20.0))
+    assert [d.knob for d in decisions] == ["data_prefetch_batches"]
+    assert store["data_prefetch_batches"] == 2
+    baseline = slo_value(goodput_snapshot(80.0, 20.0), {"kind": "goodput_pct"})
+    assert baseline == pytest.approx(80.0)
+
+    # next tick: goodput collapsed to 60% (> 5% regression) -> revert
+    clock.t += 10.0
+    decisions = pilot.tick(goodput_snapshot(compute=60.0, data_wait=5.0))
+    assert [d.action for d in decisions] == [REVERTED]
+    assert store["data_prefetch_batches"] == 0
+    rev = decisions[0]
+    assert rev.old == 2 and rev.new == 0
+    assert rev.evidence["baseline"] == pytest.approx(80.0)
+    assert rev.evidence["observed"] == pytest.approx(60.0)
+    assert pilot.status()["watches"] == []  # the experiment is closed
+
+
+def test_watch_retires_after_window_without_revert():
+    reg, store = make_registry()
+    clock = FakeClock()
+    pilot = Autopilot(lambda: {}, journal=Journal(clock=clock), reg=reg,
+                      clock=clock)
+    pilot.tick(goodput_snapshot(compute=80.0, data_wait=20.0))
+    assert store["data_prefetch_batches"] == 2
+    assert len(pilot.status()["watches"]) == 1
+    # goodput holds at baseline: the change is kept, the watch expires
+    for _ in range(int(_config.get("autopilot_watch_ticks"))):
+        clock.t += 1.0
+        assert pilot.tick(goodput_snapshot(compute=80.0, data_wait=5.0)) == []
+    assert pilot.status()["watches"] == []
+    assert store["data_prefetch_batches"] == 2
+
+
+def test_flap_freeze_blocks_oscillating_knob():
+    reg, store = make_registry()
+    clock = FakeClock()
+    j = Journal(clock=clock)
+    for val in (2, 0, 2):  # three actuations inside the flap window
+        j.record(Decision(knob="data_prefetch_batches", old=0, new=val,
+                          action=APPLIED))
+    pilot = Autopilot(lambda: {}, journal=j, reg=reg, clock=clock)
+    assert pilot.tick(goodput_snapshot(compute=80.0, data_wait=20.0)) == []
+    assert store["data_prefetch_batches"] == 0  # frozen, not re-actuated
+    assert "data_prefetch_batches" in pilot.status()["flapping"]
+
+
+def test_max_changes_per_tick_budget():
+    reg, store = make_registry()
+    clock = FakeClock()
+    pilot = Autopilot(lambda: {}, journal=Journal(clock=clock), reg=reg,
+                      clock=clock)
+    # data_wait >10% (prefetch) + hazard feed (cadence) + clean saturated
+    # links (transport): three eligible policies, budget of two
+    snapshot = goodput_snapshot(compute=70.0, data_wait=20.0)
+    snapshot["hazard_rate_per_hour"] = 6.0
+    snapshot["cadence_inputs"] = {"step_cost_s": 1.0, "ckpt_cost_s": 0.5}
+    snapshot["comms"] = {"links": {"a|b": {
+        "bytes": 10 * 2 ** 30, "seconds": 1.0, "chunks": 64,
+        "retries": 0, "failovers": 0}}}
+    decisions = pilot.tick(snapshot)
+    assert len(decisions) == int(_config.get("autopilot_max_changes_per_tick"))
+
+
+# -- policies ---------------------------------------------------------------
+
+def test_serve_batch_policy_halves_linger():
+    budget = float(_config.get("serve_target_latency_ms"))
+    snapshot = {"perf": {"cluster": {
+        "serve.queue_wait": {"count": 32.0, "p95_ms": 0.8 * budget},
+        "serve.execute": {"count": 32.0, "p50_ms": 2.0}}}}
+    out = policies.serve_batch_policy(snapshot, lambda k: 40.0,
+                                      ["serve.d.linger_ms"])
+    assert [p["value"] for p in out] == [20.0]
+    assert out[0]["slo"] == {"kind": "perf_p95", "hist": "serve.queue_wait"}
+    assert out[0]["evidence"]["queue_wait_p95_ms"] == 0.8 * budget
+    # under half the budget: leave the operator's linger alone
+    snapshot["perf"]["cluster"]["serve.queue_wait"]["p95_ms"] = 0.4 * budget
+    assert policies.serve_batch_policy(snapshot, lambda k: 40.0,
+                                       ["serve.d.linger_ms"]) == []
+    # at the floor there is nothing left to shrink
+    snapshot["perf"]["cluster"]["serve.queue_wait"]["p95_ms"] = 0.8 * budget
+    assert policies.serve_batch_policy(snapshot, lambda k: 1.0,
+                                       ["serve.d.linger_ms"]) == []
+
+
+def test_transport_policy_failover_vs_clean_links():
+    def get(knob):
+        return {"fetch_chunk_bytes": 4 * 2 ** 20,
+                "data_streams_per_peer": 2}[knob]
+    link = {"bytes": 2 ** 30, "seconds": 1.0, "chunks": 64,
+            "retries": 0, "failovers": 0}
+    # failover: halve the re-ship unit
+    bad = dict(link, failovers=3)
+    out = policies.transport_policy({"comms": {"links": {"a|b": bad}}}, get)
+    assert [(p["knob"], p["value"]) for p in out] == \
+        [("fetch_chunk_bytes", 2 * 2 ** 20)]
+    # clean and saturated (64 chunks >= 4*2 streams*1 link): add a lane
+    out = policies.transport_policy({"comms": {"links": {"a|b": link}}}, get)
+    assert [(p["knob"], p["value"]) for p in out] == \
+        [("data_streams_per_peer", 3)]
+    # retries mean stress: neither grow nor shrink
+    assert policies.transport_policy(
+        {"comms": {"links": {"a|b": dict(link, retries=2)}}}, get) == []
+
+
+def _slow_group(busbw):
+    return {"groups": {"g": {"world_size": 8, "ops": {"allreduce": {
+        "count": 4, "bytes": 2 ** 30, "busbw_gbps": busbw,
+        "compression_ratio": 1.0}}}}}
+
+
+def test_collective_policy_quantize_then_hierarchy():
+    floor = float(_config.get("autopilot_busbw_floor_gbps"))
+    store = {"collective_compression": "none", "collective_ranks_per_host": 0}
+    out = policies.collective_policy({"comms": _slow_group(floor / 2)},
+                                     store.__getitem__)
+    assert [(p["knob"], p["value"]) for p in out] == \
+        [("collective_compression", "q8")]
+    assert out[0]["evidence"]["busbw_floor_gbps"] == floor
+    # already quantized and still slow: cross the seam hierarchically
+    store["collective_compression"] = "q8"
+    out = policies.collective_policy({"comms": _slow_group(floor / 2)},
+                                     store.__getitem__)
+    assert [(p["knob"], p["value"]) for p in out] == \
+        [("collective_ranks_per_host", 2)]
+    # fp8's rel err only fits a loosened budget, and only under floor/2
+    was = _config.get("autopilot_rel_err_budget")
+    _config.set("autopilot_rel_err_budget", 2e-2)
+    try:
+        out = policies.collective_policy({"comms": _slow_group(floor / 4)},
+                                         store.__getitem__)
+        assert [(p["knob"], p["value"]) for p in out] == \
+            [("collective_compression", "fp8")]
+    finally:
+        _config.set("autopilot_rel_err_budget", was)
+    # healthy busbw: no proposal at all
+    assert policies.collective_policy({"comms": _slow_group(floor * 2)},
+                                      store.__getitem__) == []
+
+
+def test_prefetch_policy_grows_and_gives_back():
+    grow = policies.prefetch_policy(goodput_snapshot(70.0, 20.0),
+                                    lambda k: 2)
+    assert [(p["knob"], p["value"]) for p in grow] == \
+        [("data_prefetch_batches", 4)]
+    shrink = policies.prefetch_policy(goodput_snapshot(99.5, 0.5),
+                                      lambda k: 2)
+    assert [(p["knob"], p["value"]) for p in shrink] == \
+        [("data_prefetch_batches", 1)]
+    assert policies.prefetch_policy(goodput_snapshot(95.0, 5.0),
+                                    lambda k: 2) == []
+
+
+def test_cadence_policy_solves_young_daly():
+    from ray_tpu.checkpoint.cadence import solve_interval_steps
+    snapshot = {"hazard_rate_per_hour": 6.0,
+                "cadence_inputs": {"step_cost_s": 1.0, "ckpt_cost_s": 0.5,
+                                   "restart_cost_s": 0.0}}
+    out = policies.cadence_policy(snapshot, lambda k: 0)
+    want = solve_interval_steps(6.0, 1.0, 0.5)
+    assert [(p["knob"], p["value"]) for p in out] == \
+        [("checkpoint_cadence_autopilot_steps", want)]
+    assert out[0]["evidence"]["solved_interval_steps"] == want
+    # no hazard feed: local control keeps the knob
+    assert policies.cadence_policy(
+        {"cadence_inputs": {"step_cost_s": 1.0}}, lambda k: 0) == []
+
+
+def test_cadence_override_clamped_by_operator_bounds():
+    from ray_tpu.checkpoint.cadence import CadenceController
+    was = _config.get("checkpoint_cadence_autopilot_steps")
+    ctrl = CadenceController(hazard_source=lambda: 0.0, min_steps=5,
+                             max_steps=100)
+    try:
+        _config.set("checkpoint_cadence_autopilot_steps", 10_000)
+        assert ctrl.interval_steps() == 100
+        _config.set("checkpoint_cadence_autopilot_steps", 2)
+        assert ctrl.interval_steps() == 5
+        _config.set("checkpoint_cadence_autopilot_steps", 24)
+        assert ctrl.interval_steps() == 24
+    finally:
+        _config.set("checkpoint_cadence_autopilot_steps", was)
+
+
+# -- journal ----------------------------------------------------------------
+
+def test_journal_kv_roundtrip_skips_malformed():
+    class FakeState:
+        def __init__(self):
+            self.kv = {}
+
+        def kv_put(self, key, value, overwrite=True, namespace=b""):
+            self.kv[(namespace, bytes(key))] = bytes(value)
+
+        def kv_keys(self, prefix=b"", namespace=b""):
+            return [k for (ns, k) in self.kv
+                    if ns == namespace and k.startswith(prefix)]
+
+        def kv_get(self, key, namespace=b""):
+            return self.kv.get((namespace, bytes(key)))
+
+    state = FakeState()
+    clock = FakeClock()
+    j = Journal(state=state, clock=clock)
+    for i, val in enumerate((2, 4), start=1):
+        clock.t += 1.0
+        j.record(Decision(knob="data_prefetch_batches", old=val - 2,
+                          new=val, evidence={"tick": i}))
+    state.kv[(journal_mod.NAMESPACE,
+              journal_mod.DECISION_PREFIX + b"0000000000000:000099")] = \
+        b"not json"
+    recs = read_from_state(state)
+    assert [(r["old"], r["new"]) for r in recs] == [(0, 2), (2, 4)]
+    assert recs[0]["evidence"] == {"tick": 1}
+    # the knob:<name> latest pointer tracks the newest record
+    latest = json.loads(state.kv_get(
+        journal_mod.KNOB_PREFIX + b"data_prefetch_batches",
+        namespace=journal_mod.NAMESPACE))
+    assert latest["new"] == 4
+    assert read_from_state(state, knob="nope") == []
+
+
+def test_flap_counts_window_and_verbs():
+    now = 1000.0
+    recs = [{"knob": "k", "action": APPLIED, "ts": now - 10},
+            {"knob": "k", "action": REVERTED, "ts": now - 5},
+            {"knob": "k", "action": CLAMPED, "ts": now - 1},
+            {"knob": "k", "action": REJECTED, "ts": now},       # not a change
+            {"knob": "k", "action": APPLIED, "ts": now - 999},  # outside
+            {"knob": "quiet", "action": APPLIED, "ts": now}]
+    assert flap_counts(recs, window_s=60.0, threshold=3, now=now) == {"k": 3}
+    assert flap_counts(recs, window_s=60.0, threshold=4, now=now) == {}
+
+
+# -- doctor explain ---------------------------------------------------------
+
+def test_doctor_explain_knob_renders_journal():
+    from ray_tpu.doctor import explain_knob, render_explain
+    decisions = [
+        {"knob": "data_streams_per_peer", "old": 1, "new": 4,
+         "action": "applied", "reason": "clean chunks over 1 stream",
+         "evidence": {"chunks": 64}, "bounds": [1, 16], "ttl_s": 600.0,
+         "ts": 1000.0},
+        {"knob": "data_streams_per_peer", "old": 4, "new": 1,
+         "action": "reverted", "reason": "SLO regressed",
+         "evidence": {"baseline": 80.0, "observed": 60.0},
+         "bounds": [1, 16], "ts": 1010.0},
+        {"knob": "other", "old": 0, "new": 2, "action": "applied",
+         "ts": 1020.0},
+    ]
+    report = {"autopilot": {
+        "decisions": decisions,
+        "flap_flags": [{"knob": "data_streams_per_peer", "actuations": 4}],
+        "flap_window_s": 600.0}}
+    ex = explain_knob(report, "data_streams_per_peer")
+    assert len(ex["decisions"]) == 2
+    assert len(ex["reverts"]) == 1
+    assert ex["current"] == 1
+    assert ex["flapping"]["actuations"] == 4
+    text = render_explain(ex)
+    assert "1 -> 4" in text and "4 -> 1" in text
+    assert "why: SLO regressed" in text
+    assert "guardrail bounds: [1, 16]" in text
+    assert "chunks=64" in text
+    assert "FLAPPING" in text
+    # a knob the autopilot never touched says so instead of erroring
+    empty = render_explain(explain_knob(report, "untouched_knob"))
+    assert "no journaled decisions" in empty
+
+
+# -- the A/B acceptance drill ------------------------------------------------
+
+def test_drill_chaos_spec_is_golden():
+    """The acceptance schedule everyone reasons about is the one that
+    executes — and its points exist in the drill runtime."""
+    assert drill.DRILL_SEED == 1303
+    assert drill.DRILL_CHAOS_SPEC == \
+        "drill.reader@1+=drop;drill.collective[rank=1]@1+=drop"
+
+
+def test_drill_ab_autopilot_wins_and_journals_everything():
+    ab = drill.run_ab()
+    assert ab["gain_pct"] > 0
+    assert ab["on"]["goodput_pct"] > ab["off"]["goodput_pct"]
+    # the OFF arm never moved a knob
+    assert ab["off"]["journal"] == []
+    assert ab["off"]["knobs"]["data_streams_per_peer"] == 1
+    # every ON-arm change is journaled with evidence, bounds and a verb
+    recs = ab["on"]["journal"]
+    assert recs, "autopilot arm journaled nothing"
+    for rec in recs:
+        assert rec["action"] in (APPLIED, CLAMPED, REVERTED, FAILED,
+                                 REJECTED)
+        assert rec["evidence"], f"unevidenced decision: {rec}"
+        assert rec["bounds"] is not None
+        assert rec["reason"]
+    touched = {r["knob"] for r in recs}
+    # each tentpole loop fired: serve linger, transport, collective
+    # compression + hierarchy, prefetch, and the migrated cadence loop
+    assert {drill.LINGER_KNOB, "data_streams_per_peer",
+            "collective_compression", "collective_ranks_per_host",
+            "data_prefetch_batches",
+            "checkpoint_cadence_autopilot_steps"} <= touched
+    # the serve loop actually moved the observed queue tail
+    assert ab["on"]["queue_p95_ms"][-1] < ab["on"]["queue_p95_ms"][0]
+    assert ab["off"]["queue_p95_ms"][-1] == ab["off"]["queue_p95_ms"][0]
+    # and the ledger shows WHERE the wins came from
+    assert ab["on"]["cats"]["data_wait"] < ab["off"]["cats"]["data_wait"]
+    assert ab["on"]["cats"]["collective_wait"] < \
+        ab["off"]["cats"]["collective_wait"]
+
+
+def test_drill_is_deterministic():
+    assert drill.run_ab()["gain_pct"] == drill.run_ab()["gain_pct"]

@@ -1607,7 +1607,7 @@ def test_sarif_log_covers_all_rules_and_anchors_findings():
     assert log["version"] == "2.1.0"
     run = log["runs"][0]
     rules = run["tool"]["driver"]["rules"]
-    assert {r["id"] for r in rules} == {f"R{i}" for i in range(1, 26)}
+    assert {r["id"] for r in rules} == {f"R{i}" for i in range(1, 27)}
     for r in rules:
         assert r["fullDescription"]["text"], r["id"]
         assert r["helpUri"].startswith("ARCHITECTURE.md#"), r["id"]
