@@ -1,0 +1,51 @@
+"""R27 fixture: mesh/spec consistency over the abstract sharding model.
+
+Positive cases: ``BAD_RULES`` maps a logical axis to a mesh axis no mesh
+declares, ``BAD_AXIS_SPEC`` names an unknown mesh axis, ``DUP_SPEC``
+binds one mesh axis to two dims of a single PartitionSpec, ``build``
+passes a 2-spec ``in_specs`` to a 3-argument mapped function, and
+``make_specs``/``override`` use a logical name absent from every rules
+table / an unknown override mesh axis.  Clean twins mirror each case
+with valid axes.
+"""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu._private.jax_compat import shard_map
+
+AXIS_ORDER = ("data", "tensor")
+
+RULES = {"batch": "data", "mlp": "tensor"}
+BAD_RULES = {"embed": "fsdp_typo"}
+
+GOOD_SPEC = P("data", "tensor")
+GOOD_TUPLE_SPEC = P(("data", "tensor"), None)
+BAD_AXIS_SPEC = P("data", "rows")
+DUP_SPEC = P("data", "data")
+
+
+def _body3(a, b, c):
+    return jax.lax.psum(a, "data")
+
+
+def build(mesh):
+    good = shard_map(_body3, mesh=mesh,
+                     in_specs=(P("data"), P(), P("tensor")),
+                     out_specs=P("data"), check_vma=False)
+    bad = shard_map(_body3, mesh=mesh,
+                    in_specs=(P("data"), P()),
+                    out_specs=P("data"), check_vma=False)
+    return good, bad
+
+
+def make_specs(rules):
+    ok = rules.spec(("batch", "mlp"))
+    bad = rules.spec(("batch", "typo_axis"))
+    return ok, bad
+
+
+def override(rules):
+    ok = rules.with_overrides(batch="tensor")
+    bad = rules.with_overrides(batch="ghost")
+    return ok, bad

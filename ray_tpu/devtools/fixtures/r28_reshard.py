@@ -1,0 +1,50 @@
+"""R28 fixture: implicit reshard across a jitted boundary.
+
+Positive cases: ``bad`` places an array replicated and then feeds a
+shard_map whose in_specs pin P('data') — XLA inserts a silent resharding
+collective on every call; ``bad_donate`` donates argument 0 but its
+out_shardings differ from the donated in_sharding, wasting the
+donation.  The clean twins place with the consumer's spec / keep the
+donated layout.
+"""
+
+import functools
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu._private.jax_compat import shard_map
+
+_MESH = None
+
+
+def _one(x):
+    return x
+
+
+_STEP = shard_map(_one, mesh=_MESH, in_specs=(P("data"),),
+                  out_specs=P("data"), check_vma=False)
+
+
+def good(x, mesh):
+    x = jax.device_put(x, NamedSharding(mesh, P("data")))
+    return _STEP(x)
+
+
+def bad(x, mesh):
+    x = jax.device_put(x, NamedSharding(mesh, P(None)))
+    return _STEP(x)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,),
+                   in_shardings=(P("data"), P(None)),
+                   out_shardings=P("data"))
+def good_donate(state, x):
+    return state
+
+
+@functools.partial(jax.jit, donate_argnums=(0,),
+                   in_shardings=(P("data"), P(None)),
+                   out_shardings=P(None))
+def bad_donate(state, x):
+    return state

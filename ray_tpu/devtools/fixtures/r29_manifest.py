@@ -1,0 +1,37 @@
+"""R29 fixture: the static collective-cost manifest.
+
+Positive case: ``_leak`` psums over a mesh axis no AXIS_ORDER or
+Mesh(...) in the tree declares, so the op can never be planned in
+comms_manifest.json and would always report as unplanned runtime drift.
+Clean twins: ``_ring`` reduces over a declared axis and ``sync`` runs
+explicit collective-API ops with a literal group, both of which land in
+the manifest.
+"""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu import collective
+from ray_tpu._private.jax_compat import shard_map
+
+
+def _ring(x):
+    return jax.lax.psum(x, "tensor")
+
+
+def _leak(x):
+    return jax.lax.psum(x, "ghost_axis")
+
+
+def build(mesh):
+    ok = shard_map(_ring, mesh=mesh, in_specs=(P("tensor"),),
+                   out_specs=P("tensor"), check_vma=False)
+    leak = shard_map(_leak, mesh=mesh, in_specs=(P("tensor"),),
+                     out_specs=P("tensor"), check_vma=False)
+    return ok, leak
+
+
+def sync(t):
+    out = collective.allreduce(t, group_name="fixture")
+    collective.barrier(group_name="fixture")
+    return out

@@ -127,6 +127,7 @@ from typing import Callable, Dict, Iterable, Iterator, List, Optional, Set, Tupl
 
 from ray_tpu.devtools import callgraph as _cg
 from ray_tpu.devtools import dataflow as _df
+from ray_tpu.devtools import shardprop as _sp
 
 __all__ = ["Finding", "LintEngine", "rule", "project_rule", "RULES",
            "PROJECT_RULES", "rule_listing"]
@@ -2726,6 +2727,161 @@ def check_guarded_by(ctxs: List[FileContext], engine) -> Iterator[Finding]:
 
 
 # --------------------------------------------------------------------------
+# R27-R29: static SPMD sharding analysis over the shardprop model
+#
+# All three rules share one ShardModel per run (engine.shard_model); the
+# per-file facts ride the incremental cache keyed by content hash, like
+# the stitch and field facts.  The propagation lattice is constant-or-top:
+# dynamic specs, open mesh/rules universes and starred parts degrade to
+# silence — under-report, never invent.
+
+_R27_AXIS_KIND = {
+    "spec": "PartitionSpec",
+    "rules-table": "ShardingRules table value",
+    "override": "with_overrides() value",
+}
+
+
+@project_rule("R27", "mesh-spec")
+def check_mesh_spec(ctxs: List[FileContext],
+                    engine: "LintEngine") -> Iterator[Finding]:
+    """Mesh/spec consistency over the abstract sharding model: a
+    PartitionSpec (or rules-table / with_overrides value) naming a mesh
+    axis that no AXIS_ORDER or Mesh(...) construction declares, one mesh
+    axis bound to two dims of a single spec, shard_map in_specs arity
+    differing from the mapped callee's parameter count, and logical-axis
+    names absent from every reachable ShardingRules table.  A
+    one-character axis typo is exactly what ShardingRules.spec() would
+    otherwise silently replicate (its strict= mode is the runtime half of
+    this check); unresolvable specs and open universes degrade to
+    silence.  Justify exceptions with
+    '# raylint: allow(mesh-spec) <why>'."""
+    model = engine.shard_model(ctxs)
+    ctx_by_rel = {c.relpath: c for c in ctxs}
+    mesh_known = model.mesh_closed()
+    rules_known = model.rules_closed()
+    for rel in sorted(model.facts):
+        fctx = ctx_by_rel.get(rel)
+        facts = model.facts[rel]
+
+        def allowed(line: int) -> bool:
+            return fctx is not None and fctx.allowed(line, "R27",
+                                                     "mesh-spec")
+
+        if mesh_known:
+            for line, ax, kind in facts["axis_sites"]:
+                if ax in model.mesh_axes or allowed(line):
+                    continue
+                yield Finding(
+                    "R27", "mesh-spec", rel, line,
+                    f"{_R27_AXIS_KIND.get(kind, kind)} names mesh axis "
+                    f"'{ax}', but no AXIS_ORDER or Mesh(...) in the tree "
+                    f"declares it (known axes: "
+                    f"{', '.join(sorted(model.mesh_axes))}) — jax raises "
+                    "at trace time or the dimension silently replicates")
+        for line, ax in facts["dup_sites"]:
+            if allowed(line):
+                continue
+            yield Finding(
+                "R27", "mesh-spec", rel, line,
+                f"mesh axis '{ax}' is bound to two dimensions of a single "
+                "PartitionSpec — jax rejects the spec at trace time; use "
+                "a tuple (('a', 'b')) to co-shard one dimension instead")
+        for line, got, want, callee in facts["arity_sites"]:
+            if allowed(line):
+                continue
+            yield Finding(
+                "R27", "mesh-spec", rel, line,
+                f"shard_map in_specs carries {got} spec(s) but the mapped "
+                f"callable '{callee}' takes {want} positional "
+                "argument(s) — the mismatch only surfaces at trace time")
+        if rules_known:
+            for line, name, src in facts["logical_sites"]:
+                if name in model.logical_names or allowed(line):
+                    continue
+                yield Finding(
+                    "R27", "mesh-spec", rel, line,
+                    f"logical axis '{name}' is in no reachable "
+                    "ShardingRules table (DEFAULT_RULES + with_overrides) "
+                    "— ShardingRules.spec() silently replicates unknown "
+                    "names, so this dimension would never be sharded")
+
+
+@project_rule("R28", "implicit-reshard")
+def check_implicit_reshard(ctxs: List[FileContext],
+                           engine: "LintEngine") -> Iterator[Finding]:
+    """Implicit reshard across a jitted boundary: an array placed with
+    one sharding (device_put / make_array under a NamedSharding) and then
+    passed to a shard_map/pjit callable whose in_specs/in_shardings pin a
+    different spec — XLA inserts a silent resharding collective on the
+    hot path; also donated buffers whose donation is wasted because the
+    out-spec differs from the donated argument's in-spec.  Both sides
+    must be statically provable (same scope chain, fully-constant specs)
+    for the rule to fire.  Justify deliberate reshards with
+    '# raylint: allow(implicit-reshard) <why>'."""
+    model = engine.shard_model(ctxs)
+    ctx_by_rel = {c.relpath: c for c in ctxs}
+    for rel in sorted(model.facts):
+        fctx = ctx_by_rel.get(rel)
+        facts = model.facts[rel]
+        for line, pos, got, want, callee in facts["reshard_sites"]:
+            if fctx is not None and fctx.allowed(line, "R28",
+                                                 "implicit-reshard"):
+                continue
+            yield Finding(
+                "R28", "implicit-reshard", rel, line,
+                f"argument {pos} of '{callee}' was placed as {got} but its "
+                f"in_specs expect {want}: XLA inserts a silent resharding "
+                "collective at this boundary on every call — place the "
+                "array with the consumer's spec (or annotate why not)")
+        for line, pos, got, want in facts["donate_sites"]:
+            if fctx is not None and fctx.allowed(line, "R28",
+                                                 "implicit-reshard"):
+                continue
+            yield Finding(
+                "R28", "implicit-reshard", rel, line,
+                f"donated argument {pos} enters as {got} but the result "
+                f"leaves as {want}: the layouts differ, so XLA cannot "
+                "reuse the donated buffer and the donation is wasted — "
+                "align out_shardings with the donated in_sharding")
+
+
+@project_rule("R29", "comms-manifest")
+def check_comms_manifest(ctxs: List[FileContext],
+                         engine: "LintEngine") -> Iterator[Finding]:
+    """Static collective-cost manifest: every explicit ray_tpu.collective
+    op (keyed by group name) and every jax.lax collective with a resolved
+    mesh axis (keyed axis:<name>) is compiled into a plan with its busbw
+    wire-factor formula — written via --comms-manifest and cross-checked
+    at runtime by ray_tpu.doctor --comms-baseline ('__manifest__' key),
+    which reports ledgered ops absent from the plan as drift.  The rule
+    itself flags collectives over a mesh axis that no mesh in the tree
+    declares: such an op can never appear in the plan, so it would always
+    report as unplanned drift.  Justify with
+    '# raylint: allow(comms-manifest) <why>'."""
+    model = engine.shard_model(ctxs)
+    engine.comms_manifest = _sp.build_manifest(model)
+    if not model.mesh_closed():
+        return
+    ctx_by_rel = {c.relpath: c for c in ctxs}
+    for rel in sorted(model.facts):
+        fctx = ctx_by_rel.get(rel)
+        for line, op, axis in model.facts[rel]["lax_sites"]:
+            if axis == _sp.UNKNOWN or axis in model.mesh_axes:
+                continue
+            if fctx is not None and fctx.allowed(line, "R29",
+                                                 "comms-manifest"):
+                continue
+            yield Finding(
+                "R29", "comms-manifest", rel, line,
+                f"collective '{op}' runs over mesh axis '{axis}', which "
+                f"no AXIS_ORDER or Mesh(...) in the tree declares (known "
+                f"axes: {', '.join(sorted(model.mesh_axes))}) — the op "
+                "cannot be planned in comms_manifest.json and would "
+                "always surface as unplanned drift at runtime")
+
+
+# --------------------------------------------------------------------------
 # engine
 
 class LintEngine:
@@ -2769,6 +2925,16 @@ class LintEngine:
         # hash-validated per-file field-safety facts (R23-R25) replayed
         # from the cache
         self._field_cache: Dict[str, dict] = {}
+        # hash-validated per-file SPMD shard facts (R27-R29) replayed
+        # from the cache
+        self._shard_cache: Dict[str, dict] = {}
+        # (shard-fact replay hits, files scanned) after a shard-model
+        # build — None when no SPMD rule (R27-R29) forced it
+        self.shard_stats: Optional[Tuple[int, int]] = None
+        self._shard_model: Optional[_sp.ShardModel] = None
+        # static collective plan (R29) — built by the R29 rule or
+        # replayed from the project cache; --comms-manifest writes it
+        self.comms_manifest: Optional[dict] = None
 
     def index(self, ctxs: List[FileContext]) -> _cg.ProjectIndex:
         """Whole-program symbol table / call graph, built once per run and
@@ -2780,6 +2946,17 @@ class LintEngine:
             self.stitch_stats = (self._index.stitch_hits,
                                  len(self._index.stitch_facts))
         return self._index
+
+    def shard_model(self, ctxs: List[FileContext]) -> _sp.ShardModel:
+        """Whole-tree SPMD sharding model, built once per run and shared
+        by R27-R29, with hash-validated per-file fact replay exactly like
+        the stitch/field layers."""
+        if self._shard_model is None:
+            self._shard_model = _sp.ShardModel(
+                ctxs, cached=self._shard_cache)
+            self.shard_stats = (self._shard_model.hits,
+                                len(self._shard_model.facts))
+        return self._shard_model
 
     @staticmethod
     def _load_baseline(path: Optional[str]) -> Set[Tuple[str, str]]:
@@ -2836,7 +3013,7 @@ class LintEngine:
             from ray_tpu.devtools import lockwatch as _lw
             h = hashlib.sha256(sys.version.encode())
             for mod_file in (__file__, _cg.__file__, _df.__file__,
-                             _lw.__file__):
+                             _sp.__file__, _lw.__file__):
                 try:
                     with open(mod_file, "rb") as f:
                         h.update(f.read())
@@ -2922,6 +3099,7 @@ class LintEngine:
                 # without a single ast.parse
                 self.cache_stats = (len(sources), len(sources), True)
                 self.errors.extend(proj.get("errors") or [])
+                self.comms_manifest = proj.get("manifest")
                 return [Finding(**d) for d in proj.get("findings") or []]
         ctxs: List[FileContext] = []
         file_findings: List[Finding] = []
@@ -2966,6 +3144,16 @@ class LintEngine:
             for rel, ent in cached_fields.items()
             if rel in hashes and ent.get("hash") == hashes[rel]
             and ent.get("facts") is not None}
+        # same replay for the SPMD shard facts (R27-R29): per-file spec /
+        # mesh / collective-site records are pure functions of one file's
+        # source, so a matching content hash makes them valid verbatim
+        cached_shard = (cache.get("shard") if cache is not None else
+                        None) or {}
+        self._shard_cache = {
+            rel: ent["facts"]
+            for rel, ent in cached_shard.items()
+            if rel in hashes and ent.get("hash") == hashes[rel]
+            and ent.get("facts") is not None}
         proj_findings: List[Finding] = []
         if self.only_rules is None:
             t0 = time.perf_counter()
@@ -3000,16 +3188,24 @@ class LintEngine:
                                for rel, facts in
                                self._index.field_facts.items()
                                if rel in hashes})
+            shard = dict(cached_shard)
+            if self._shard_model is not None:
+                shard.update({rel: {"hash": hashes[rel], "facts": facts}
+                              for rel, facts in
+                              self._shard_model.facts.items()
+                              if rel in hashes})
             self._cache_store({
                 "salt": self._engine_salt(),
                 "files": merged,
                 "stitch": stitch,
                 "fields": fields,
+                "shard": shard,
                 "project": {
                     "tree_key": tree_key,
                     "findings": [f.to_json()
                                  for f in file_findings + proj_findings],
-                    "errors": list(self.errors)},
+                    "errors": list(self.errors),
+                    "manifest": self.comms_manifest},
             })
         return file_findings + proj_findings
 
@@ -3156,6 +3352,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="additionally write findings as a SARIF 2.1.0 "
                              "log to OUT.json (machine-consumable for "
                              "code-scanning UIs)")
+    parser.add_argument("--comms-manifest", default=None, metavar="OUT.json",
+                        help="additionally write the R29 static "
+                             "collective-cost manifest (planned ops per "
+                             "group / mesh axis with busbw wire factors) "
+                             "to OUT.json; ray_tpu.doctor --comms-baseline "
+                             "cross-checks the runtime ledger against it "
+                             "via the '__manifest__' baseline key")
     parser.add_argument("--write-baseline", default=None, metavar="FILE",
                         help="write current findings as a baseline and exit 0")
     args = parser.parse_args(argv)
@@ -3199,8 +3402,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             fields = "fields {}/{}".format(*engine.field_stats)
         else:
             fields = "fields skipped"
+        if warm:
+            shard = "shard replayed"
+        elif engine.shard_stats is not None:
+            shard = "shard {}/{}".format(*engine.shard_stats)
+        else:
+            shard = "shard skipped"
         print(f"raylint-cache: {hits}/{total} file hits, "
-              f"project {'hit' if warm else 'miss'}, {stitch}, {fields}",
+              f"project {'hit' if warm else 'miss'}, {stitch}, {fields}, "
+              f"{shard}",
               file=sys.stderr)
     if engine.rule_times:
         total_t = sum(engine.rule_times.values())
@@ -3229,6 +3439,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         with open(args.sarif, "w", encoding="utf-8") as f:
             json.dump(sarif_log(findings), f, indent=2)
         print(f"raylint: sarif log written to {args.sarif}",
+              file=sys.stderr)
+
+    if args.comms_manifest:
+        manifest = engine.comms_manifest or {
+            "version": 1, "tool": "raylint/R29", "mesh_axes": [],
+            "unresolved_sites": 0, "groups": {}}
+        with open(args.comms_manifest, "w", encoding="utf-8") as f:
+            json.dump(manifest, f, indent=2)
+        n_groups = len(manifest.get("groups") or {})
+        n_ops = sum(len(ops) for ops in (manifest.get("groups")
+                                         or {}).values())
+        print(f"raylint: comms manifest written to {args.comms_manifest} "
+              f"({n_groups} group(s), {n_ops} planned op kind(s))",
               file=sys.stderr)
 
     if args.json:

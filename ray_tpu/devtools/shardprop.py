@@ -1,0 +1,914 @@
+"""Abstract sharding propagation for the SPMD lint rules (R27-R29).
+
+The SPMD surface of the tree is *data*: ``ShardingRules`` tables map
+logical axis names to mesh axes, ``AXIS_ORDER`` / ``Mesh(...)``
+constructions declare the mesh-axis universe, and ``PartitionSpec`` /
+``shard_map`` / ``pjit`` call sites consume both.  This module extracts
+those facts per file (pure, JSON-able — they ride the incremental lint
+cache keyed by content hash, exactly like the stitch and field facts of
+:mod:`ray_tpu.devtools.callgraph`) and joins them into a whole-tree
+:class:`ShardModel` the R27/R28/R29 project rules query.
+
+The propagation lattice is deliberately tiny: every value is either a
+*known constant* (a string, ``None``, or a tuple of strings, resolved
+through single-assignment locals in the enclosing scope chain) or ``"?"``
+(top).  Anything dynamic — a spec built from parameters, a mesh with
+computed axis names, a rules table spread from ``**kwargs`` — degrades to
+top, and top never produces a finding.  When a file constructs a mesh or
+a rules table we cannot enumerate, the whole corresponding universe is
+marked *open* and membership checks shut off tree-wide: the rules
+under-report but never invent, the same stance as R10-R26.
+
+:func:`build_manifest` turns the same model into ``comms_manifest.json``
+— the static plan of every explicit ``ray_tpu.collective`` op (keyed by
+group name) and every ``jax.lax`` collective with a resolved mesh axis
+(keyed ``axis:<name>``), each with its busbw wire-factor formula.  The
+formulas mirror ``observability/comms.py``'s ``_BUSBW`` table (the
+EQuARX byte counts); ``ray_tpu.doctor --comms-baseline`` cross-checks
+the runtime ledger against this plan and reports unplanned collectives
+as drift.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["file_shard_facts", "ShardModel", "build_manifest",
+           "wire_factor", "WIRE_FORMULAS", "format_spec", "UNKNOWN"]
+
+UNKNOWN = "?"
+
+# jax.lax collective primitives that move bytes over a named mesh axis
+# (axis name is the second positional argument / ``axis_name`` kwarg).
+LAX_COLLECTIVES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "ppermute",
+    "all_gather", "all_to_all", "psum_scatter",
+})
+
+# Public ops of ray_tpu/collective/collective.py, by ledger op name.
+EXPLICIT_OPS = frozenset({
+    "allreduce", "reduce", "broadcast", "allgather", "reducescatter",
+    "send", "recv", "barrier",
+})
+
+# Human-readable busbw wire-factor formulas per op, mirroring
+# observability/comms.py _BUSBW (asserted equal by the devtools tests).
+WIRE_FORMULAS: Dict[str, str] = {
+    "allreduce": "2*(n-1)/n", "psum": "2*(n-1)/n", "pmean": "2*(n-1)/n",
+    "pmax": "2*(n-1)/n", "pmin": "2*(n-1)/n",
+    "allgather": "(n-1)/n", "all_gather": "(n-1)/n",
+    "reducescatter": "(n-1)/n", "psum_scatter": "(n-1)/n",
+    "all_to_all": "(n-1)/n",
+}
+
+
+def wire_factor(op: str, n: int) -> float:
+    """Numeric busbw factor for *op* over an *n*-member group — the same
+    ring formulas ``comms._BUSBW`` applies to the runtime ledger."""
+    if op in ("allreduce", "psum", "pmean", "pmax", "pmin"):
+        return 2.0 * (n - 1) / n if n else 1.0
+    if op in ("allgather", "all_gather", "reducescatter", "psum_scatter",
+              "all_to_all"):
+        return (n - 1) / n if n else 1.0
+    return 1.0
+
+
+def format_spec(parts: Sequence[Any]) -> str:
+    """Render abstract spec parts back as PartitionSpec source text."""
+    def one(p: Any) -> str:
+        if p is None:
+            return "None"
+        if isinstance(p, list):
+            return "(" + ", ".join(repr(x) for x in p) + ")"
+        if p == UNKNOWN:
+            return "?"
+        return repr(p)
+    return "P(" + ", ".join(one(p) for p in parts) + ")"
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return base + "." + node.attr if base else None
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_jax_name(dn: Optional[str], origin: Dict[str, str],
+                 leaf: str) -> bool:
+    """True when dotted name *dn* plausibly resolves into jax: either the
+    text starts with ``jax.`` or the head's import origin mentions jax
+    (the ``_private.jax_compat`` shim counts, as in R21)."""
+    if not dn:
+        return False
+    if dn.split(".")[-1] != leaf:
+        return False
+    if dn.startswith("jax."):
+        return True
+    head = dn.split(".")[0]
+    return "jax" in origin.get(head, "")
+
+
+def _const_str_tuple(node: ast.AST) -> Optional[List[str]]:
+    """A tuple/list literal of string constants, or a single string."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def _strip_trailing_none(parts: List[Any]) -> List[Any]:
+    out = list(parts)
+    while out and out[-1] is None:
+        out.pop()
+    return out
+
+
+def _specs_equal(a: List[Any], b: List[Any]) -> bool:
+    """Equality of two fully-known spec part lists, modulo the trailing
+    ``None`` padding PartitionSpec itself ignores."""
+    return _strip_trailing_none(a) == _strip_trailing_none(b)
+
+
+def _fully_known(parts: Sequence[Any]) -> bool:
+    return all(p != UNKNOWN for p in parts)
+
+
+class _Scope:
+    """One lexical scope's constant environment, chained to its parent."""
+
+    def __init__(self, parent: Optional["_Scope"] = None):
+        self.parent = parent
+        self.consts: Dict[str, Any] = {}      # name -> str constant
+        self.specs: Dict[str, Any] = {}       # name -> spec parts | UNKNOWN
+        self.shardings: Dict[str, Any] = {}   # name -> spec parts (NamedSharding)
+        self.producers: Dict[str, Tuple[int, List[Any]]] = {}  # var -> (line, parts)
+        self.consumers: Dict[str, Tuple[str, List[Any]]] = {}  # var -> (kind, in_specs)
+        self.defs: Dict[str, List[Tuple[int, int]]] = {}       # name -> [(min, max)]
+
+    def _lookup(self, attr: str, name: str) -> Any:
+        s: Optional[_Scope] = self
+        while s is not None:
+            d = getattr(s, attr)
+            if name in d:
+                return d[name]
+            s = s.parent
+        return None
+
+    def const(self, name: str) -> Any:
+        return self._lookup("consts", name)
+
+    def spec_of(self, name: str) -> Any:
+        return self._lookup("specs", name)
+
+    def sharding_of(self, name: str) -> Any:
+        return self._lookup("shardings", name)
+
+    def consumer_of(self, name: str) -> Any:
+        return self._lookup("consumers", name)
+
+    def arities_of(self, name: str) -> Any:
+        return self._lookup("defs", name)
+
+
+class _FileScanner:
+    """Single-pass fact extraction for one parsed file."""
+
+    def __init__(self, ctx: Any):
+        self.ctx = ctx
+        self.origin: Dict[str, str] = getattr(ctx, "import_origin", {})
+        self.facts: Dict[str, Any] = {
+            "rules": {},            # table name -> sorted logical keys
+            "override_names": [],   # kwarg names seen at with_overrides()
+            "axis_order": [],       # tuples assigned to *AXIS_ORDER* names
+            "mesh_ctors": [],       # axis names from Mesh(...) literals
+            "dynamic_mesh": False,  # a mesh with unresolvable axis names
+            "dynamic_rules": False,  # a rules table we cannot enumerate
+            "axis_sites": [],       # [line, axis, kind]
+            "dup_sites": [],        # [line, axis]
+            "arity_sites": [],      # [line, got, want_lo, want_hi, callee]
+            "logical_sites": [],    # [line, name, src]
+            "reshard_sites": [],    # [line, argpos, got, want, callee]
+            "donate_sites": [],     # [line, argpos, got, want]
+            "collective_sites": [],  # [line, op, group]
+            "lax_sites": [],        # [line, op, axis]
+        }
+        self._seen_p: set = set()   # id() of P-call nodes already recorded
+
+    # -- entry ------------------------------------------------------------
+
+    def run(self) -> Dict[str, Any]:
+        tree = self.ctx.tree
+        self._module_pass(tree)
+        root = _Scope()
+        self._scan_block(tree.body, root, in_logical_fn=False)
+        f = self.facts
+        f["override_names"] = sorted(set(f["override_names"]))
+        f["mesh_ctors"] = sorted(set(f["mesh_ctors"]))
+        return f
+
+    # -- module-level tables ----------------------------------------------
+
+    def _module_pass(self, tree: ast.Module) -> None:
+        for node in tree.body:
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            tgt = node.targets[0]
+            if not isinstance(tgt, ast.Name):
+                continue
+            name = tgt.id
+            if name.endswith("RULES") and isinstance(node.value, ast.Dict):
+                table = self._rules_table(node.value)
+                if table is None:
+                    self.facts["dynamic_rules"] = True
+                else:
+                    self.facts["rules"][name] = sorted(table)
+            if "AXIS_ORDER" in name:
+                axes = _const_str_tuple(node.value)
+                if axes:
+                    self.facts["axis_order"].append(axes)
+
+    def _rules_table(self, node: ast.Dict) -> Optional[List[str]]:
+        """Logical keys of a rules-table dict literal; None if dynamic.
+        Mesh-axis *values* are recorded as checkable axis sites."""
+        keys: List[str] = []
+        for k, v in zip(node.keys, node.values):
+            if k is None:  # **spread
+                return None
+            if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+                return None
+            keys.append(k.value)
+            axes = _const_str_tuple(v)
+            if axes:
+                for ax in axes:
+                    self.facts["axis_sites"].append(
+                        [v.lineno, ax, "rules-table"])
+        return keys
+
+    # -- scope machinery ---------------------------------------------------
+
+    def _scan_block(self, stmts: List[ast.stmt], scope: _Scope,
+                    in_logical_fn: bool) -> None:
+        self._prepass(stmts, scope)
+        for stmt in stmts:
+            self._visit_stmt(stmt, scope, in_logical_fn)
+
+    def _prepass(self, stmts: List[ast.stmt], scope: _Scope) -> None:
+        """Collect single-assignment locals usable as constants: strings,
+        P(...) specs, NamedSharding specs, shard_map/jit consumers,
+        device_put producers, and def/lambda arities."""
+        counts: Dict[str, int] = {}
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope.defs.setdefault(stmt.name, []).append(
+                    _arity_range(stmt.args))
+                continue
+            if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+                continue
+            tgt = stmt.targets[0]
+            if not isinstance(tgt, ast.Name):
+                continue
+            name, val = tgt.id, stmt.value
+            counts[name] = counts.get(name, 0) + 1
+            if counts[name] > 1:
+                # reassigned: drop every interpretation except lambdas,
+                # which accumulate (branch-dependent bodies are all real)
+                scope.consts.pop(name, None)
+                scope.specs.pop(name, None)
+                scope.shardings.pop(name, None)
+                scope.producers.pop(name, None)
+                scope.consumers.pop(name, None)
+            if isinstance(val, ast.Constant) and isinstance(val.value, str):
+                if counts[name] == 1:
+                    scope.consts[name] = val.value
+            elif isinstance(val, ast.Lambda):
+                scope.defs.setdefault(name, []).append(_arity_range(val.args))
+            elif isinstance(val, ast.Call):
+                if counts[name] > 1:
+                    continue
+                parts = self._p_parts(val)
+                if parts is not None:
+                    scope.specs[name] = parts
+                    continue
+                parts = self._namedsharding_parts(val, scope)
+                if parts is not None:
+                    scope.shardings[name] = parts
+                    continue
+                prod = self._producer_parts(val, scope)
+                if prod is not None:
+                    scope.producers[name] = (stmt.lineno, prod)
+                    continue
+                cons = self._consumer_specs(val, scope)
+                if cons is not None:
+                    scope.consumers[name] = cons
+
+    def _visit_stmt(self, stmt: ast.stmt, scope: _Scope,
+                    in_logical_fn: bool) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in stmt.decorator_list:
+                self._visit_expr(dec, scope, in_logical_fn)
+            child = _Scope(scope)
+            logical = in_logical_fn or "logical_axes" in stmt.name
+            self._scan_block(stmt.body, child, logical)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            for dec in stmt.decorator_list:
+                self._visit_expr(dec, scope, in_logical_fn)
+            # class body shares the enclosing constant env read-only
+            self._scan_block(stmt.body, _Scope(scope), in_logical_fn)
+            return
+        for node in ast.iter_child_nodes(stmt):
+            if isinstance(node, ast.expr):
+                self._visit_expr(node, scope, in_logical_fn)
+            elif isinstance(node, ast.stmt):
+                self._visit_stmt(node, scope, in_logical_fn)
+            elif isinstance(node, (ast.excepthandler, ast.withitem,
+                                   ast.match_case)):
+                for sub in ast.iter_child_nodes(node):
+                    if isinstance(sub, ast.expr):
+                        self._visit_expr(sub, scope, in_logical_fn)
+                    elif isinstance(sub, ast.stmt):
+                        self._visit_stmt(sub, scope, in_logical_fn)
+
+    def _visit_expr(self, node: ast.AST, scope: _Scope,
+                    in_logical_fn: bool) -> None:
+        if isinstance(node, ast.Lambda):
+            child = _Scope(scope)
+            self._visit_expr(node.body, child, in_logical_fn)
+            return
+        if isinstance(node, ast.Call):
+            self._handle_call(node, scope)
+        if in_logical_fn and isinstance(node, (ast.Tuple, ast.List)):
+            names = self._logical_tuple(node)
+            if names is not None:
+                for nm in names:
+                    self.facts["logical_sites"].append(
+                        [node.lineno, nm, "logical-axes"])
+                return  # elements consumed; nothing nested to visit
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._visit_expr(child, scope, in_logical_fn)
+            elif isinstance(child, (ast.comprehension, ast.keyword,
+                                    ast.Starred)):
+                for sub in ast.iter_child_nodes(child):
+                    if isinstance(sub, ast.expr):
+                        self._visit_expr(sub, scope, in_logical_fn)
+
+    # -- detectors ---------------------------------------------------------
+
+    def _handle_call(self, node: ast.Call, scope: _Scope) -> None:
+        dn = _dotted(node.func)
+        leaf = dn.split(".")[-1] if dn else ""
+        if leaf in ("PartitionSpec", "P") and self._is_p_call(node, dn):
+            self._record_p(node, scope)
+        elif leaf == "Mesh" and _is_jax_name(dn, self.origin, "Mesh"):
+            self._record_mesh(node)
+        elif leaf == "shard_map" and _is_jax_name(dn, self.origin,
+                                                  "shard_map"):
+            self._record_shard_map(node, scope)
+        elif leaf in ("jit", "pjit") and _is_jax_name(dn, self.origin, leaf):
+            self._record_jit(node, node, scope)
+        elif leaf == "partial" and node.args:
+            inner = _dotted(node.args[0])
+            ileaf = inner.split(".")[-1] if inner else ""
+            if ileaf in ("jit", "pjit") and _is_jax_name(
+                    inner, self.origin, ileaf):
+                self._record_jit(node, node, scope)
+        elif leaf in LAX_COLLECTIVES and self._is_lax_collective(dn):
+            self._record_lax(node, leaf, scope)
+        elif leaf in EXPLICIT_OPS and self._is_explicit_op(dn, leaf):
+            self._record_explicit(node, leaf)
+        elif leaf == "with_overrides":
+            self._record_overrides(node)
+        elif leaf in ("spec", "sharding") and self._is_rules_recv(node):
+            self._record_logical_call(node, leaf)
+        elif leaf == "shard_pytree":
+            self._record_axes_tree(node)
+        elif leaf == "ShardingRules":
+            self._record_rules_ctor(node)
+        # R28: call through a known shard_map/jit consumer
+        if isinstance(node.func, ast.Name):
+            cons = scope.consumer_of(node.func.id)
+            if cons is not None:
+                self._check_reshard(node, node.func.id, cons, scope)
+
+    # P / PartitionSpec ----------------------------------------------------
+
+    def _is_p_call(self, node: ast.Call, dn: Optional[str]) -> bool:
+        if not dn:
+            return False
+        head = dn.split(".")[0]
+        org = self.origin.get(head, "")
+        return ("PartitionSpec" in org or "jax" in org
+                or dn.startswith("jax."))
+
+    def _p_parts(self, node: ast.AST) -> Optional[List[Any]]:
+        """Abstract parts of a P(...)/PartitionSpec(...) call, else None."""
+        if not isinstance(node, ast.Call):
+            return None
+        dn = _dotted(node.func)
+        leaf = dn.split(".")[-1] if dn else ""
+        if leaf not in ("PartitionSpec", "P") or not self._is_p_call(node, dn):
+            return None
+        parts: List[Any] = []
+        for arg in node.args:
+            if isinstance(arg, ast.Starred):
+                parts.append(UNKNOWN)
+                continue
+            parts.append(self._part_value(arg, None))
+        return parts
+
+    def _part_value(self, node: ast.AST, scope: Optional[_Scope]) -> Any:
+        if isinstance(node, ast.Constant):
+            if node.value is None:
+                return None
+            if isinstance(node.value, str):
+                return node.value
+            return UNKNOWN
+        if isinstance(node, (ast.Tuple, ast.List)):
+            vals = _const_str_tuple(node)
+            return list(vals) if vals is not None else UNKNOWN
+        if isinstance(node, ast.Name) and scope is not None:
+            c = scope.const(node.id)
+            if c is not None:
+                return c
+        return UNKNOWN
+
+    def _record_p(self, node: ast.Call, scope: _Scope) -> None:
+        if id(node) in self._seen_p:
+            return
+        self._seen_p.add(id(node))
+        parts: List[Any] = []
+        for arg in node.args:
+            if isinstance(arg, ast.Starred):
+                parts.append(UNKNOWN)
+            else:
+                parts.append(self._part_value(arg, scope))
+        used: List[str] = []
+        for p in parts:
+            axes = p if isinstance(p, list) else ([p] if isinstance(p, str)
+                                                  else [])
+            for ax in axes:
+                if ax == UNKNOWN:
+                    continue
+                self.facts["axis_sites"].append([node.lineno, ax, "spec"])
+                if ax in used:
+                    self.facts["dup_sites"].append([node.lineno, ax])
+                used.append(ax)
+
+    # Mesh -----------------------------------------------------------------
+
+    def _record_mesh(self, node: ast.Call) -> None:
+        axes_node: Optional[ast.AST] = None
+        if len(node.args) >= 2:
+            axes_node = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "axis_names":
+                axes_node = kw.value
+        if axes_node is None:
+            self.facts["dynamic_mesh"] = True
+            return
+        axes = _const_str_tuple(axes_node)
+        if axes is None and isinstance(axes_node, ast.Name) and \
+                "AXIS_ORDER" in axes_node.id and self.facts["axis_order"]:
+            # e.g. Mesh(arr, AXIS_ORDER): resolve via the module table
+            axes = self.facts["axis_order"][0]
+        if axes is None:
+            self.facts["dynamic_mesh"] = True
+        else:
+            self.facts["mesh_ctors"].extend(axes)
+
+    # shard_map ------------------------------------------------------------
+
+    def _in_specs_list(self, node: ast.AST,
+                       scope: _Scope) -> Optional[List[Any]]:
+        """Resolve an in_specs/out_specs expression to a list of abstract
+        specs (each a parts list or UNKNOWN); None when the shape itself
+        is unresolvable (so even the arity is unknown)."""
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out: List[Any] = []
+            for elt in node.elts:
+                if isinstance(elt, ast.Starred):
+                    return None
+                out.append(self._one_spec(elt, scope))
+            return out
+        one = self._one_spec(node, scope)
+        return [one] if one is not UNKNOWN else None
+
+    def _one_spec(self, node: ast.AST, scope: _Scope) -> Any:
+        parts = self._p_parts(node)
+        if parts is not None:
+            resolved = []
+            for i, arg in enumerate(node.args):  # type: ignore[union-attr]
+                if isinstance(arg, ast.Starred):
+                    resolved.append(UNKNOWN)
+                else:
+                    resolved.append(self._part_value(arg, scope))
+            return resolved
+        if isinstance(node, ast.Name):
+            sp = scope.spec_of(node.id)
+            if sp is not None:
+                return sp
+        return UNKNOWN
+
+    def _record_shard_map(self, node: ast.Call, scope: _Scope) -> None:
+        in_specs = None
+        for kw in node.keywords:
+            if kw.arg == "in_specs":
+                in_specs = self._in_specs_list(kw.value, scope)
+        if in_specs is None or not node.args:
+            return
+        callee = node.args[0]
+        callee_name = _dotted(callee) or "<fn>"
+        arities: List[Tuple[int, int]] = []
+        if isinstance(callee, ast.Lambda):
+            arities = [_arity_range(callee.args)]
+            callee_name = "<lambda>"
+        elif isinstance(callee, ast.Name):
+            found = scope.arities_of(callee.id)
+            if found:
+                arities = list(found)
+        got = len(in_specs)
+        if arities and not any(lo <= got <= hi for lo, hi in arities):
+            lo, hi = arities[0]
+            want = str(lo) if lo == hi else f"{lo}..{hi}"
+            self.facts["arity_sites"].append(
+                [node.lineno, got, want, callee_name])
+
+    def _consumer_specs(self, node: ast.Call,
+                        scope: _Scope) -> Optional[Tuple[str, List[Any]]]:
+        """in_specs/in_shardings of a shard_map or jit call assigned to a
+        local — the consumer side of the R28 boundary check."""
+        dn = _dotted(node.func)
+        leaf = dn.split(".")[-1] if dn else ""
+        if leaf == "shard_map" and _is_jax_name(dn, self.origin,
+                                                "shard_map"):
+            for kw in node.keywords:
+                if kw.arg == "in_specs":
+                    specs = self._in_specs_list(kw.value, scope)
+                    if specs is not None:
+                        return ("shard_map", specs)
+        if leaf in ("jit", "pjit") and _is_jax_name(dn, self.origin, leaf):
+            for kw in node.keywords:
+                if kw.arg == "in_shardings":
+                    specs = self._in_specs_list(kw.value, scope)
+                    if specs is not None:
+                        return (leaf, specs)
+        return None
+
+    # producers (R28) -------------------------------------------------------
+
+    def _namedsharding_parts(self, node: ast.Call,
+                             scope: _Scope) -> Optional[List[Any]]:
+        dn = _dotted(node.func)
+        if not dn or dn.split(".")[-1] != "NamedSharding":
+            return None
+        if not _is_jax_name(dn, self.origin, "NamedSharding"):
+            head = dn.split(".")[0]
+            if "NamedSharding" not in self.origin.get(head, ""):
+                return None
+        spec_node: Optional[ast.AST] = node.args[1] if len(node.args) >= 2 \
+            else None
+        for kw in node.keywords:
+            if kw.arg == "spec":
+                spec_node = kw.value
+        if spec_node is None:
+            return None
+        parts = self._one_spec(spec_node, scope)
+        return parts if isinstance(parts, list) else None
+
+    def _producer_parts(self, node: ast.Call,
+                        scope: _Scope) -> Optional[List[Any]]:
+        """``x = jax.device_put(v, <sharding>)`` (or
+        make_array_from_single_device_arrays): the producer side."""
+        dn = _dotted(node.func)
+        leaf = dn.split(".")[-1] if dn else ""
+        if leaf == "device_put" and _is_jax_name(dn, self.origin,
+                                                 "device_put"):
+            sh = node.args[1] if len(node.args) >= 2 else None
+            for kw in node.keywords:
+                if kw.arg == "device":
+                    sh = kw.value
+        elif leaf == "make_array_from_single_device_arrays" and \
+                _is_jax_name(dn, self.origin, leaf):
+            sh = node.args[1] if len(node.args) >= 2 else None
+            for kw in node.keywords:
+                if kw.arg == "sharding":
+                    sh = kw.value
+        else:
+            return None
+        if sh is None:
+            return None
+        if isinstance(sh, ast.Call):
+            parts = self._namedsharding_parts(sh, scope)
+            if parts is not None:
+                return parts
+        if isinstance(sh, ast.Name):
+            parts = scope.sharding_of(sh.id)
+            if parts is not None:
+                return parts
+        return None
+
+    def _check_reshard(self, node: ast.Call, fname: str,
+                       cons: Tuple[str, List[Any]], scope: _Scope) -> None:
+        kind, in_specs = cons
+        for i, arg in enumerate(node.args):
+            if i >= len(in_specs):
+                break
+            if not isinstance(arg, ast.Name):
+                continue
+            prod = scope._lookup("producers", arg.id)
+            if prod is None:
+                continue
+            _line, got = prod
+            want = in_specs[i]
+            if not isinstance(want, list) or not isinstance(got, list):
+                continue
+            if not (_fully_known(got) and _fully_known(want)):
+                continue
+            if not _specs_equal(got, want):
+                self.facts["reshard_sites"].append(
+                    [node.lineno, i, format_spec(got), format_spec(want),
+                     fname])
+
+    # jit donation (R28) ----------------------------------------------------
+
+    def _record_jit(self, node: ast.Call, kw_holder: ast.Call,
+                    scope: _Scope) -> None:
+        donate: Optional[List[int]] = None
+        in_sh = out_sh = None
+        for kw in kw_holder.keywords:
+            if kw.arg == "donate_argnums":
+                donate = _int_positions(kw.value)
+            elif kw.arg == "in_shardings":
+                in_sh = self._in_specs_list(kw.value, scope)
+            elif kw.arg == "out_shardings":
+                out_sh = self._in_specs_list(kw.value, scope)
+        if not donate or in_sh is None or out_sh is None:
+            return
+        for pos in donate:
+            if pos >= len(in_sh):
+                continue
+            got = in_sh[pos]
+            want = out_sh[pos] if len(out_sh) > 1 else out_sh[0]
+            if not isinstance(got, list) or not isinstance(want, list):
+                continue
+            if not (_fully_known(got) and _fully_known(want)):
+                continue
+            if not _specs_equal(got, want):
+                self.facts["donate_sites"].append(
+                    [node.lineno, pos, format_spec(got), format_spec(want)])
+
+    # collectives (R29) -----------------------------------------------------
+
+    def _is_lax_collective(self, dn: Optional[str]) -> bool:
+        if not dn:
+            return False
+        if ".lax." in dn or dn.startswith("lax."):
+            head = dn.split(".")[0]
+            return dn.startswith("jax.") or "jax" in self.origin.get(head, "")
+        head = dn.split(".")[0]
+        return "jax" in self.origin.get(head, "") and "lax" in \
+            self.origin.get(head, "")
+
+    def _record_lax(self, node: ast.Call, op: str, scope: _Scope) -> None:
+        axis_node: Optional[ast.AST] = node.args[1] if len(node.args) >= 2 \
+            else None
+        for kw in node.keywords:
+            if kw.arg in ("axis_name", "axis"):
+                axis_node = kw.value
+        axis: Any = UNKNOWN
+        if axis_node is not None:
+            axis = self._part_value(axis_node, scope)
+            if isinstance(axis, list):  # multi-axis collective: any str ok
+                axis = axis[0] if len(axis) == 1 else UNKNOWN
+            if axis is None:
+                axis = UNKNOWN
+        self.facts["lax_sites"].append([node.lineno, op, axis])
+
+    def _is_explicit_op(self, dn: Optional[str], leaf: str) -> bool:
+        if not dn:
+            return False
+        head = dn.split(".")[0]
+        org = self.origin.get(head, "")
+        full = (org + dn[len(head):]) if org else dn
+        return "collective" in full and (
+            full.startswith("ray_tpu.") or org.startswith("ray_tpu"))
+
+    def _record_explicit(self, node: ast.Call, op: str) -> None:
+        group: Any = None
+        dynamic = False
+        for kw in node.keywords:
+            if kw.arg == "group_name":
+                if isinstance(kw.value, ast.Constant) and \
+                        isinstance(kw.value.value, str):
+                    group = kw.value.value
+                else:
+                    dynamic = True
+            elif kw.arg is None:
+                dynamic = True  # **kwargs may carry group_name
+        if group is None:
+            for arg in node.args[1:]:
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str):
+                    group = arg.value
+                    break
+                if not isinstance(arg, ast.Constant):
+                    dynamic = True
+        if group is None:
+            group = "*" if dynamic else "default"
+        self.facts["collective_sites"].append([node.lineno, op, group])
+
+    # logical-axis uses (R27d) ----------------------------------------------
+
+    def _record_overrides(self, node: ast.Call) -> None:
+        for kw in node.keywords:
+            if kw.arg is None:
+                self.facts["dynamic_rules"] = True
+                continue
+            self.facts["override_names"].append(kw.arg)
+            axes = _const_str_tuple(kw.value)
+            if axes:
+                for ax in axes:
+                    self.facts["axis_sites"].append(
+                        [kw.value.lineno, ax, "override"])
+
+    def _is_rules_recv(self, node: ast.Call) -> bool:
+        if not isinstance(node.func, ast.Attribute):
+            return False
+        recv = _dotted(node.func.value)
+        return bool(recv) and "rules" in recv.split(".")[-1].lower()
+
+    def _record_logical_call(self, node: ast.Call, leaf: str) -> None:
+        # rules.spec(axes) / rules.sharding(mesh, axes)
+        idx = 0 if leaf == "spec" else 1
+        arg = node.args[idx] if len(node.args) > idx else None
+        for kw in node.keywords:
+            if kw.arg == "logical_axes":
+                arg = kw.value
+        if arg is None or not isinstance(arg, (ast.Tuple, ast.List)):
+            return
+        names = self._logical_tuple(arg)
+        if names is None:
+            return
+        for nm in names:
+            self.facts["logical_sites"].append([arg.lineno, nm, "spec-call"])
+
+    def _logical_tuple(self, node: ast.AST) -> Optional[List[str]]:
+        """Tuple/list literal of logical names: str and None elements only,
+        at least one str."""
+        if not isinstance(node, (ast.Tuple, ast.List)) or not node.elts:
+            return None
+        out: List[str] = []
+        for elt in node.elts:
+            if not isinstance(elt, ast.Constant):
+                return None
+            if isinstance(elt.value, str):
+                out.append(elt.value)
+            elif elt.value is not None:
+                return None
+        return out if out else None
+
+    def _record_axes_tree(self, node: ast.Call) -> None:
+        axes = node.args[1] if len(node.args) >= 2 else None
+        for kw in node.keywords:
+            if kw.arg == "axes_tree":
+                axes = kw.value
+        if not isinstance(axes, ast.Dict):
+            return
+        for v in axes.values:
+            names = self._logical_tuple(v)
+            if names:
+                for nm in names:
+                    self.facts["logical_sites"].append(
+                        [v.lineno, nm, "axes-tree"])
+
+    def _record_rules_ctor(self, node: ast.Call) -> None:
+        for arg in node.args:
+            if isinstance(arg, ast.Dict):
+                table = self._rules_table(arg)
+                if table is None:
+                    self.facts["dynamic_rules"] = True
+                else:
+                    self.facts["rules"].setdefault(
+                        f"<ctor:{node.lineno}>", sorted(table))
+            else:
+                self.facts["dynamic_rules"] = True
+        for kw in node.keywords:
+            if kw.arg == "rules" and isinstance(kw.value, ast.Dict):
+                table = self._rules_table(kw.value)
+                if table is None:
+                    self.facts["dynamic_rules"] = True
+                else:
+                    self.facts["rules"].setdefault(
+                        f"<ctor:{node.lineno}>", sorted(table))
+            elif kw.arg is not None and not isinstance(kw.value, ast.Dict):
+                # other dataclass fields (none today) — stay conservative
+                self.facts["dynamic_rules"] = True
+
+
+def _arity_range(args: ast.arguments) -> Tuple[int, int]:
+    n = len(args.posonlyargs) + len(args.args)
+    lo = n - len(args.defaults)
+    hi = 10 ** 6 if args.vararg is not None else n
+    return (lo, hi)
+
+
+def _int_positions(node: ast.AST) -> Optional[List[int]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[int] = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def file_shard_facts(ctx: Any) -> Dict[str, Any]:
+    """Pure, JSON-able SPMD facts for one file — the cacheable unit."""
+    return _FileScanner(ctx).run()
+
+
+class ShardModel:
+    """Whole-tree join of per-file shard facts.
+
+    ``cached`` maps relpath -> previously computed facts (content-hash
+    validated by the caller); files present there skip re-extraction and
+    count toward ``hits`` for the ``raylint-cache: ... shard S/T`` line.
+    """
+
+    def __init__(self, ctxs: Sequence[Any],
+                 cached: Optional[Dict[str, dict]] = None):
+        cached = cached or {}
+        self.facts: Dict[str, Dict[str, Any]] = {}
+        self.hits = 0
+        for ctx in ctxs:
+            f = cached.get(ctx.relpath)
+            if f is not None:
+                self.hits += 1
+            else:
+                f = file_shard_facts(ctx)
+            self.facts[ctx.relpath] = f
+        self.mesh_axes: set = set()
+        self.logical_names: set = set()
+        self._open_mesh = False
+        self._open_rules = False
+        for f in self.facts.values():
+            for order in f["axis_order"]:
+                self.mesh_axes.update(order)
+            self.mesh_axes.update(f["mesh_ctors"])
+            self._open_mesh = self._open_mesh or f["dynamic_mesh"]
+            for keys in f["rules"].values():
+                self.logical_names.update(keys)
+            self.logical_names.update(f["override_names"])
+            self._open_rules = self._open_rules or f["dynamic_rules"]
+
+    def mesh_closed(self) -> bool:
+        """True when the mesh-axis universe is known exactly — only then
+        may axis-membership checks fire (under-approximation stance)."""
+        return bool(self.mesh_axes) and not self._open_mesh
+
+    def rules_closed(self) -> bool:
+        return bool(self.logical_names) and not self._open_rules
+
+
+def build_manifest(model: ShardModel) -> Dict[str, Any]:
+    """The static collective-cost plan: every resolvable collective site,
+    keyed by runtime ledger group (explicit ops) or ``axis:<mesh-axis>``
+    (shard_map/pjit-implied jax.lax collectives), with its busbw
+    wire-factor formula.  ``unresolved_sites`` counts the sites whose
+    axis or group degraded to top — the plan never claims to cover them."""
+    groups: Dict[str, Dict[str, Any]] = {}
+    unresolved = 0
+
+    def ent(group: str, op: str) -> Dict[str, Any]:
+        return groups.setdefault(group, {}).setdefault(
+            op, {"sites": [], "wire_formula": WIRE_FORMULAS.get(op, "1")})
+
+    for rel in sorted(model.facts):
+        f = model.facts[rel]
+        for line, op, group in f["collective_sites"]:
+            ent(group, op)["sites"].append([rel, int(line)])
+        for line, op, axis in f["lax_sites"]:
+            if axis == UNKNOWN:
+                unresolved += 1
+                continue
+            if model.mesh_closed() and axis not in model.mesh_axes:
+                continue  # an R29 finding, not a plan entry
+            ent("axis:" + axis, op)["sites"].append([rel, int(line)])
+    return {"version": 1, "tool": "raylint/R29",
+            "mesh_axes": sorted(model.mesh_axes),
+            "unresolved_sites": unresolved, "groups": groups}

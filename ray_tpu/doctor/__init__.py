@@ -399,6 +399,56 @@ def _goodput_reports(collected: dict,
     return {"jobs": jobs, "drift": drift}
 
 
+def _manifest_drift(groups: dict, manifest: Optional[dict],
+                    tolerance: float = 1.0) -> List[dict]:
+    """Cross-check the runtime collective ledger against the static plan
+    raylint's R29 emits (``comms_manifest.json``).
+
+    Every ledgered group/op with a nonzero count must appear in the
+    manifest's ``groups`` table, either under its own group name or under
+    the ``"*"`` wildcard (statically-unresolvable group names) —
+    otherwise it is an *unplanned* collective and reports as drift.  For
+    planned ops, a ``wire_ratio_max`` ceiling in the manifest entry gates
+    the ledgered wire/logical ratio, and the predicted per-link bytes
+    (ledger wire bytes x the shared busbw formula for the group's world
+    size) ride along informationally on the entry.  Reused by
+    ``_comms_reports`` (the ``__manifest__`` baseline key), the devtools
+    tests, and run_sanitizers.sh's manifest-vs-ledger gate."""
+    from ray_tpu.observability import comms as comms_mod
+    drift: List[dict] = []
+    plan = (manifest or {}).get("groups") or {}
+    wildcard = plan.get("*") or {}
+    for gname, rec in sorted((groups or {}).items()):
+        planned = dict(wildcard)
+        planned.update(plan.get(gname) or {})
+        world = int(rec.get("world_size") or 0)
+        for op, o in sorted((rec.get("ops") or {}).items()):
+            count = int(o.get("count") or 0)
+            if count <= 0:
+                continue
+            ent = planned.get(op)
+            if ent is None:
+                drift.append({"group": gname,
+                              "metric": f"{op}_unplanned",
+                              "got": count, "baseline": 0.0,
+                              "tolerance": tolerance})
+                continue
+            nbytes = float(o.get("bytes") or 0.0)
+            wire = float(o.get("wire_bytes", nbytes) or nbytes)
+            factor_fn = comms_mod._BUSBW.get(op, lambda n: 1.0)
+            ent["predicted_link_bytes"] = round(wire * factor_fn(world), 1)
+            ratio_max = ent.get("wire_ratio_max")
+            if ratio_max is not None and nbytes:
+                got = wire / nbytes
+                if got > float(ratio_max) * tolerance:
+                    drift.append({"group": gname,
+                                  "metric": f"{op}_wire_ratio",
+                                  "got_ratio": round(got, 4),
+                                  "baseline_ratio": float(ratio_max),
+                                  "tolerance": tolerance})
+    return drift
+
+
 def _comms_reports(collected: dict, baseline: Optional[dict] = None,
                    factor: float = 3.0) -> dict:
     """Comms-plane section: every node's ``"comms"`` payload (collective
@@ -418,7 +468,13 @@ def _comms_reports(collected: dict, baseline: Optional[dict] = None,
     group drifting back toward 1.0 means compression silently stopped
     paying for itself.  Unknown groups in the baseline are ignored (a
     gate for a group that never ran is not a drift).  Flags and drift
-    all count as issues."""
+    all count as issues.
+
+    The special baseline key ``"__manifest__"`` (a path to raylint's
+    ``comms_manifest.json`` or the inlined manifest dict) additionally
+    cross-checks every ledgered group/op against the static collective
+    plan via :func:`_manifest_drift`: ops the static analysis never
+    planned report as ``<op>_unplanned`` drift."""
     from ray_tpu.observability import comms as comms_mod
     cluster = collected.get("cluster") or {}
     snaps = (cluster.get("metrics") or {}).get("snapshots") or {}
@@ -433,7 +489,25 @@ def _comms_reports(collected: dict, baseline: Optional[dict] = None,
     links = comms_mod.link_flags(merged["links"], factor=factor)
     report = comms_mod.skew_report(groups, bounds=bounds)
     drift = []
-    for group, budgets in (baseline or {}).items():
+    base = dict(baseline or {})
+    manifest = base.pop("__manifest__", None)
+    if isinstance(manifest, str):
+        try:
+            with open(manifest, encoding="utf-8") as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            # a configured gate that cannot be read must fail loudly,
+            # not silently pass
+            drift.append({"group": "__manifest__",
+                          "metric": "manifest_unreadable",
+                          "got": 1, "baseline": 0.0, "tolerance": 1.0,
+                          "error": str(e)})
+            manifest = None
+    if isinstance(manifest, dict):
+        drift.extend(_manifest_drift(
+            groups, manifest,
+            tolerance=float(manifest.get("tolerance", 1.0))))
+    for group, budgets in base.items():
         rec = groups.get(group)
         if rec is None:
             continue
@@ -770,7 +844,7 @@ def render_text(report: dict) -> str:
     cdrift = comms_section.get("drift") or []
     if cdrift:
         lines.append("")
-        lines.append(f"COMMS DRIFT ({len(cdrift)}) — bandwidth/skew "
+        lines.append(f"COMMS DRIFT ({len(cdrift)}) — bandwidth/skew/plan "
                      "beyond recorded budget")
         for d in cdrift:
             if "got_gbps" in d:
@@ -781,6 +855,15 @@ def render_text(report: dict) -> str:
                 lines.append(
                     f"  {d['group']}.{d['metric']}: {d['got_ms']}ms > "
                     f"{d['baseline_ms']}ms x{d['tolerance']}")
+            elif "got_ratio" in d:
+                lines.append(
+                    f"  {d['group']}.{d['metric']}: {d['got_ratio']} > "
+                    f"{d['baseline_ratio']} x{d['tolerance']}")
+            elif d["metric"].endswith("_unplanned"):
+                lines.append(
+                    f"  {d['group']}.{d['metric']}: {d['got']} op(s) "
+                    "ledgered but absent from comms_manifest.json — "
+                    "unplanned collective")
             else:
                 lines.append(
                     f"  {d['group']}.{d['metric']}: {d['got']} > "
@@ -936,8 +1019,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="JSON file of per-group comms budgets "
                              "({group: {allreduce_gbps: floor, "
                              "skew_p95_ms: ceiling, mismatches: ceiling, "
-                             "tolerance: 1.0}}); budget violations count "
-                             "as issues")
+                             "tolerance: 1.0}}); the special key "
+                             "'__manifest__' (path to raylint's "
+                             "comms_manifest.json, or the inlined "
+                             "manifest) cross-checks the ledger against "
+                             "the static collective plan — ledgered ops "
+                             "absent from the plan report as unplanned "
+                             "drift; budget violations count as issues")
     args = parser.parse_args(argv)
     perf_baseline = None
     if args.perf_baseline:

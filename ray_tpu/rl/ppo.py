@@ -119,8 +119,11 @@ class PPOLearner:
                                      SampleBatch.VALUE_TARGETS)})
         sharding = None
         if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            sharding = NamedSharding(self.mesh, P("data"))
+            # Batch spec comes from the rules table (("data", "fsdp")),
+            # not a bare P("data"): on an fsdp-bearing mesh the jitted
+            # train_fn would otherwise reshard every minibatch.
+            from ray_tpu.parallel.sharding import batch_sharding
+            sharding = batch_sharding(self.mesh, ndim=1)
         arrays = batch_to_device(used, sharding)
         self.params, self.opt_state, self.rng, metrics = self._train(
             self.params, self.opt_state, self.rng,

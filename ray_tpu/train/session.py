@@ -226,22 +226,30 @@ def get_collective_group_name() -> Optional[str]:
 
 
 def shard_batch(array, spec=None):
-    """Place this worker's LOCAL batch onto the session mesh's ``data``
-    axis as one global array. On a process-spanning mesh (multi-host
-    tensor plane) each worker contributes its shard
+    """Place this worker's LOCAL batch across the session mesh's
+    data-parallel axes as one global array. On a process-spanning mesh
+    (multi-host tensor plane) each worker contributes its shard
     (``jax.make_array_from_process_local_data``); single-process meshes
     just device_put with the sharding. The returned array feeds a pjit'd
-    step whose gradient psum then rides the compiled collectives."""
+    step whose gradient psum then rides the compiled collectives.
+
+    The default spec comes from the ``batch`` entry of the rules table
+    (``("data", "fsdp")``), matching what ``train.step.batch_sharding``
+    pins on the jitted step — a bare ``P("data")`` here would make XLA
+    reshard the batch over fsdp at the step boundary on every call.
+    """
     import jax
     import numpy as np
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import NamedSharding
+    from ray_tpu.parallel.sharding import ShardingRules
     s = _get_session()
     if s is None or s.mesh is None:
         raise RuntimeError("shard_batch() needs a session with a mesh")
-    if spec is None:
-        spec = P("data")
-    sharding = NamedSharding(s.mesh, spec)
     arr = np.asarray(array)
+    if spec is None:
+        spec = ShardingRules().sharding(
+            s.mesh, ("batch",) + (None,) * (max(1, arr.ndim) - 1)).spec
+    sharding = NamedSharding(s.mesh, spec)
     if jax.process_count() > 1:
         return jax.make_array_from_process_local_data(sharding, arr)
     return jax.device_put(arr, sharding)

@@ -120,6 +120,74 @@ RAY_TPU_CHAOS="20260807:collective.quant@2%3=delay(0.01);collective.op@3%5=delay
 JAX_PLATFORMS=cpu \
 python -m pytest tests/test_collective.py tests/test_quantization.py -q
 
+echo "== comms-manifest gate (static R29 plan vs live inproc collective ledger) =="
+# Manifest-vs-ledger cross-check: raylint's sharding model derives the
+# static collective plan from the drill driver's own source, the driver
+# then runs exactly the ops it declares through the inproc cpu backend,
+# and doctor's manifest gate must (a) pass clean against that plan and
+# (b) flag every ledgered op as unplanned against an empty plan — the
+# same drift `ray-tpu doctor --comms-baseline` reports when production
+# code grows a collective the last lint run never planned.
+JAX_PLATFORMS=cpu \
+python - <<'EOF'
+import numpy as np
+
+import ray_tpu
+from ray_tpu import doctor
+from ray_tpu.devtools import shardprop
+from ray_tpu.devtools.linter import FileContext
+from ray_tpu.observability import comms
+
+DRIVER_SRC = '''
+import numpy as np
+
+from ray_tpu import collective
+
+
+def step(t):
+    out = collective.allreduce(t, group_name="manifest_drill")
+    collective.barrier(group_name="manifest_drill")
+    return out
+'''
+
+model = shardprop.ShardModel([FileContext("drill.py", "drill.py",
+                                          DRIVER_SRC)])
+manifest = shardprop.build_manifest(model)
+assert "allreduce" in manifest["groups"]["manifest_drill"], manifest
+
+ray_tpu.init()
+comms.enable()
+comms.reset()
+
+
+@ray_tpu.remote(num_cpus=0.1)
+class Member:
+    def run(self, fn_name, *args, **kwargs):
+        from ray_tpu import collective as col
+        return getattr(col, fn_name)(*args, **kwargs)
+
+
+n = 2
+actors = [Member.remote() for _ in range(n)]
+from ray_tpu.collective import create_collective_group
+create_collective_group(actors, n, list(range(n)), backend="cpu",
+                        group_name="manifest_drill")
+ray_tpu.get([a.run.remote("allreduce", np.full((1024,), float(i + 1)),
+                          "manifest_drill") for i, a in enumerate(actors)])
+ray_tpu.get([a.run.remote("barrier", "manifest_drill") for a in actors])
+
+groups = comms.snapshot()["groups"]
+ops = sorted(groups.get("manifest_drill", {}).get("ops", {}))
+clean = doctor._manifest_drift(groups, manifest)
+assert clean == [], f"planned ops reported as drift: {clean}"
+drift = doctor._manifest_drift(groups, {"version": 1, "groups": {}})
+flagged = {(d["group"], d["metric"]) for d in drift}
+assert ("manifest_drill", "allreduce_unplanned") in flagged, drift
+print(f"comms-manifest drill: ledger ops {ops} all planned; "
+      f"empty plan flags {len(drift)} unplanned op(s)")
+ray_tpu.shutdown()
+EOF
+
 echo "== forensics gate (crash bundles sealed + doctor reads them back) =="
 # Hard-death drill: the forensics suite kills processes mid-task — via a
 # deterministic chaos exit schedule (hooks run) and via raw SIGKILL (no

@@ -6,13 +6,16 @@
 #      shipped fixture corpus round-trips expected.json exactly, and the
 #      machine-readable `--rules` listing is cross-checked against this
 #      header and the ARCHITECTURE.md rule table so neither can drift.
-#   1. raylint — the framework-aware AST linter (R1..R26, including the
+#   1. raylint — the framework-aware AST linter (R1..R29, including the
 #      whole-program call-graph rules, the path-sensitive dataflow
-#      rules, the cross-process stitched-graph rules, and the
-#      field-level thread-safety rules R23-R25) over
+#      rules, the cross-process stitched-graph rules, the
+#      field-level thread-safety rules R23-R25, and the static SPMD
+#      sharding rules R27-R29) over
 #      ray_tpu/, bench.py, bench_micro.py, and tests/; any
 #      non-allowlisted finding fails the gate. tests/ runs under a
 #      scoped allow profile (see below). Emits a SARIF 2.1.0 artifact
+#      and the R29 collective-cost plan (comms_manifest.json, the
+#      input to `ray-tpu doctor --comms-baseline`'s __manifest__ gate)
 #      next to the JSON summary, reports the incremental-cache hit rate
 #      in the timing summary, and warns when the stage outruns its
 #      recorded cold-cache baseline by >50%.
@@ -90,8 +93,14 @@ LINT_ERR="$(mktemp /tmp/raytpu_lint.XXXXXX.err)"
 # CI artifact: SARIF 2.1.0 log of every finding (empty `results` on a
 # clean tree), for editor/code-scanning ingestion
 LINT_SARIF="${RAYLINT_SARIF_OUT:-/tmp/raytpu_lint.sarif.json}"
+# CI artifact: the static collective plan R29 derives from the sharding
+# model — ships next to the SARIF log and feeds the runtime
+# manifest-vs-ledger cross-check (doctor --comms-baseline __manifest__,
+# run_sanitizers.sh).
+LINT_MANIFEST="${RAYLINT_MANIFEST_OUT:-/tmp/raytpu_comms_manifest.json}"
 if python -m ray_tpu.devtools.lint ray_tpu bench.py bench_micro.py tests \
      --allow-in "tests/:R9,R12,R22,R23,R24,R25,R26" --json --sarif "$LINT_SARIF" \
+     --comms-manifest "$LINT_MANIFEST" \
      > "$LINT_JSON" 2> "$LINT_ERR"; then
   python - "$LINT_JSON" <<'EOF'
 import json, sys
@@ -121,11 +130,12 @@ rm -f "$LINT_JSON" "$LINT_ERR"
 stage_done "stage 1 (raylint)" "$t0" "$st"
 STAGE_TIMES+=("stage 1 cache: ${CACHE_LINE#raylint-cache: }")
 STAGE_TIMES+=("stage 1 rule times: ${TIMES_LINE#raylint-times: }")
-# Budget check against the recorded cold-cache baseline (full R1..R26
-# run over the widened file set, incl. the stitch pass and the R23-R25
-# field plan, 2026-08): a >50% overshoot means a rule regressed into
-# super-linear work or the cache stopped landing.
-STAGE1_BASELINE_S="${RAYLINT_STAGE1_BASELINE_S:-18}"
+# Budget check against the recorded cold-cache baseline (full R1..R29
+# run over the widened file set, incl. the stitch pass, the R23-R25
+# field plan, and the R27-R29 sharding model, 2026-08): a >50%
+# overshoot means a rule regressed into super-linear work or the cache
+# stopped landing.
+STAGE1_BASELINE_S="${RAYLINT_STAGE1_BASELINE_S:-45}"
 st1_el=$(( SECONDS - t0 ))
 if [ "$st1_el" -gt $(( STAGE1_BASELINE_S * 3 / 2 )) ]; then
   echo "WARNING: stage 1 took ${st1_el}s, >50% over its recorded" \

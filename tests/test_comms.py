@@ -440,6 +440,63 @@ def test_doctor_wire_ratio_budget_gates_compression():
                                                            abs=1e-3)
 
 
+def test_doctor_manifest_cross_check_flags_unplanned_collectives(tmp_path):
+    """R29 acceptance: the ``__manifest__`` comms-baseline key cross-
+    checks the runtime ledger against raylint's static collective plan —
+    ledgered ops absent from comms_manifest.json report as
+    ``<op>_unplanned`` drift, matching plans stay clean, and an
+    unreadable manifest path fails loudly instead of silently passing."""
+    from ray_tpu import doctor
+    groups = {"gman": {"world_size": 2, "ops": {
+        "allreduce": {"count": 3, "bytes": float(3 << 20),
+                      "wire_bytes": float(3 << 20), "seconds": 0.01}}}}
+    plan = {"version": 1, "tool": "raylint/R29",
+            "groups": {"gman": {"allreduce":
+                                {"wire_formula": "2*(n-1)/n"}}}}
+    assert doctor._manifest_drift(groups, plan) == []
+    # planned entries get the predicted per-link bytes annotated:
+    # wire_bytes x busbw_factor(world=2) = wire_bytes x 1.0 for allreduce
+    ent = plan["groups"]["gman"]["allreduce"]
+    assert ent["predicted_link_bytes"] == pytest.approx(float(3 << 20))
+    drift = doctor._manifest_drift(groups, {"version": 1, "groups": {}})
+    assert [(d["group"], d["metric"], d["got"]) for d in drift] == \
+        [("gman", "allreduce_unplanned", 3)]
+    # "*" wildcard covers statically-unresolvable group names
+    assert doctor._manifest_drift(
+        groups, {"groups": {"*": {"allreduce": {}}}}) == []
+    # wire_ratio_max ceilings gate compression on planned ops
+    ratio = doctor._manifest_drift(
+        groups, {"groups": {"gman": {"allreduce":
+                                     {"wire_ratio_max": 0.5}}}})
+    assert [d["metric"] for d in ratio] == ["allreduce_wire_ratio"]
+
+    # end-to-end: a live ledger vs a manifest file on disk
+    comms.record_op("gman", "allreduce", 1 << 20, "float32", 0.004,
+                    world_size=2)
+    collected = {"ts": time.time(), "errors": [],
+                 "cluster": {"metrics": {"snapshots": {
+                     "head": comms.families()}}}}
+    man_path = tmp_path / "comms_manifest.json"
+    man_path.write_text(json.dumps(
+        {"version": 1, "groups": {"gman": {"allreduce": {}}}}))
+    clean = doctor._comms_reports(
+        collected, baseline={"__manifest__": str(man_path)})
+    assert clean["drift"] == []
+    report = doctor.diagnose(
+        collected,
+        comms_baseline={"__manifest__": {"version": 1, "groups": {}}})
+    assert not report["healthy"]
+    unplanned = [d for d in report["comms"]["drift"]
+                 if d["metric"] == "allreduce_unplanned"
+                 and d["group"] == "gman"]
+    assert unplanned and unplanned[0]["got"] == 1
+    assert "unplanned collective" in doctor.render_text(report)
+    broken = doctor._comms_reports(
+        collected,
+        baseline={"__manifest__": str(tmp_path / "missing.json")})
+    assert [d["metric"] for d in broken["drift"]] == ["manifest_unreadable"]
+
+
 # -- tensor-plane epoch gauge ------------------------------------------------
 
 def test_tensor_plane_mark_sets_epoch_gauge():

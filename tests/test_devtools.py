@@ -1607,7 +1607,7 @@ def test_sarif_log_covers_all_rules_and_anchors_findings():
     assert log["version"] == "2.1.0"
     run = log["runs"][0]
     rules = run["tool"]["driver"]["rules"]
-    assert {r["id"] for r in rules} == {f"R{i}" for i in range(1, 27)}
+    assert {r["id"] for r in rules} == {f"R{i}" for i in range(1, 30)}
     for r in rules:
         assert r["fullDescription"]["text"], r["id"]
         assert r["helpUri"].startswith("ARCHITECTURE.md#"), r["id"]
@@ -2047,6 +2047,199 @@ def test_runtime_modules_stay_field_clean():
         "ray_tpu/util/client/client.py",
     )]
     eng = LintEngine(targets, only_rules={"R23", "R24", "R25"})
+    findings = eng.run()
+    assert not eng.errors, eng.errors
+    assert [f.format() for f in findings] == []
+
+
+# -- R27-R29: static SPMD sharding & the comms manifest -----------------------
+
+def test_r27_fires_on_unknown_axis_dup_and_arity(tmp_path):
+    findings = run_rule(tmp_path, "R27", """\
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from ray_tpu._private.jax_compat import shard_map
+
+        AXIS_ORDER = ("data", "tensor")
+
+        BAD = P("data", "rows")
+        DUP = P("data", "data")
+
+        def _two(a, b):
+            return jax.lax.psum(a, "data")
+
+        def build(mesh):
+            bad = shard_map(_two, mesh=mesh, in_specs=(P("data"),),
+                            out_specs=P("data"), check_vma=False)
+            return (bad,)
+    """)
+    assert [f.rule for f in findings] == ["R27"] * 3
+    msgs = " | ".join(f.message for f in findings)
+    assert "'rows'" in msgs                 # unknown mesh axis
+    assert "two dimensions" in msgs         # duplicate binding
+    assert "in_specs carries 1" in msgs     # arity vs _two's 2 params
+
+
+def test_r27_quiet_on_open_mesh_universe_and_clean_specs(tmp_path):
+    # No AXIS_ORDER/Mesh reachable: membership is undecidable, so the
+    # unknown-axis check must under-approximate to silence.
+    assert run_rule(tmp_path, "R27", """\
+        from jax.sharding import PartitionSpec as P
+        SPEC = P("data", "rows")
+    """) == []
+    assert run_rule(tmp_path, "R27", """\
+        from jax.sharding import PartitionSpec as P
+        AXIS_ORDER = ("data", "tensor")
+        SPEC = P(("data", "tensor"), None)
+    """) == []
+
+
+def test_r27_fires_on_unknown_logical_axis(tmp_path):
+    findings = run_rule(tmp_path, "R27", """\
+        RULES = {"batch": "data", "mlp": "tensor"}
+
+        def make(rules):
+            return rules.spec(("batch", "typo"))
+    """)
+    assert [f.rule for f in findings] == ["R27"]
+    assert "'typo'" in findings[0].message
+
+
+def test_r28_fires_on_producer_consumer_mismatch(tmp_path):
+    src = """\
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ray_tpu._private.jax_compat import shard_map
+
+        def _one(x):
+            return x
+
+        _STEP = shard_map(_one, mesh=None, in_specs=(P("data"),),
+                          out_specs=P("data"), check_vma=False)
+
+        def feed(x, mesh):
+            x = jax.device_put(x, NamedSharding(mesh, P(%s)))
+            return _STEP(x)
+    """
+    bad = run_rule(tmp_path, "R28", src % "None")
+    assert [f.rule for f in bad] == ["R28"]
+    assert "resharding" in bad[0].message
+    assert run_rule(tmp_path, "R28", src % '"data"') == []
+
+
+def test_r28_fires_on_wasted_donation(tmp_path):
+    src = """\
+        import functools
+
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        @functools.partial(jax.jit, donate_argnums=(0,),
+                           in_shardings=(P("data"),),
+                           out_shardings=P(%s))
+        def step(state):
+            return state
+    """
+    bad = run_rule(tmp_path, "R28", src % "None")
+    assert [f.rule for f in bad] == ["R28"]
+    assert "donated argument 0" in bad[0].message
+    assert run_rule(tmp_path, "R28", src % '"data"') == []
+
+
+def test_r29_fires_on_ghost_axis_quiet_on_dynamic(tmp_path):
+    findings = run_rule(tmp_path, "R29", """\
+        import jax
+
+        AXIS_ORDER = ("data",)
+
+        def _leak(x):
+            return jax.lax.psum(x, "ghost")
+
+        def _dyn(x, axis):
+            return jax.lax.psum(x, axis)  # axis unknown -> no finding
+    """)
+    assert [f.rule for f in findings] == ["R29"]
+    assert "'ghost'" in findings[0].message
+
+
+def test_manifest_build_and_wire_parity_with_ledger(tmp_path):
+    from ray_tpu.devtools import shardprop
+    from ray_tpu.devtools.linter import FileContext
+    from ray_tpu.observability import comms
+
+    src = textwrap.dedent("""\
+        import jax
+
+        from ray_tpu import collective
+
+        AXIS_ORDER = ("data",)
+
+        def ring(x):
+            return jax.lax.psum(x, "data")
+
+        def sync(t):
+            return collective.allreduce(t, group_name="g")
+    """)
+    p = tmp_path / "m.py"
+    p.write_text(src)
+    model = shardprop.ShardModel([FileContext(str(p), "m.py", src)])
+    man = shardprop.build_manifest(model)
+    assert man["mesh_axes"] == ["data"]
+    assert "psum" in man["groups"]["axis:data"]
+    assert "allreduce" in man["groups"]["g"]
+    # Static wire factors must agree numerically with the runtime
+    # ledger's busbw table, or doctor's predicted bytes would drift
+    # from what the ledger reports for the very same op.
+    for op, fac in comms._BUSBW.items():
+        if op in shardprop.WIRE_FORMULAS:
+            for n in (2, 4, 8, 32):
+                assert shardprop.wire_factor(op, n) == pytest.approx(fac(n))
+
+
+_SPMD_CLEAN_SRC = """\
+from jax.sharding import PartitionSpec as P
+
+AXIS_ORDER = ("data",)
+SPEC = P("data")
+"""
+
+
+def test_shard_fact_cache_invalidates_only_the_edited_file(tmp_path,
+                                                           monkeypatch):
+    """Per-file shard facts are cached by content hash exactly like
+    stitch/field facts: after editing one of N files, the warm run
+    replays N-1 fact sets and re-derives only the edited file's."""
+    monkeypatch.setenv("RAYLINT_CACHE", str(tmp_path / "cache.json"))
+    root = tmp_path / "proj"
+    root.mkdir()
+    names = ("a.py", "b.py", "c.py")
+    for name in names:
+        (root / name).write_text(_SPMD_CLEAN_SRC)
+
+    eng_cold = LintEngine([str(root)], cache=True)
+    assert eng_cold.run() == []
+    assert not eng_cold.errors, eng_cold.errors
+    assert eng_cold.shard_stats == (0, len(names))
+
+    (root / "c.py").write_text("# nudged\n" + _SPMD_CLEAN_SRC)
+    eng_warm = LintEngine([str(root)], cache=True)
+    assert eng_warm.run() == []
+    assert eng_warm.shard_stats == (len(names) - 1, len(names))
+
+
+def test_spmd_modules_stay_shard_clean():
+    """Regression guard for the sharding fixes that landed with R27-R29:
+    the parallel/train/models/rl trees must lint clean under the SPMD
+    rules without allow comments."""
+    targets = [os.path.join(REPO, rel) for rel in (
+        "ray_tpu/parallel",
+        "ray_tpu/train",
+        "ray_tpu/models",
+        "ray_tpu/rl",
+    )]
+    eng = LintEngine(targets, only_rules={"R27", "R28", "R29"})
     findings = eng.run()
     assert not eng.errors, eng.errors
     assert [f.format() for f in findings] == []

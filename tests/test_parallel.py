@@ -31,9 +31,10 @@ def test_build_mesh_axes(eight_device_mesh):
 
 def test_sharding_rules_spec():
     rules = ShardingRules()
+    # embed -> fsdp is already used by batch, so spec() dedups it to None
+    # rather than binding fsdp to a second dimension (an invalid spec).
     spec = rules.spec(("batch", "seq", "embed"))
-    assert spec == P(("data", "fsdp"), "seq", None) or spec == P(
-        ("data", "fsdp"), "seq", "fsdp")
+    assert spec == P(("data", "fsdp"), "seq", None)
 
 
 def test_sharding_rules_no_duplicate_axis():
@@ -134,3 +135,55 @@ def test_moe_routes_and_preserves_shape(eight_device_mesh):
     expected = x + gv[:, None] * x * (idx + 1.0)[:, None]
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_mesh_config_two_wildcards_rejected():
+    with pytest.raises(ValueError, match="at most one axis may be -1"):
+        MeshConfig(data=-1, fsdp=-1).resolved(8)
+
+
+def test_mesh_config_wildcard_not_divisible():
+    with pytest.raises(ValueError, match="not divisible"):
+        MeshConfig(data=-1, tensor=3).resolved(8)
+
+
+def test_sharding_drops_size_one_axes(eight_device_mesh):
+    # batch -> ("data", "fsdp") and mlp -> tensor, but on a data-only
+    # mesh fsdp/tensor are size 1: both must drop out of the spec.
+    mesh = build_mesh(MeshConfig(data=8), eight_device_mesh)
+    sh = ShardingRules().sharding(mesh, ("batch", "mlp"))
+    assert sh.spec in (P("data", None), P(("data",), None))
+
+
+def test_sharding_rules_strict_raises_on_typo():
+    rules = ShardingRules()
+    with pytest.raises(ValueError, match="unknown logical axis"):
+        rules.spec(("batch", "typo"), strict=True)
+    # the default path replicates the unknown dimension instead
+    assert rules.spec(("batch", "typo")) == P(("data", "fsdp"), None)
+
+
+def test_sharding_strict_rejects_mesh_geometry_drift(eight_device_mesh):
+    from jax.sharding import Mesh
+    mesh = Mesh(np.asarray(eight_device_mesh), ("rows",))
+    rules = ShardingRules()
+    with pytest.raises(ValueError, match="absent from this mesh"):
+        rules.sharding(mesh, ("batch",), strict=True)
+    # non-strict: geometry drift quietly degrades to replication
+    assert rules.sharding(mesh, ("batch",)).spec == P(None)
+
+
+def test_shard_pytree_mismatched_axes_tree_names_path(eight_device_mesh):
+    mesh = build_mesh(MeshConfig(data=2, tensor=4), eight_device_mesh)
+    params = {"w": jnp.ones((16, 32)), "b": jnp.ones((32,))}
+    with pytest.raises(ValueError, match="does not mirror tree at") as ei:
+        shard_pytree(params, {"w": ("embed", "mlp")}, mesh)
+    assert "missing keys ['b']" in str(ei.value)
+    with pytest.raises(ValueError, match="does not mirror tree at") as ei:
+        shard_pytree(params, ("embed", "mlp"), mesh)
+    assert "tree has a dict" in str(ei.value)
+    # a strict-mode rules error passes through untranslated: the shapes
+    # mirror fine, the axis name is what is wrong
+    with pytest.raises(ValueError, match="unknown logical axis"):
+        shard_pytree(params, {"w": ("embed", "typo"), "b": ("mlp",)},
+                     mesh, strict=True)
